@@ -1,0 +1,20 @@
+"""PredictionIO-TPU: a TPU-native machine-learning serving and lifecycle framework.
+
+A ground-up rebuild of the capability surface of Apache PredictionIO
+(incubating) — event collection, DASE engines (Data source / Preparator /
+Algorithm(s) / Serving), training, deployment as an HTTP query server, and
+evaluation/tuning — with the Spark/MLlib execution substrate replaced by
+JAX/XLA/Pallas on TPU:
+
+- arrays + ``jit``/``shard_map`` over a ``jax.sharding.Mesh`` replace
+  RDDs + spark-submit + shuffle,
+- Pallas kernels implement the hot per-block normal-equation solves of ALS,
+- XLA collectives (psum/all_gather) over ICI replace the Spark shuffle for
+  factor exchange,
+- a plain Python/HTTP control plane replaces the JVM/akka one.
+
+Reference capability map: see SURVEY.md at the repo root. Reference layer
+map: /root/reference SURVEY §1 (L0 Spark substrate → L5 CLI).
+"""
+
+__version__ = "0.1.0"

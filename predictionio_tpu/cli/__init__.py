@@ -1,0 +1,1 @@
+"""`pio` command-line interface (reference tools/.../console/Console.scala)."""

@@ -1,0 +1,47 @@
+"""`pio` CLI entry point (reference tools/.../console/Console.scala:78).
+
+Verbs land here incrementally; unknown verbs print usage and exit 1.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+USAGE = """pio <command> [options]
+
+Commands (TPU-native PredictionIO):
+  status                     check storage configuration
+  version                    print version
+
+Run 'pio <command> --help' for command help."""
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = sys.argv[1:] if argv is None else list(argv)
+    if not args or args[0] in ("help", "--help", "-h"):
+        print(USAGE)
+        return 0
+    verb = args[0]
+    if verb == "version":
+        from predictionio_tpu import __version__
+
+        print(__version__)
+        return 0
+    if verb == "status":
+        from predictionio_tpu.data.storage import REPOSITORIES, get_storage
+
+        storage = get_storage()
+        storage.verify_all_data_objects()
+        for repo in REPOSITORIES:
+            name, typ = storage.repository_source(repo)
+            print(f"{repo}: source={name} type={typ}")
+        print("(sanity check) All storage repositories verified.")
+        return 0
+    print(f"pio: unknown command {verb!r}", file=sys.stderr)
+    print(USAGE, file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,13 @@
+"""Event layer: canonical event model, property bags, aggregation, storage.
+
+Capability parity with the reference ``data/`` module (event model,
+validation, DataMap, $set/$unset/$delete property aggregation, BiMap id
+indexing, storage registry with METADATA/EVENTDATA/MODELDATA repositories).
+"""
+
+from predictionio_tpu.data.event import Event, EventValidationError
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.propertymap import PropertyMap
+from predictionio_tpu.data.bimap import BiMap
+
+__all__ = ["Event", "EventValidationError", "DataMap", "PropertyMap", "BiMap"]

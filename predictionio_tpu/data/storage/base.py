@@ -1,0 +1,356 @@
+"""Storage DAO contracts and metadata records.
+
+Capability parity with the reference storage abstraction
+(data/.../storage/: Apps.scala:32, AccessKeys.scala:35, Channels.scala:32,
+EngineInstances.scala:46, EvaluationInstances.scala:42, Models.scala:33,
+LEvents.scala:40, PEvents.scala:38). The L/P DAO split collapses here: one
+``Events`` contract serves both the serving-time point lookups (L) and the
+training-time bulk scans (P); bulk reads return plain lists that feed the
+jax/numpy array builders (the RDD analog).
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import re
+import secrets
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Iterable, Sequence
+
+from predictionio_tpu.data.event import Event
+
+# --------------------------------------------------------------------------
+# Metadata records
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class App:
+    """An application namespace for events (reference Apps.scala:32-44)."""
+
+    id: int
+    name: str
+    description: str | None = None
+
+
+@dataclass
+class AccessKey:
+    """Event-server credential, scoped to an app and optionally to specific
+    event names (reference AccessKeys.scala:35-50)."""
+
+    key: str
+    appid: int
+    events: list[str] = field(default_factory=list)
+
+
+def generate_access_key() -> str:
+    """64 random bytes, URL-safe base64 (reference AccessKeys.generateKey)."""
+    return base64.urlsafe_b64encode(secrets.token_bytes(48)).decode("ascii").rstrip("=")
+
+
+CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+
+
+@dataclass
+class Channel:
+    """A named sub-stream of an app's events (reference Channels.scala:32-45).
+
+    Name constraint mirrors Channels.isValidName (1-16 alphanumeric or '-').
+    """
+
+    id: int
+    name: str
+    appid: int
+
+    @staticmethod
+    def is_valid_name(name: str) -> bool:
+        return bool(CHANNEL_NAME_RE.match(name))
+
+
+class EngineInstanceStatus:
+    INIT = "INIT"
+    TRAINING = "TRAINING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+
+
+@dataclass
+class EngineInstance:
+    """One training run's metadata (reference EngineInstances.scala:46-97).
+
+    ``runtime_conf`` is the analog of the reference's ``sparkConf``:
+    free-form execution-substrate configuration (mesh shape, precision,
+    donation flags) recorded with the run.
+    """
+
+    id: str
+    status: str
+    start_time: datetime
+    end_time: datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    runtime_conf: dict[str, str] = field(default_factory=dict)
+    datasource_params: str = "{}"
+    preparator_params: str = "{}"
+    algorithms_params: str = "[]"
+    serving_params: str = "{}"
+
+
+class EvaluationInstanceStatus:
+    INIT = "INIT"
+    EVALUATING = "EVALUATING"
+    EVALCOMPLETED = "EVALCOMPLETED"
+    FAILED = "FAILED"
+
+
+@dataclass
+class EvaluationInstance:
+    """One evaluation run's metadata (reference EvaluationInstances.scala:42-81)."""
+
+    id: str
+    status: str
+    start_time: datetime
+    end_time: datetime
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    runtime_conf: dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclass
+class Model:
+    """A serialized trained model blob (reference Models.scala:33-51)."""
+
+    id: str
+    models: bytes
+
+
+# --------------------------------------------------------------------------
+# DAO contracts
+# --------------------------------------------------------------------------
+
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> int | None:
+        """Insert; app.id == 0 means auto-assign. Returns the assigned id."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> App | None: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> App | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, access_key: AccessKey) -> str | None:
+        """Insert; empty key means generate one. Returns the key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> AccessKey | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, access_key: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> int | None:
+        """Insert; channel.id == 0 means auto-assign. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Channel | None: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> list[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str:
+        """Insert; empty id means auto-assign. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> EngineInstance | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> EngineInstance | None:
+        """Most recent COMPLETED instance for (engineId, version, variant) —
+        what ``deploy`` picks (reference commands/Engine.scala:224-230)."""
+
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class EvaluationInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> EvaluationInstance | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class Models(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Model | None: ...
+
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> bool: ...
+
+
+class Events(abc.ABC):
+    """Event CRUD + queries for one storage backend.
+
+    Unified L+P contract (reference LEvents.scala:40-513, PEvents.scala:38-188):
+    point ops serve the event server and serving-time business rules; ``find``
+    with no limit is the bulk training read whose result feeds array builders.
+    """
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Create the backing table/namespace for an (app, channel)."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Drop all events of an (app, channel)."""
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        """Insert one event, returning its assigned event id.
+
+        Contract (all backends): the (app, channel) namespace is auto-created
+        on first insert, and inserting with an existing ``event_id`` replaces
+        the stored event."""
+
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None: ...
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool: ...
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+        limit: int | None = None,
+        reversed_order: bool = False,
+    ) -> list[Event]:
+        """Query events. ``target_entity_type``/``target_entity_id`` use
+        ``...`` (Ellipsis) for "don't care", ``None`` for "must be absent"
+        — mirroring the reference's Option[Option[String]] semantics
+        (LEvents.scala:282-313). ``limit=None`` or ``-1`` means all."""
+
+    def batch_insert(
+        self, events: Iterable[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        entity_type: str = "",
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, Any]:
+        """Aggregated entityId -> PropertyMap view (LEvents.scala:373-418).
+
+        ``entity_type`` is mandatory (as in the reference API): aggregating
+        across entity types would merge unrelated entities sharing an id.
+        """
+        if not entity_type:
+            raise ValueError("aggregate_properties requires entity_type")
+        from predictionio_tpu.data.aggregator import (
+            AGGREGATOR_EVENT_NAMES,
+            aggregate_properties,
+        )
+
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=list(AGGREGATOR_EVENT_NAMES),
+        )
+        result = aggregate_properties(events)
+        if required:
+            req = set(required)
+            result = {k: v for k, v in result.items() if req.issubset(v.keyset())}
+        return result
+
+    def close(self) -> None:
+        """Release backend resources."""

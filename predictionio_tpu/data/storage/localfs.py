@@ -1,0 +1,46 @@
+"""Local-filesystem model store: one file per model id.
+
+Capability parity with the reference's localfs backend
+(storage/localfs/src/main/scala/.../LocalFSModels.scala — one file per
+model id under ``PIO_FS_BASEDIR``).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from urllib.parse import quote
+
+from predictionio_tpu.data.storage import base
+
+
+class LocalFSStorageClient:
+    def __init__(self, config: dict | None = None):
+        self.config = config or {}
+        self.base_path = Path(self.config.get("path", "~/.pio_tpu/models")).expanduser()
+        self.base_path.mkdir(parents=True, exist_ok=True)
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, client: LocalFSStorageClient):
+        self._c = client
+
+    def _path(self, model_id: str) -> Path:
+        # percent-encoding keeps distinct ids on distinct files (injective)
+        safe = quote(model_id, safe="")
+        return self._c.base_path / f"pio_model_{safe}.bin"
+
+    def insert(self, model: base.Model) -> None:
+        self._path(model.id).write_bytes(model.models)
+
+    def get(self, model_id: str) -> base.Model | None:
+        p = self._path(model_id)
+        if not p.exists():
+            return None
+        return base.Model(model_id, p.read_bytes())
+
+    def delete(self, model_id: str) -> bool:
+        p = self._path(model_id)
+        if p.exists():
+            p.unlink()
+            return True
+        return False

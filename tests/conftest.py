@@ -1,0 +1,29 @@
+"""Test fixtures.
+
+Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding
+(mesh/pjit/shard_map) is exercised without TPU hardware — the analog of the
+reference's Spark local[4] stand-in for a cluster
+(core/src/test/scala/org/apache/predictionio/workflow/BaseTest.scala:31-92).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from predictionio_tpu.data.storage import set_storage, test_storage  # noqa: E402
+
+
+@pytest.fixture()
+def storage():
+    """Fresh in-memory storage installed as the process singleton."""
+    s = test_storage()
+    set_storage(s)
+    yield s
+    set_storage(None)

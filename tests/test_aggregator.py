@@ -1,0 +1,97 @@
+"""Property aggregation tests (mirrors reference LEventAggregatorSpec /
+PEventAggregatorSpec, data/src/test/scala/.../LEventAggregatorSpec.scala)."""
+
+from datetime import datetime, timedelta, timezone
+
+from predictionio_tpu.data.aggregator import (
+    aggregate_properties,
+    aggregate_properties_single,
+)
+from predictionio_tpu.data.event import Event
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def ev(name, entity_id, props, minutes):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity_id,
+        properties=props,
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def test_set_merge_later_wins():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"a": 1, "b": "x"}, 0),
+            ev("$set", "u1", {"b": "y", "c": 3}, 1),
+        ]
+    )
+    assert pm is not None
+    assert pm.to_dict() == {"a": 1, "b": "y", "c": 3}
+    assert pm.first_updated == T0
+    assert pm.last_updated == T0 + timedelta(minutes=1)
+
+
+def test_order_independence():
+    events = [
+        ev("$set", "u1", {"a": 1}, 0),
+        ev("$set", "u1", {"a": 2}, 5),
+        ev("$unset", "u1", {"a": None}, 3),
+    ]
+    # replay must sort by event time: set(1) @0, unset @3, set(2) @5
+    pm = aggregate_properties_single(reversed(events))
+    assert pm.to_dict() == {"a": 2}
+
+
+def test_unset_removes_keys():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"a": 1, "b": 2}, 0),
+            ev("$unset", "u1", {"a": None}, 1),
+        ]
+    )
+    assert pm.to_dict() == {"b": 2}
+
+
+def test_delete_drops_entity():
+    assert (
+        aggregate_properties_single(
+            [ev("$set", "u1", {"a": 1}, 0), ev("$delete", "u1", {}, 1)]
+        )
+        is None
+    )
+
+
+def test_set_after_delete_recreates():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"a": 1, "b": 2}, 0),
+            ev("$delete", "u1", {}, 1),
+            ev("$set", "u1", {"c": 3}, 2),
+        ]
+    )
+    assert pm.to_dict() == {"c": 3}
+
+
+def test_non_special_events_ignored():
+    pm = aggregate_properties_single(
+        [ev("$set", "u1", {"a": 1}, 0), ev("rate", "u1", {"rating": 5}, 1)]
+    )
+    assert pm.to_dict() == {"a": 1}
+    assert pm.last_updated == T0  # non-special event doesn't touch times
+
+
+def test_multi_entity_grouping():
+    out = aggregate_properties(
+        [
+            ev("$set", "u1", {"a": 1}, 0),
+            ev("$set", "u2", {"a": 2}, 0),
+            ev("$delete", "u2", {}, 1),
+            ev("rate", "u3", {"r": 1}, 0),
+        ]
+    )
+    assert set(out) == {"u1"}
+    assert out["u1"].to_dict() == {"a": 1}

@@ -1,0 +1,159 @@
+"""Event model + validation tests (mirrors reference EventValidation rules,
+data/.../storage/Event.scala:112-141, and the DataMapSpec/BiMapSpec suites)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap, BiMapError
+from predictionio_tpu.data.datamap import DataMap, DataMapError
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    format_time,
+    parse_time,
+    validate,
+)
+
+
+def make(**kw):
+    defaults = dict(event="rate", entity_type="user", entity_id="u1")
+    defaults.update(kw)
+    return Event(**defaults)
+
+
+class TestEventValidation:
+    def test_valid_plain_event(self):
+        validate(make(target_entity_type="item", target_entity_id="i1"))
+
+    def test_empty_event_name_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate(make(event=""))
+
+    def test_empty_entity_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate(make(entity_type=""))
+        with pytest.raises(EventValidationError):
+            validate(make(entity_id=""))
+
+    def test_target_entity_must_be_paired(self):
+        with pytest.raises(EventValidationError):
+            validate(make(target_entity_type="item"))
+        with pytest.raises(EventValidationError):
+            validate(make(target_entity_id="i1"))
+
+    def test_special_events_allowed(self):
+        validate(make(event="$set", properties={"a": 1}))
+        validate(make(event="$unset", properties={"a": 1}))
+        validate(make(event="$delete"))
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(EventValidationError):
+            validate(make(event="$unset"))
+
+    def test_unknown_reserved_prefix_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate(make(event="$other"))
+        with pytest.raises(EventValidationError):
+            validate(make(event="pio_custom"))
+
+    def test_special_event_cannot_have_target(self):
+        with pytest.raises(EventValidationError):
+            validate(
+                make(event="$set", target_entity_type="x", target_entity_id="y")
+            )
+
+    def test_reserved_entity_type(self):
+        with pytest.raises(EventValidationError):
+            validate(make(entity_type="pio_custom"))
+        validate(make(entity_type="pio_pr"))  # built-in
+
+    def test_reserved_property_prefix(self):
+        with pytest.raises(EventValidationError):
+            validate(make(properties={"pio_score": 1}))
+
+    def test_json_roundtrip(self):
+        e = make(
+            target_entity_type="item",
+            target_entity_id="i1",
+            properties={"rating": 4.5},
+            event_time=datetime(2020, 1, 2, 3, 4, 5, 678000, tzinfo=timezone.utc),
+            tags=("a", "b"),
+            pr_id="pr-1",
+        )
+        e2 = Event.from_json(e.to_json())
+        assert e2.event == e.event
+        assert e2.entity_id == e.entity_id
+        assert e2.target_entity_id == "i1"
+        assert e2.properties.get_double("rating") == 4.5
+        assert e2.event_time == e.event_time
+        assert e2.tags == ("a", "b")
+        assert e2.pr_id == "pr-1"
+
+    def test_time_format(self):
+        dt = datetime(2020, 1, 2, 3, 4, 5, 678000, tzinfo=timezone.utc)
+        assert format_time(dt) == "2020-01-02T03:04:05.678Z"
+        assert parse_time("2020-01-02T03:04:05.678Z") == dt
+        assert parse_time("2020-01-02T03:04:05.678+00:00") == dt
+
+
+class TestDataMap:
+    def test_required_getters(self):
+        dm = DataMap({"a": 1, "b": "x", "c": 2.5, "d": [1.0, 2.0], "e": ["s"]})
+        assert dm.get_int("a") == 1
+        assert dm.get_string("b") == "x"
+        assert dm.get_double("c") == 2.5
+        assert dm.get_double("a") == 1.0  # int widens to double
+        assert dm.get_double_list("d") == [1.0, 2.0]
+        assert dm.get_string_list("e") == ["s"]
+
+    def test_missing_required_raises(self):
+        with pytest.raises(DataMapError):
+            DataMap({}).get_required("nope")
+        with pytest.raises(DataMapError):
+            DataMap({"a": None}).get_required("a")
+
+    def test_wrong_type_raises(self):
+        with pytest.raises(DataMapError):
+            DataMap({"a": "str"}).get_double("a")
+        with pytest.raises(DataMapError):
+            DataMap({"a": True}).get_int("a")
+
+    def test_optional(self):
+        dm = DataMap({"a": 1})
+        assert dm.get_opt("a") == 1
+        assert dm.get_opt("b") is None
+        assert dm.get_opt("b", default=7) == 7
+
+    def test_merge_and_remove(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = DataMap({"y": 3, "z": 4})
+        assert a.merge(b).to_dict() == {"x": 1, "y": 3, "z": 4}
+        assert a.remove(["x"]).to_dict() == {"y": 2}
+        assert a.to_dict() == {"x": 1, "y": 2}  # immutability
+
+    def test_json_roundtrip(self):
+        dm = DataMap({"nested": {"a": [1, 2]}, "b": None})
+        assert DataMap.from_json(dm.to_json()) == dm
+
+
+class TestBiMap:
+    def test_string_int_dense_first_seen(self):
+        m = BiMap.string_int(["b", "a", "b", "c"])
+        assert m.to_dict() == {"b": 0, "a": 1, "c": 2}
+        assert m.inverse[1] == "a"
+        assert m.inverse.inverse["a"] == 1
+
+    def test_one_to_one_enforced(self):
+        with pytest.raises(BiMapError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_take(self):
+        m = BiMap.string_int(["a", "b", "c"])
+        assert m.take(["a", "c", "zz"]).to_dict() == {"a": 0, "c": 2}
+
+    def test_vectorized(self):
+        m = BiMap.string_int(["u1", "u2", "u3"])
+        arr = m.to_index_array(["u3", "u1", "u1"])
+        assert arr.tolist() == [2, 0, 0]
+        assert arr.dtype.name == "int32"

@@ -1,0 +1,358 @@
+"""Storage registry + backend tests (mirrors reference LEventsSpec/
+PEventsSpec in storage/jdbc/src/test and the metadata DAO behaviors)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineInstanceStatus,
+    EvaluationInstance,
+    EvaluationInstanceStatus,
+    Model,
+    Storage,
+    StorageError,
+    test_storage as make_test_storage,
+)
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def _event(i, entity="u1", name="rate", target=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties={"rating": float(i)},
+        event_time=T0 + timedelta(minutes=i),
+    )
+
+
+def storages(tmp_path):
+    sqlite_env = {
+        "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+    }
+    return [make_test_storage(), Storage(env=sqlite_env)]
+
+
+@pytest.fixture(params=["memory", "sqlite+localfs"])
+def any_storage(request, tmp_path):
+    mem, sql = storages(tmp_path)
+    s = mem if request.param == "memory" else sql
+    yield s
+    s.close()
+
+
+class TestMetadataDAOs:
+    def test_apps_crud(self, any_storage):
+        apps = any_storage.get_metadata_apps()
+        app_id = apps.insert(App(0, "myapp", "desc"))
+        assert app_id is not None
+        assert apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        assert apps.update(App(app_id, "renamed", None))
+        assert apps.get_by_name("renamed") is not None
+        assert [a.id for a in apps.get_all()] == [app_id]
+        assert apps.delete(app_id)
+        assert apps.get(app_id) is None
+
+    def test_access_keys(self, any_storage):
+        keys = any_storage.get_metadata_access_keys()
+        k = keys.insert(AccessKey("", appid=7, events=["rate"]))
+        assert k and len(k) > 20
+        got = keys.get(k)
+        assert got.appid == 7 and got.events == ["rate"]
+        k2 = keys.insert(AccessKey("explicit-key", appid=7))
+        assert k2 == "explicit-key"
+        assert {x.key for x in keys.get_by_appid(7)} == {k, "explicit-key"}
+        assert keys.delete(k)
+        assert keys.get(k) is None
+
+    def test_channels(self, any_storage):
+        channels = any_storage.get_metadata_channels()
+        ch_id = channels.insert(Channel(0, "live", appid=3))
+        assert ch_id is not None
+        assert channels.get(ch_id).name == "live"
+        assert channels.insert(Channel(0, "bad name!", appid=3)) is None
+        assert channels.insert(Channel(0, "live", appid=3)) is None  # dup per app
+        assert channels.insert(Channel(0, "live", appid=4)) is not None
+        assert len(channels.get_by_appid(3)) == 1
+        assert channels.delete(ch_id)
+
+    def test_engine_instances_lifecycle(self, any_storage):
+        instances = any_storage.get_metadata_engine_instances()
+        base = dict(
+            engine_id="e1",
+            engine_version="v1",
+            engine_variant="default",
+            engine_factory="my.Engine",
+        )
+        i1 = EngineInstance(
+            id="", status=EngineInstanceStatus.INIT,
+            start_time=T0, end_time=T0, **base,
+        )
+        iid = instances.insert(i1)
+        assert instances.get_latest_completed("e1", "v1", "default") is None
+        i1.status = EngineInstanceStatus.COMPLETED
+        i1.end_time = T0 + timedelta(minutes=5)
+        assert instances.update(i1)
+        i2 = EngineInstance(
+            id="", status=EngineInstanceStatus.COMPLETED,
+            start_time=T0 + timedelta(hours=1),
+            end_time=T0 + timedelta(hours=2), **base,
+        )
+        instances.insert(i2)
+        latest = instances.get_latest_completed("e1", "v1", "default")
+        assert latest.id == i2.id
+        assert len(instances.get_completed("e1", "v1", "default")) == 2
+        assert instances.get_latest_completed("other", "v1", "default") is None
+        assert instances.delete(iid)
+
+    def test_evaluation_instances(self, any_storage):
+        evals = any_storage.get_metadata_evaluation_instances()
+        e = EvaluationInstance(
+            id="", status=EvaluationInstanceStatus.INIT,
+            start_time=T0, end_time=T0, evaluation_class="my.Eval",
+        )
+        eid = evals.insert(e)
+        e.status = EvaluationInstanceStatus.EVALCOMPLETED
+        e.evaluator_results = "score=0.9"
+        assert evals.update(e)
+        assert evals.get(eid).evaluator_results == "score=0.9"
+        assert [x.id for x in evals.get_completed()] == [eid]
+
+
+class TestModels:
+    def test_model_blobs(self, any_storage):
+        models = any_storage.get_model_data_models()
+        models.insert(Model("m1", b"\x00\x01binary\xff"))
+        assert models.get("m1").models == b"\x00\x01binary\xff"
+        models.insert(Model("m1", b"new"))  # overwrite
+        assert models.get("m1").models == b"new"
+        assert models.delete("m1")
+        assert models.get("m1") is None
+
+
+class TestEvents:
+    def test_insert_get_delete(self, any_storage):
+        events = any_storage.get_events()
+        events.init(1)
+        eid = events.insert(_event(1), 1)
+        got = events.get(eid, 1)
+        assert got is not None and got.properties.get_double("rating") == 1.0
+        assert events.delete(eid, 1)
+        assert events.get(eid, 1) is None
+
+    def test_find_filters(self, any_storage):
+        events = any_storage.get_events()
+        events.init(1)
+        events.batch_insert(
+            [
+                _event(0, "u1", "rate", target="i1"),
+                _event(1, "u1", "buy", target="i2"),
+                _event(2, "u2", "rate", target="i1"),
+                _event(3, "u2", "$set"),
+            ],
+            1,
+        )
+        assert len(events.find(1)) == 4
+        assert len(events.find(1, entity_id="u1")) == 2
+        assert len(events.find(1, event_names=["rate"])) == 2
+        assert len(events.find(1, event_names=["rate", "buy"])) == 3
+        assert len(events.find(1, target_entity_id="i1")) == 2
+        assert len(events.find(1, target_entity_id=None)) == 1
+        assert (
+            len(
+                events.find(
+                    1,
+                    start_time=T0 + timedelta(minutes=1),
+                    until_time=T0 + timedelta(minutes=3),
+                )
+            )
+            == 2
+        )
+        # ordering + limit + reversed
+        times = [e.event_time for e in events.find(1)]
+        assert times == sorted(times)
+        last = events.find(1, limit=1, reversed_order=True)
+        assert last[0].event == "$set"
+
+    def test_channel_isolation(self, any_storage):
+        events = any_storage.get_events()
+        events.init(1)
+        events.init(1, channel_id=2)
+        events.insert(_event(0), 1)
+        events.insert(_event(1), 1, channel_id=2)
+        assert len(events.find(1)) == 1
+        assert len(events.find(1, channel_id=2)) == 1
+        events.remove(1, channel_id=2)
+        assert len(events.find(1, channel_id=2)) == 0
+
+    def test_aggregate_properties_via_dao(self, any_storage):
+        events = any_storage.get_events()
+        events.init(1)
+        events.insert(
+            Event(
+                event="$set", entity_type="item", entity_id="i1",
+                properties={"color": "red", "price": 10},
+                event_time=T0,
+            ),
+            1,
+        )
+        events.insert(
+            Event(
+                event="$set", entity_type="item", entity_id="i2",
+                properties={"color": "blue"},
+                event_time=T0,
+            ),
+            1,
+        )
+        props = events.aggregate_properties(1, entity_type="item")
+        assert props["i1"].get_string("color") == "red"
+        required = events.aggregate_properties(1, entity_type="item", required=["price"])
+        assert set(required) == {"i1"}
+
+
+class TestRegistry:
+    def test_default_zero_config(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+        s = Storage()
+        assert s.verify_all_data_objects()
+        assert s.repository_source("MODELDATA")[1] == "localfs"
+        assert s.repository_source("METADATA")[1] == "sqlite"
+        s.close()
+
+    def test_unknown_source_rejected(self):
+        with pytest.raises(StorageError):
+            Storage(
+                env={
+                    "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+                    "PIO_STORAGE_SOURCES_DB_PATH": ":memory:",
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NOPE",
+                }
+            )
+
+    def test_capability_subset_enforced(self, tmp_path):
+        s = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+                "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+            }
+        )
+        assert s.get_model_data_models() is not None
+        with pytest.raises(StorageError):
+            s.get_metadata_apps()  # localfs can't hold metadata
+
+    def test_sqlite_persistence_across_instances(self, tmp_path):
+        env = {
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "p.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        }
+        s1 = Storage(env=env)
+        app_id = s1.get_metadata_apps().insert(App(0, "persist-me"))
+        s1.get_events().init(app_id)
+        s1.get_events().insert(_event(1), app_id)
+        s1.close()
+        s2 = Storage(env=env)
+        assert s2.get_metadata_apps().get_by_name("persist-me") is not None
+        assert len(s2.get_events().find(app_id)) == 1
+        s2.close()
+
+
+class TestEventStoreFacade:
+    def test_app_name_resolution(self, storage):
+        from predictionio_tpu.data import store
+
+        apps = storage.get_metadata_apps()
+        app_id = apps.insert(App(0, "facade-app"))
+        storage.get_events().init(app_id)
+        storage.get_events().insert(_event(5, "u9"), app_id)
+
+        found = store.find("facade-app", storage=storage)
+        assert len(found) == 1 and found[0].entity_id == "u9"
+        with pytest.raises(store.EventStoreError):
+            store.find("missing-app", storage=storage)
+        with pytest.raises(store.EventStoreError):
+            store.find("facade-app", channel_name="nope", storage=storage)
+
+
+class TestReviewRegressions:
+    def test_event_timezone_roundtrip(self, any_storage):
+        from datetime import timezone as tz_mod
+
+        events = any_storage.get_events()
+        events.init(1)
+        offset = timezone(timedelta(hours=9))
+        e = Event(
+            event="rate", entity_type="user", entity_id="u1",
+            event_time=datetime(2020, 5, 1, 12, 0, tzinfo=offset),
+        )
+        eid = events.insert(e, 1)
+        got = events.get(eid, 1)
+        assert got.event_time == e.event_time
+        assert got.event_time.utcoffset() == timedelta(hours=9)
+
+    def test_insert_replaces_existing_event_id(self, any_storage):
+        events = any_storage.get_events()
+        events.init(1)
+        e1 = _event(1).with_event_id("fixed-id")
+        e2 = _event(2, entity="u7").with_event_id("fixed-id")
+        events.insert(e1, 1)
+        events.insert(e2, 1)
+        assert len(events.find(1)) == 1
+        assert events.get("fixed-id", 1).entity_id == "u7"
+
+    def test_insert_auto_creates_namespace(self, any_storage):
+        events = any_storage.get_events()
+        eid = events.insert(_event(1), 42)  # no init() call
+        assert events.get(eid, 42) is not None
+
+    def test_explicit_then_auto_id_no_collision(self, any_storage):
+        apps = any_storage.get_metadata_apps()
+        assert apps.insert(App(1, "explicit")) == 1
+        auto = apps.insert(App(0, "auto"))
+        assert auto is not None and auto != 1
+
+    def test_memory_snapshot_semantics(self):
+        s = make_test_storage()
+        instances = s.get_metadata_engine_instances()
+        inst = EngineInstance(
+            id="", status=EngineInstanceStatus.INIT, start_time=T0, end_time=T0,
+            engine_id="e", engine_version="v", engine_variant="d",
+            engine_factory="f",
+        )
+        iid = instances.insert(inst)
+        inst.status = EngineInstanceStatus.COMPLETED  # mutate without update()
+        assert instances.get(iid).status == EngineInstanceStatus.INIT
+
+    def test_localfs_id_encoding_injective(self, tmp_path):
+        from predictionio_tpu.data.storage.localfs import (
+            LocalFSModels,
+            LocalFSStorageClient,
+        )
+
+        models = LocalFSModels(LocalFSStorageClient({"path": str(tmp_path)}))
+        models.insert(Model("a/b", b"one"))
+        models.insert(Model("a_b", b"two"))
+        assert models.get("a/b").models == b"one"
+        assert models.get("a_b").models == b"two"
