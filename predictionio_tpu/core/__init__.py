@@ -1,0 +1,53 @@
+"""DASE core: controller contracts, engine orchestration, workflow drivers.
+
+Capability parity with the reference ``core/`` module
+(core/src/main/scala/org/apache/predictionio/{core,controller}/): the
+Data source / Preparator / Algorithm(s) / Serving component model, typed
+params, engine train/eval orchestration, model persistence, and the
+train/eval workflow drivers.
+
+TPU-first redesign notes:
+
+- The reference's L / P / P2L algorithm split encodes *where RDDs live*.
+  On TPU there is one natural contract: train consumes host-side prepared
+  data and produces a (possibly mesh-sharded) device model; predict is a
+  device computation per query batch. So there is a single ``Algorithm``
+  base with optional batch methods, and "distributed model" is expressed
+  by sharding annotations inside the model pytree, not by a class split.
+- ``SparkContext`` is replaced by :class:`WorkflowContext`, which owns the
+  ``jax.sharding.Mesh`` (the ICI/DCN device fabric) instead of an RDD
+  scheduler.
+"""
+
+from predictionio_tpu.core.params import Params, EmptyParams, EngineParams
+from predictionio_tpu.core.base import (
+    Algorithm,
+    DataSource,
+    Preparator,
+    IdentityPreparator,
+    Serving,
+    FirstServing,
+    AverageServing,
+    SanityCheck,
+    doer,
+)
+from predictionio_tpu.core.context import WorkflowContext
+from predictionio_tpu.core.engine import Engine, EngineFactory
+
+__all__ = [
+    "Params",
+    "EmptyParams",
+    "EngineParams",
+    "Algorithm",
+    "DataSource",
+    "Preparator",
+    "IdentityPreparator",
+    "Serving",
+    "FirstServing",
+    "AverageServing",
+    "SanityCheck",
+    "doer",
+    "WorkflowContext",
+    "Engine",
+    "EngineFactory",
+]

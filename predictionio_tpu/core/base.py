@@ -1,0 +1,160 @@
+"""DASE component contracts: DataSource, Preparator, Algorithm, Serving.
+
+Capability parity with the reference's controller layer
+(core/.../core/BaseDataSource.scala:43, BasePreparator.scala,
+BaseAlgorithm.scala:69-125, BaseServing.scala, controller/LAlgorithm.scala:45,
+P2LAlgorithm.scala:46, PAlgorithm.scala:47, LServing.scala,
+IdentityPreparator.scala, SanityCheck.scala).
+
+TPU-first collapse of the reference's type zoo: the L/P/P2L split encoded
+whether data/models lived in one JVM heap or across RDD partitions. Here
+training data is host-side Python/numpy, models are pytrees (optionally
+sharded over the WorkflowContext mesh), so one ``Algorithm`` contract
+covers all three; ``batch_predict`` has a default implementation that
+loops ``predict`` (engines override it with a vmapped/jitted batch path —
+that's the P2L "qs.mapValues(predict)" analog done properly on the MXU).
+"""
+
+from __future__ import annotations
+
+import abc
+import inspect
+import logging
+from typing import Any, Generic, Sequence, TypeVar
+
+from predictionio_tpu.core.context import WorkflowContext
+from predictionio_tpu.core.params import EmptyParams, Params
+
+logger = logging.getLogger(__name__)
+
+TD = TypeVar("TD")  # training data
+PD = TypeVar("PD")  # prepared data
+Q = TypeVar("Q")  # query
+P = TypeVar("P")  # predicted result
+A = TypeVar("A")  # actual result
+M = TypeVar("M")  # model
+
+
+class Component:
+    """Common base: every DASE component is constructed with a Params
+    instance available as ``self.params`` (reference AbstractDoer)."""
+
+    params_class: type[Params] = EmptyParams
+
+    def __init__(self, params: Params | None = None):
+        self.params = params if params is not None else self.params_class()
+
+
+def doer(cls: type, params: Params | None = None) -> Any:
+    """Instantiate a DASE component with params, tolerating zero-arg
+    constructors (reference core/AbstractDoer.scala ``object Doer``)."""
+    try:
+        sig = inspect.signature(cls.__init__)
+        takes_params = len(sig.parameters) > 1  # beyond self
+    except (TypeError, ValueError):
+        takes_params = True
+    if takes_params:
+        return cls(params) if params is not None else cls()
+    return cls()
+
+
+class DataSource(Component, Generic[TD, Q, A], abc.ABC):
+    """Reads training (and evaluation) data from the event store.
+
+    ``read_training`` -> TD; ``read_eval`` -> [(TD, eval_info, [(Q, A)])]
+    for k evaluation sets (reference BaseDataSource.readTrainingBase /
+    readEvalBase).
+    """
+
+    @abc.abstractmethod
+    def read_training(self, ctx: WorkflowContext) -> TD: ...
+
+    def read_eval(
+        self, ctx: WorkflowContext
+    ) -> list[tuple[TD, Any, list[tuple[Q, A]]]]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement read_eval; "
+            "evaluation is unavailable for this data source"
+        )
+
+
+class Preparator(Component, Generic[TD, PD], abc.ABC):
+    """TD -> PD transformation (reference BasePreparator.prepareBase)."""
+
+    @abc.abstractmethod
+    def prepare(self, ctx: WorkflowContext, training_data: TD) -> PD: ...
+
+
+class IdentityPreparator(Preparator[TD, TD]):
+    """PD = TD passthrough (reference controller/IdentityPreparator.scala)."""
+
+    def prepare(self, ctx: WorkflowContext, training_data: TD) -> TD:
+        return training_data
+
+
+class Algorithm(Component, Generic[PD, M, Q, P], abc.ABC):
+    """Train a model from prepared data; score queries against it.
+
+    The reference resolves the query class via runtime reflection
+    (BaseAlgorithm.queryClass); here ``query_class`` is an optional class
+    attribute used by the query server to deserialize JSON queries (dict
+    passthrough when None).
+    """
+
+    query_class: type | None = None
+
+    @abc.abstractmethod
+    def train(self, ctx: WorkflowContext, prepared_data: PD) -> M: ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P: ...
+
+    def batch_predict(self, model: M, queries: Sequence[tuple[int, Q]]) -> list[tuple[int, P]]:
+        """Evaluation-time bulk scoring. Default: loop ``predict``.
+
+        TPU engines override with a single jitted batch computation
+        (reference P2LAlgorithm.batchPredict's qs.mapValues analog).
+        """
+        return [(ix, self.predict(model, q)) for ix, q in queries]
+
+    # -- model persistence hooks (reference makePersistentModel) ----------
+    def make_persistent_model(self, model: M) -> Any:
+        """Return the object to persist for this model. Returning the model
+        itself means "pickle it"; returning a PersistentModel delegates to
+        its save/load contract; returning None means "retrain on deploy"
+        (the reference PAlgorithm-without-PersistentModel behavior)."""
+        return model
+
+
+class Serving(Component, Generic[Q, P], abc.ABC):
+    """Combines per-algorithm predictions into one response
+    (reference BaseServing.supplementBase/serveBase, LServing)."""
+
+    def supplement(self, query: Q) -> Q:
+        return query
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P: ...
+
+
+class FirstServing(Serving[Q, P]):
+    """Serve the first algorithm's prediction (reference LFirstServing:28)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(Serving[Q, float]):
+    """Average numeric predictions (reference LAverageServing:28)."""
+
+    def serve(self, query: Q, predictions: Sequence[float]) -> float:
+        return sum(predictions) / len(predictions)
+
+
+class SanityCheck(abc.ABC):
+    """Optional self-check run on TrainingData / PreparedData / models
+    during training unless skipped (reference controller/SanityCheck.scala,
+    invoked from controller/Engine.scala:652-708)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None: ...

@@ -1,0 +1,87 @@
+"""WorkflowContext: the execution-substrate handle passed through DASE.
+
+The reference threads a ``SparkContext`` through every DASE method
+(core/.../core/BaseAlgorithm.scala:69-82, workflow/WorkflowContext.scala).
+The TPU analog owns the device fabric instead of an RDD scheduler:
+
+- a ``jax.sharding.Mesh`` over the available devices (ICI within a slice,
+  DCN across hosts), built lazily so pure-host workflows never touch jax;
+- run metadata (mode, batch label) and runtime config (the ``sparkConf``
+  analog: mesh axis spec, precision, etc.);
+- a PRNG key root for reproducible training.
+
+Components that only do host work can ignore it; TPU algorithms get their
+mesh and sharding axes from here so the same engine code runs on 1 chip or
+a full slice.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class WorkflowContext:
+    """Execution context for one train/eval/serve run."""
+
+    def __init__(
+        self,
+        mode: str = "",
+        batch: str = "",
+        runtime_conf: dict[str, Any] | None = None,
+        mesh_axes: Sequence[tuple[str, int]] | None = None,
+        seed: int = 0,
+    ):
+        self.mode = mode
+        self.batch = batch
+        self.runtime_conf = dict(runtime_conf or {})
+        self.seed = seed
+        self._mesh = None
+        self._mesh_axes = list(mesh_axes) if mesh_axes else None
+        # app name mirrors the reference's "PredictionIO {mode}: {batch}"
+        self.app_name = f"PredictionIO-TPU {mode}: {batch}".strip(": ")
+
+    # -- device fabric -----------------------------------------------------
+    @property
+    def mesh(self):
+        """The device mesh, created on first use.
+
+        Default axes: a 1-D ``("data",)`` mesh over all devices. Engines
+        that want tp/sp/etc. pass ``mesh_axes`` like
+        ``[("data", 2), ("model", 4)]`` (sizes must multiply to the device
+        count, or use -1 once to absorb the remainder).
+        """
+        if self._mesh is None:
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            devices = jax.devices()
+            if self._mesh_axes:
+                names = [n for n, _ in self._mesh_axes]
+                sizes = [s for _, s in self._mesh_axes]
+                if -1 in sizes:
+                    known = int(np.prod([s for s in sizes if s != -1]))
+                    sizes[sizes.index(-1)] = len(devices) // max(known, 1)
+                arr = np.array(devices[: int(np.prod(sizes))]).reshape(sizes)
+                self._mesh = Mesh(arr, tuple(names))
+            else:
+                self._mesh = Mesh(np.array(devices), ("data",))
+        return self._mesh
+
+    @property
+    def num_devices(self) -> int:
+        import jax
+
+        return len(jax.devices())
+
+    def rng(self, salt: int = 0):
+        import jax
+
+        return jax.random.PRNGKey(self.seed + salt)
+
+    def stop(self) -> None:
+        """SparkContext.stop analog: release the mesh handle."""
+        self._mesh = None

@@ -1,0 +1,134 @@
+"""Model persistence: serialize trained models into the MODELDATA repo.
+
+Capability parity with the reference's model save/load paths:
+- Kryo round-trip of in-heap models into the ``Models`` store
+  (workflow/CoreWorkflow.scala:76-92) -> here: pickle with device arrays
+  pulled to host numpy first (jax arrays are not picklable across
+  processes; the host copy is the canonical persisted form).
+- ``PersistentModel``/``PersistentModelLoader`` custom contract
+  (controller/PersistentModel.scala) for models that manage their own
+  files (e.g. orbax checkpoint dirs) -> :class:`PersistentModel`.
+- PAlgorithm's "return Unit, retrain on deploy" escape hatch
+  (controller/Engine.scala:211-233) -> an algorithm's
+  ``make_persistent_model`` returning ``None``.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+logger = logging.getLogger(__name__)
+
+_RETRAIN_SENTINEL = "__pio_tpu_retrain__"
+
+
+class PersistentModel:
+    """Custom save/load contract. Subclasses implement ``save`` writing
+    wherever they like and classmethod ``load`` restoring; the framework
+    persists only the (class, model_id) manifest
+    (reference PersistentModelManifest)."""
+
+    def save(self, model_id: str) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def load(cls, model_id: str) -> "PersistentModel":
+        raise NotImplementedError
+
+
+@dataclass
+class _Manifest:
+    """What actually lands in the MODELDATA blob for one algorithm slot."""
+
+    kind: str  # "pickle" | "persistent" | "retrain"
+    payload: Any = None  # pickled bytes | (module, qualname) | None
+
+
+def _device_to_host(tree: Any) -> Any:
+    """Pull any jax arrays in a pytree to host numpy for pickling."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except ImportError:  # pure-host deployment
+        return tree
+
+    def convert(x):
+        if isinstance(x, jax.Array):
+            import numpy as np
+
+            return np.asarray(jax.device_get(x))
+        return x
+
+    return jax.tree_util.tree_map(convert, tree)
+
+
+def serialize_models(algorithms: Sequence[Any], models: Sequence[Any], model_id: str) -> bytes:
+    """Build the persisted blob for all algorithm models of one engine
+    instance (the makeSerializableModels pass, Engine.scala:286-304)."""
+    manifests: list[_Manifest] = []
+    for algo, model in zip(algorithms, models):
+        persistable = algo.make_persistent_model(model)
+        if persistable is None:
+            manifests.append(_Manifest(kind="retrain"))
+        elif isinstance(persistable, PersistentModel):
+            cls = type(persistable)
+            if not persistable.save(model_id):
+                raise RuntimeError(
+                    f"{cls.__name__}.save({model_id!r}) returned False"
+                )
+            manifests.append(
+                _Manifest(kind="persistent", payload=(cls.__module__, cls.__qualname__))
+            )
+        else:
+            host_model = _device_to_host(persistable)
+            manifests.append(
+                _Manifest(kind="pickle", payload=pickle.dumps(host_model, protocol=4))
+            )
+    buf = io.BytesIO()
+    pickle.dump(manifests, buf, protocol=4)
+    return buf.getvalue()
+
+
+def deserialize_models(
+    blob: bytes,
+    algorithms: Sequence[Any],
+    model_id: str,
+) -> list[Any]:
+    """Restore per-algorithm models; entries marked ``retrain`` come back
+    as :data:`RETRAIN` and the deploy path re-trains them
+    (prepareDeploy, Engine.scala:199-268)."""
+    import importlib
+
+    manifests: list[_Manifest] = pickle.loads(blob)
+    if len(manifests) != len(algorithms):
+        raise ValueError(
+            f"model blob has {len(manifests)} models but engine has "
+            f"{len(algorithms)} algorithms — variant/instance mismatch"
+        )
+    out: list[Any] = []
+    for manifest in manifests:
+        if manifest.kind == "pickle":
+            out.append(pickle.loads(manifest.payload))
+        elif manifest.kind == "persistent":
+            module, qualname = manifest.payload
+            cls: Any = importlib.import_module(module)
+            for part in qualname.split("."):
+                cls = getattr(cls, part)
+            out.append(cls.load(model_id))
+        elif manifest.kind == "retrain":
+            out.append(RETRAIN)
+        else:
+            raise ValueError(f"unknown model manifest kind {manifest.kind!r}")
+    return out
+
+
+class _Retrain:
+    def __repr__(self) -> str:
+        return "<RETRAIN: model must be re-trained on deploy>"
+
+
+RETRAIN = _Retrain()

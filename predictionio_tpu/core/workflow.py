@@ -1,0 +1,188 @@
+"""Workflow drivers: the train / deploy-prepare runtime around Engine.
+
+Capability parity with the reference's workflow layer
+(core/.../workflow/CreateWorkflow.scala:136, CoreWorkflow.scala:45-160):
+engine-instance lifecycle (INIT -> COMPLETED / FAILED), model blob
+persistence into MODELDATA, and the deploy path that re-hydrates (or
+re-trains) models for serving. The spark-submit process boundary is gone:
+drivers are plain function calls the CLI invokes in-process or in a
+subprocess.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import traceback
+from datetime import datetime, timezone
+from typing import Any, Mapping
+
+from predictionio_tpu.core import persistence
+from predictionio_tpu.core.context import WorkflowContext
+from predictionio_tpu.core.engine import (
+    Engine,
+    EngineParams,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+)
+from predictionio_tpu.data.storage import (
+    EngineInstance,
+    EngineInstanceStatus,
+    Model,
+    Storage,
+    get_storage,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _now() -> datetime:
+    return datetime.now(tz=timezone.utc)
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    engine_id: str = "default",
+    engine_version: str = "0",
+    engine_variant: str = "default",
+    engine_factory: str = "",
+    workflow_params: WorkflowParams | None = None,
+    storage: Storage | None = None,
+    ctx: WorkflowContext | None = None,
+) -> str:
+    """Train and persist: the `pio train` driver
+    (CreateWorkflow.main + CoreWorkflow.runTrain). Returns the engine
+    instance id; raises on failure after marking the instance FAILED."""
+    storage = storage or get_storage()
+    wp = workflow_params or WorkflowParams()
+    ctx = ctx or WorkflowContext(
+        mode="Training", batch=wp.batch, runtime_conf=wp.runtime_conf
+    )
+
+    instances = storage.get_metadata_engine_instances()
+    instance = EngineInstance(
+        id="",
+        status=EngineInstanceStatus.INIT,
+        start_time=_now(),
+        end_time=_now(),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=wp.batch,
+        runtime_conf={k: str(v) for k, v in wp.runtime_conf.items()},
+        datasource_params=_params_json(engine_params.datasource),
+        preparator_params=_params_json(engine_params.preparator),
+        algorithms_params=json.dumps(
+            [
+                {"name": name, "params": params.to_dict()}
+                for name, params in engine_params.algorithms
+            ],
+            sort_keys=True,
+        ),
+        serving_params=_params_json(engine_params.serving),
+    )
+    instance_id = instances.insert(instance)
+    logger.info("engine instance %s created (INIT)", instance_id)
+
+    try:
+        algorithms = engine.make_algorithms(engine_params)
+        models = engine.train(ctx, engine_params, wp, algorithms=algorithms)
+        if wp.save_model:
+            blob = persistence.serialize_models(algorithms, models, instance_id)
+            storage.get_model_data_models().insert(Model(instance_id, blob))
+        instance.status = EngineInstanceStatus.COMPLETED
+        instance.end_time = _now()
+        instances.update(instance)
+        logger.info("engine instance %s COMPLETED", instance_id)
+        return instance_id
+    except (StopAfterReadInterruption, StopAfterPrepareInterruption) as stop:
+        # debug stop requested via WorkflowParams — not a failure
+        # (reference CoreWorkflow.scala:91-97)
+        instance.end_time = _now()
+        instances.update(instance)
+        logger.info("training of %s interrupted by %s", instance_id, type(stop).__name__)
+        return instance_id
+    except Exception:
+        instance.status = EngineInstanceStatus.FAILED
+        instance.end_time = _now()
+        instances.update(instance)
+        logger.error(
+            "engine instance %s FAILED:\n%s", instance_id, traceback.format_exc()
+        )
+        raise
+
+
+def prepare_deploy(
+    engine: Engine,
+    instance: EngineInstance,
+    storage: Storage | None = None,
+    ctx: WorkflowContext | None = None,
+) -> tuple[EngineParams, list[Any], list[Any], Any]:
+    """Re-hydrate a completed instance for serving
+    (CreateServer.createServerActorWithEngine + Engine.prepareDeploy).
+
+    Returns (engine_params, algorithms, models, serving). Models persisted
+    as RETRAIN sentinels are re-trained here — on TPU the retrained factors
+    stay resident on the serving process's mesh (better than the
+    reference, which re-runs Spark jobs per deploy).
+    """
+    storage = storage or get_storage()
+    ctx = ctx or WorkflowContext(mode="Serving", batch=instance.batch)
+    engine_params = engine_params_from_instance(engine, instance)
+    algorithms = engine.make_algorithms(engine_params)
+    serving = engine.make_serving(engine_params)
+
+    blob = storage.get_model_data_models().get(instance.id)
+    if blob is None:
+        raise RuntimeError(
+            f"no persisted model for engine instance {instance.id}; "
+            "was it trained with save_model=False?"
+        )
+    models = persistence.deserialize_models(blob.models, algorithms, instance.id)
+    if any(m is persistence.RETRAIN for m in models):
+        logger.info("instance %s has retrain-on-deploy models; training", instance.id)
+        retrained = engine.train(ctx, engine_params, algorithms=algorithms)
+        models = [
+            retrained[i] if m is persistence.RETRAIN else m
+            for i, m in enumerate(models)
+        ]
+    return engine_params, algorithms, models, serving
+
+
+def engine_params_from_instance(
+    engine: Engine, instance: EngineInstance
+) -> EngineParams:
+    """Instance params-JSON -> EngineParams
+    (reference Engine.engineInstanceToEngineParams, Engine.scala:422-498)."""
+    variant: dict[str, Any] = {}
+    ds = json.loads(instance.datasource_params or "{}")
+    prep = json.loads(instance.preparator_params or "{}")
+    algos = json.loads(instance.algorithms_params or "[]")
+    serv = json.loads(instance.serving_params or "{}")
+    if ds:
+        variant["datasource"] = ds
+    if prep:
+        variant["preparator"] = prep
+    if algos:
+        variant["algorithms"] = algos
+    if serv:
+        variant["serving"] = serv
+    return engine.params_from_variant(variant)
+
+
+def _params_json(pair: tuple[str, Any]) -> str:
+    name, params = pair
+    return json.dumps({"name": name, "params": params.to_dict()}, sort_keys=True)
+
+
+def load_variant(path: str) -> dict[str, Any]:
+    """Read an engine variant JSON file (engine.json analog)."""
+    with open(path) as f:
+        return json.load(f)
+
+
+def variant_engine_params(engine: Engine, variant: Mapping[str, Any]) -> EngineParams:
+    return engine.params_from_variant(variant)

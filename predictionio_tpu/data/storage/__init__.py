@@ -140,12 +140,29 @@ class Storage:
         )
         prefix = "PIO_STORAGE_SOURCES_"
         sources: dict[str, dict] = {}
-        for k, v in self.env.items():
-            if not k.startswith(prefix):
+        # Source names may contain underscores: anchor on the *_TYPE keys to
+        # learn the names, then assign remaining props by longest-name match.
+        source_keys = [k for k in self.env if k.startswith(prefix)]
+        names = sorted(
+            (k[len(prefix):-len("_TYPE")] for k in source_keys if k.endswith("_TYPE")),
+            key=len,
+            reverse=True,
+        )
+        for name in names:
+            sources[name] = {"type": self.env[f"{prefix}{name}_TYPE"]}
+        for k in source_keys:
+            if k.endswith("_TYPE"):
                 continue
             rest = k[len(prefix):]
-            name, _, prop = rest.partition("_")
-            sources.setdefault(name, {})[prop.lower()] = v
+            owner = next((n for n in names if rest.startswith(n + "_")), None)
+            if owner is None:
+                raise StorageError(
+                    f"cannot match env var {k} to a configured source "
+                    f"(known sources: {sorted(names)}); did you set "
+                    f"{prefix}<NAME>_TYPE?"
+                )
+            prop = rest[len(owner) + 1 :]
+            sources[owner][prop.lower()] = self.env[k]
         if not sources:
             sources = {
                 "SQLITE": {"type": "sqlite", "path": os.path.join(base_dir, "pio.db")},

@@ -31,6 +31,10 @@ def _from_ts(ts: float) -> datetime:
     return datetime.fromtimestamp(ts, tz=timezone.utc)
 
 
+def _is_missing_table(err: sqlite3.OperationalError) -> bool:
+    return "no such table" in str(err)
+
+
 class SQLiteStorageClient:
     """One sqlite database file shared by all DAOs of this source."""
 
@@ -557,7 +561,9 @@ class SQLiteEvents(base.Events):
             try:
                 with self._c.conn:
                     self._c.conn.executemany(sql, rows)
-            except sqlite3.OperationalError:
+            except sqlite3.OperationalError as err:
+                if not _is_missing_table(err):
+                    raise
                 self.init(app_id, channel_id)
                 with self._c.conn:
                     self._c.conn.executemany(sql, rows)
@@ -589,8 +595,10 @@ class SQLiteEvents(base.Events):
         t = self._table(app_id, channel_id)
         try:
             row = self._c.query_one(f"SELECT * FROM {t} WHERE id=?", (event_id,))
-        except sqlite3.OperationalError:
-            return None
+        except sqlite3.OperationalError as err:
+            if _is_missing_table(err):
+                return None
+            raise
         return self._parse(row) if row else None
 
     def delete(
@@ -600,8 +608,10 @@ class SQLiteEvents(base.Events):
         with self._c.lock, self._c.conn:
             try:
                 cur = self._c.conn.execute(f"DELETE FROM {t} WHERE id=?", (event_id,))
-            except sqlite3.OperationalError:
-                return False
+            except sqlite3.OperationalError as err:
+                if _is_missing_table(err):
+                    return False
+                raise
             return cur.rowcount > 0
 
     def find(
@@ -633,6 +643,8 @@ class SQLiteEvents(base.Events):
             clauses.append("entityid = ?")
             params.append(entity_id)
         if event_names is not None:
+            if not event_names:
+                return []  # empty name filter matches nothing
             clauses.append(
                 "event IN (" + ",".join("?" * len(event_names)) + ")"
             )
@@ -656,8 +668,10 @@ class SQLiteEvents(base.Events):
             sql += f" LIMIT {int(limit)}"
         try:
             rows = self._c.query(sql, params)
-        except sqlite3.OperationalError:
-            return []
+        except sqlite3.OperationalError as err:
+            if _is_missing_table(err):
+                return []
+            raise
         return [self._parse(r) for r in rows]
 
     def close(self) -> None:

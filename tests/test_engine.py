@@ -1,0 +1,430 @@
+"""DASE runtime tests with a fake engine zoo.
+
+Mirrors the reference's EngineTest.scala/SampleEngine.scala strategy
+(core/src/test/scala/.../controller/SampleEngine.scala:30-120): id-tracking
+fake components so tests assert the exact data flow through
+read -> prepare -> train -> predict/serve, plus failure injection.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import pytest
+
+from predictionio_tpu.core import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    EmptyParams,
+    Engine,
+    EngineParams,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+    WorkflowContext,
+    doer,
+)
+from predictionio_tpu.core.engine import (
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+    resolve_engine_factory,
+)
+from predictionio_tpu.core import persistence, workflow
+from predictionio_tpu.data.storage import EngineInstanceStatus
+
+
+# --- fake engine zoo -------------------------------------------------------
+
+
+@dataclass
+class DSParams(Params):
+    id: int = 0
+    error: bool = False
+
+
+@dataclass
+class TrainingData:
+    id: int
+    error: bool = False
+
+
+class DataSource0(DataSource):
+    params_class = DSParams
+
+    def read_training(self, ctx):
+        return TrainingData(id=self.params.id, error=self.params.error)
+
+    def read_eval(self, ctx):
+        # two eval sets, each with 3 (q, a) pairs keyed by set index
+        out = []
+        for s in range(2):
+            td = TrainingData(id=self.params.id + s)
+            qa = [(10 * s + i, 100 * s + i) for i in range(3)]
+            out.append((td, {"set": s}, qa))
+        return out
+
+
+class SanityTrainingData(TrainingData, SanityCheck):
+    def sanity_check(self):
+        if self.error:
+            raise AssertionError("training data flagged as error")
+
+
+class SanityDataSource(DataSource0):
+    def read_training(self, ctx):
+        return SanityTrainingData(id=self.params.id, error=self.params.error)
+
+
+@dataclass
+class PParams(Params):
+    id: int = 0
+
+
+@dataclass
+class PreparedData:
+    td: TrainingData
+    pid: int
+
+
+class Preparator0(Preparator):
+    params_class = PParams
+
+    def prepare(self, ctx, td):
+        return PreparedData(td=td, pid=self.params.id)
+
+
+@dataclass
+class AlgoParams(Params):
+    id: int = 0
+
+
+@dataclass
+class FakeModel:
+    aid: int
+    pid: int
+    tid: int
+
+
+class Algo0(Algorithm):
+    params_class = AlgoParams
+
+    def train(self, ctx, pd: PreparedData) -> FakeModel:
+        return FakeModel(aid=self.params.id, pid=pd.pid, tid=pd.td.id)
+
+    def predict(self, model: FakeModel, query):
+        return (model.aid, model.tid, query)
+
+
+class NoParamsAlgo(Algorithm):
+    """Zero-configurable algorithm: Doer must tolerate it."""
+
+    def train(self, ctx, pd):
+        return FakeModel(aid=-1, pid=pd.pid, tid=pd.td.id)
+
+    def predict(self, model, query):
+        return (model.aid, model.tid, query)
+
+
+class Serving0(Serving):
+    def serve(self, query, predictions):
+        return ("served", query, tuple(predictions))
+
+
+def make_engine():
+    return Engine(
+        datasource_classes={"": DataSource0, "sane": SanityDataSource},
+        preparator_classes={"": Preparator0, "id": IdentityPreparator},
+        algorithm_classes={"": Algo0, "noparams": NoParamsAlgo},
+        serving_classes={"": Serving0, "first": FirstServing},
+    )
+
+
+def make_params(ds_id=1, p_id=2, algo_ids=(3, 4)):
+    return EngineParams(
+        datasource=("", DSParams(id=ds_id)),
+        preparator=("", PParams(id=p_id)),
+        algorithms=[("", AlgoParams(id=a)) for a in algo_ids],
+        serving=("", EmptyParams()),
+    )
+
+
+CTX = WorkflowContext(mode="Test")
+
+
+# --- tests -----------------------------------------------------------------
+
+
+class TestDoer:
+    def test_with_params(self):
+        a = doer(Algo0, AlgoParams(id=7))
+        assert a.params.id == 7
+
+    def test_zero_arg_component(self):
+        class Bare:
+            def __init__(self):
+                self.ok = True
+
+        assert doer(Bare, AlgoParams(id=1)).ok
+
+
+class TestEngineTrain:
+    def test_data_flows_through_all_components(self):
+        models = make_engine().train(CTX, make_params())
+        assert models == [
+            FakeModel(aid=3, pid=2, tid=1),
+            FakeModel(aid=4, pid=2, tid=1),
+        ]
+
+    def test_single_class_shorthand(self):
+        engine = Engine(DataSource0, Preparator0, Algo0, Serving0)
+        models = engine.train(CTX, make_params(algo_ids=(9,)))
+        assert models == [FakeModel(aid=9, pid=2, tid=1)]
+
+    def test_no_algorithms_rejected(self):
+        with pytest.raises(ValueError):
+            make_engine().train(CTX, make_params().copy(algorithms=[]))
+
+    def test_unknown_component_name(self):
+        ep = make_params().copy(datasource=("nope", DSParams()))
+        with pytest.raises(KeyError):
+            make_engine().train(CTX, ep)
+
+    def test_stop_after_read(self):
+        with pytest.raises(StopAfterReadInterruption):
+            make_engine().train(
+                CTX, make_params(), WorkflowParams(stop_after_read=True)
+            )
+
+    def test_stop_after_prepare(self):
+        with pytest.raises(StopAfterPrepareInterruption):
+            make_engine().train(
+                CTX, make_params(), WorkflowParams(stop_after_prepare=True)
+            )
+
+    def test_sanity_check_failure_aborts(self):
+        ep = make_params().copy(datasource=("sane", DSParams(id=1, error=True)))
+        with pytest.raises(AssertionError):
+            make_engine().train(CTX, ep)
+        # and is skippable (reference --skip-sanity-check)
+        make_engine().train(CTX, ep, WorkflowParams(skip_sanity_check=True))
+
+
+class TestEngineEval:
+    def test_eval_joins_queries_predictions_actuals(self):
+        results = make_engine().eval(CTX, make_params())
+        assert len(results) == 2  # two eval sets
+        for s, (info, served) in enumerate(results):
+            assert info == {"set": s}
+            assert len(served) == 3
+            for i, (q, p, a) in enumerate(served):
+                assert q == 10 * s + i
+                assert a == 100 * s + i
+                # serving got one prediction per algorithm, in algo order
+                assert p == ("served", q, ((3, 1 + s, q), (4, 1 + s, q)))
+
+    def test_batch_eval_covers_all_candidates(self):
+        eps = [make_params(algo_ids=(1,)), make_params(algo_ids=(2,))]
+        out = make_engine().batch_eval(CTX, eps)
+        assert [ep for ep, _ in out] == eps
+        assert len(out[0][1]) == 2
+
+
+class TestVariantParsing:
+    def test_full_variant(self):
+        variant = {
+            "datasource": {"params": {"id": 5}},
+            "preparator": {"params": {"id": 6}},
+            "algorithms": [
+                {"name": "", "params": {"id": 7}},
+                {"name": "noparams", "params": {}},
+            ],
+            "serving": {"name": "first", "params": {}},
+        }
+        ep = make_engine().params_from_variant(variant)
+        assert ep.datasource[1].id == 5
+        assert ep.preparator[1].id == 6
+        assert ep.algorithms[0][1].id == 7
+        assert ep.algorithms[1][0] == "noparams"
+        assert ep.serving[0] == "first"
+
+    def test_defaults_and_unknown_fields_tolerated(self):
+        ep = make_engine().params_from_variant(
+            {"datasource": {"params": {"id": 1, "bogus_field": True}}}
+        )
+        assert ep.datasource[1].id == 1
+        assert ep.algorithms[0][0] == ""
+
+    def test_unknown_algorithm_name_rejected(self):
+        with pytest.raises(KeyError):
+            make_engine().params_from_variant(
+                {"algorithms": [{"name": "missing", "params": {}}]}
+            )
+
+
+ENGINE_SINGLETON = make_engine()
+
+
+def engine_factory_fn():
+    return make_engine()
+
+
+class TestFactoryResolution:
+    def test_module_level_instance(self):
+        e = resolve_engine_factory(f"{__name__}.ENGINE_SINGLETON")
+        assert isinstance(e, Engine)
+
+    def test_callable(self):
+        e = resolve_engine_factory(f"{__name__}.engine_factory_fn")
+        assert isinstance(e, Engine)
+
+    def test_bad_path(self):
+        with pytest.raises(ValueError):
+            resolve_engine_factory("notdotted")
+
+
+class SavedModel(persistence.PersistentModel):
+    saved: dict = {}
+
+    def __init__(self, value):
+        self.value = value
+
+    def save(self, model_id):
+        SavedModel.saved[model_id] = self.value
+        return True
+
+    @classmethod
+    def load(cls, model_id):
+        return cls(cls.saved[model_id])
+
+
+class PersistentAlgo(Algo0):
+    def train(self, ctx, pd):
+        return SavedModel(value=self.params.id)
+
+    def make_persistent_model(self, model):
+        return model
+
+    def predict(self, model, query):
+        return model.value
+
+
+class RetrainAlgo(Algo0):
+    def make_persistent_model(self, model):
+        return None  # PAlgorithm-without-PersistentModel analog
+
+
+class TestPersistence:
+    def test_pickle_roundtrip_with_numpy(self):
+        import numpy as np
+
+        algo = Algo0(AlgoParams(id=1))
+        model = {"w": np.arange(4.0), "meta": FakeModel(1, 2, 3)}
+        blob = persistence.serialize_models([algo], [model], "m1")
+        [restored] = persistence.deserialize_models(blob, [algo], "m1")
+        assert restored["meta"] == model["meta"]
+        assert (restored["w"] == model["w"]).all()
+
+    def test_jax_arrays_persist_as_host_arrays(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        algo = Algo0(AlgoParams(id=1))
+        model = {"w": jnp.ones((2, 2))}
+        blob = persistence.serialize_models([algo], [model], "m2")
+        [restored] = persistence.deserialize_models(blob, [algo], "m2")
+        assert isinstance(restored["w"], np.ndarray)
+        assert restored["w"].sum() == 4.0
+
+    def test_persistent_model_contract(self):
+        algo = PersistentAlgo(AlgoParams(id=42))
+        model = algo.train(CTX, PreparedData(TrainingData(1), 1))
+        blob = persistence.serialize_models([algo], [model], "m3")
+        [restored] = persistence.deserialize_models(blob, [algo], "m3")
+        assert isinstance(restored, SavedModel) and restored.value == 42
+
+    def test_retrain_sentinel(self):
+        algo = RetrainAlgo(AlgoParams(id=1))
+        blob = persistence.serialize_models([algo], ["whatever"], "m4")
+        [restored] = persistence.deserialize_models(blob, [algo], "m4")
+        assert restored is persistence.RETRAIN
+
+    def test_count_mismatch_rejected(self):
+        algo = Algo0(AlgoParams(id=1))
+        blob = persistence.serialize_models([algo], ["m"], "m5")
+        with pytest.raises(ValueError):
+            persistence.deserialize_models(blob, [algo, algo], "m5")
+
+
+class TestWorkflowLifecycle:
+    def test_run_train_completes_and_persists(self, storage):
+        instance_id = workflow.run_train(
+            make_engine(),
+            make_params(),
+            engine_id="eng",
+            engine_version="1",
+            engine_variant="v",
+            storage=storage,
+        )
+        inst = storage.get_metadata_engine_instances().get(instance_id)
+        assert inst.status == EngineInstanceStatus.COMPLETED
+        assert storage.get_model_data_models().get(instance_id) is not None
+        latest = storage.get_metadata_engine_instances().get_latest_completed(
+            "eng", "1", "v"
+        )
+        assert latest.id == instance_id
+
+    def test_run_train_failure_marks_failed(self, storage):
+        class BoomAlgo(Algo0):
+            def train(self, ctx, pd):
+                raise RuntimeError("boom")
+
+        engine = Engine(DataSource0, Preparator0, BoomAlgo, Serving0)
+        with pytest.raises(RuntimeError):
+            workflow.run_train(engine, make_params(algo_ids=(1,)), storage=storage)
+        all_instances = storage.get_metadata_engine_instances().get_all()
+        assert len(all_instances) == 1
+        assert all_instances[0].status == EngineInstanceStatus.FAILED
+
+    def test_prepare_deploy_rehydrates(self, storage):
+        engine = make_engine()
+        instance_id = workflow.run_train(
+            engine, make_params(), engine_id="e", storage=storage
+        )
+        inst = storage.get_metadata_engine_instances().get(instance_id)
+        ep, algorithms, models, serving = workflow.prepare_deploy(
+            engine, inst, storage=storage
+        )
+        # params round-tripped through instance JSON
+        assert ep.datasource[1].id == 1
+        assert models == [FakeModel(3, 2, 1), FakeModel(4, 2, 1)]
+        # full serving path works on rehydrated models
+        preds = [a.predict(m, "q") for a, m in zip(algorithms, models)]
+        assert serving.serve("q", preds) == ("served", "q", ((3, 1, "q"), (4, 1, "q")))
+
+    def test_prepare_deploy_retrains_sentinels(self, storage):
+        engine = Engine(
+            DataSource0, Preparator0, {"": RetrainAlgo}, Serving0
+        )
+        instance_id = workflow.run_train(
+            engine, make_params(algo_ids=(5,)), storage=storage
+        )
+        inst = storage.get_metadata_engine_instances().get(instance_id)
+        _, _, models, _ = workflow.prepare_deploy(engine, inst, storage=storage)
+        assert models == [FakeModel(aid=5, pid=2, tid=1)]
+
+    def test_prepare_deploy_without_model_blob(self, storage):
+        engine = make_engine()
+        instance_id = workflow.run_train(
+            engine,
+            make_params(),
+            storage=storage,
+            workflow_params=WorkflowParams(save_model=False),
+        )
+        inst = storage.get_metadata_engine_instances().get(instance_id)
+        with pytest.raises(RuntimeError):
+            workflow.prepare_deploy(engine, inst, storage=storage)

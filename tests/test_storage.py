@@ -356,3 +356,28 @@ class TestReviewRegressions:
         models.insert(Model("a_b", b"two"))
         assert models.get("a/b").models == b"one"
         assert models.get("a_b").models == b"two"
+
+
+class TestRegistryParsing:
+    def test_underscore_source_names(self, tmp_path):
+        s = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_MY_PG_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_MY_PG_PATH": str(tmp_path / "a.db"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MY_PG",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MY_PG",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MY_PG",
+            }
+        )
+        assert s.repository_source("METADATA") == ("MY_PG", "sqlite")
+        s.close()
+
+    def test_orphan_prop_rejected(self):
+        with pytest.raises(StorageError):
+            Storage(env={"PIO_STORAGE_SOURCES_DB_PATH": "/tmp/x.db"})
+
+    def test_empty_event_names_matches_nothing(self, any_storage):
+        events = any_storage.get_events()
+        events.init(1)
+        events.insert(_event(0), 1)
+        assert events.find(1, event_names=[]) == []
