@@ -1,0 +1,128 @@
+"""Benchmark: MovieLens-100K-shaped ALS training on TPU vs CPU baseline.
+
+North star (BASELINE.json): MovieLens ALS train wall-clock at RMSE parity
+(rank 20) vs Spark-MLlib ALS. The reference publishes no numbers and this
+box has no Spark and no network, so the measured comparator is the same
+blocked normal-equation ALS implemented in NumPy on the host CPU — the
+single-machine stand-in for the JVM baseline (BASELINE.md).
+
+Data: synthetic MovieLens-100K shape (943 users x 1682 items, 100k
+ratings, long-tail degree distribution, 1-5 star values from a low-rank
+ground truth + noise), fixed seed.
+
+Prints ONE JSON line:
+  {"metric": "ml100k_als_train_wallclock", "value": <tpu seconds>,
+   "unit": "s", "vs_baseline": <cpu_seconds / tpu_seconds>, ...extras}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+RANK = 20
+ITERATIONS = 10
+REG = 0.05
+NUM_USERS, NUM_ITEMS, NUM_RATINGS = 943, 1682, 100_000
+SEED = 42
+
+
+def make_ml100k_shaped():
+    rng = np.random.default_rng(SEED)
+    # long-tail popularity: zipf-ish item/user sampling
+    user_p = rng.pareto(1.2, NUM_USERS) + 1
+    user_p /= user_p.sum()
+    item_p = rng.pareto(1.1, NUM_ITEMS) + 1
+    item_p /= item_p.sum()
+    rows = rng.choice(NUM_USERS, NUM_RATINGS, p=user_p).astype(np.int32)
+    cols = rng.choice(NUM_ITEMS, NUM_RATINGS, p=item_p).astype(np.int32)
+    gt_rank = 8
+    U = rng.normal(size=(NUM_USERS, gt_rank)) / np.sqrt(gt_rank)
+    V = rng.normal(size=(NUM_ITEMS, gt_rank)) / np.sqrt(gt_rank)
+    raw = (U[rows] * V[cols]).sum(1) + 0.3 * rng.normal(size=NUM_RATINGS)
+    vals = np.clip(np.round(3.0 + 1.5 * raw), 1, 5).astype(np.float32)
+    return rows, cols, vals
+
+
+def numpy_als(buckets_row, buckets_col, num_u, num_i, rank, iterations, reg, seed):
+    """CPU comparator: identical algorithm (bucketed batched solves) in
+    NumPy float32."""
+    rng = np.random.default_rng(seed)
+    U = (rng.standard_normal((num_u, rank)) / np.sqrt(rank)).astype(np.float32)
+    V = (rng.standard_normal((num_i, rank)) / np.sqrt(rank)).astype(np.float32)
+    eye = np.eye(rank, dtype=np.float32)
+
+    def half(target, other, buckets):
+        for b in buckets:
+            vg = other[b.col_ids]  # [B,K,D]
+            vw = vg * b.mask[:, :, None]
+            A = np.einsum("bkd,bke->bde", vw, vg, optimize=True)
+            n = b.mask.sum(1)
+            lam = reg * np.where(n > 0, n, 1.0)
+            A += lam[:, None, None] * eye
+            rhs = np.einsum("bkd,bk->bd", vg, b.ratings * b.mask, optimize=True)
+            target[b.row_ids] = np.linalg.solve(A, rhs[..., None])[..., 0].astype(np.float32)
+
+    for _ in range(iterations):
+        half(U, V, buckets_row)
+        half(V, U, buckets_col)
+    return U, V
+
+
+def main() -> None:
+    import jax
+
+    from predictionio_tpu.ops import als
+
+    rows, cols, vals = make_ml100k_shaped()
+    data = als.build_ratings_data(rows, cols, vals, NUM_USERS, NUM_ITEMS)
+    params = als.ALSParams(
+        rank=RANK, iterations=ITERATIONS, reg=REG, seed=SEED, compute_dtype="float32"
+    )
+
+    # --- TPU (or whatever the default jax device is) ---
+    # warmup: compile all bucket kernels with a 1-iteration run
+    warm = als.ALSParams(**{**params.__dict__, "iterations": 1})
+    als.als_train(data, warm)[0].block_until_ready()
+    t0 = time.perf_counter()
+    U, V = als.als_train(data, params)
+    U.block_until_ready()
+    V.block_until_ready()
+    tpu_s = time.perf_counter() - t0
+    tpu_rmse = als.rmse(U, V, rows, cols, vals)
+
+    # --- CPU baseline (same algorithm, numpy) ---
+    t0 = time.perf_counter()
+    Un, Vn = numpy_als(
+        data.row_buckets,
+        data.col_buckets,
+        NUM_USERS,
+        NUM_ITEMS,
+        RANK,
+        ITERATIONS,
+        REG,
+        SEED,
+    )
+    cpu_s = time.perf_counter() - t0
+    pred = (Un[rows] * Vn[cols]).sum(1)
+    cpu_rmse = float(np.sqrt(np.mean((pred - vals) ** 2)))
+
+    result = {
+        "metric": "ml100k_als_train_wallclock",
+        "value": round(tpu_s, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_s / tpu_s, 2),
+        "baseline_cpu_s": round(cpu_s, 4),
+        "rmse": round(tpu_rmse, 4),
+        "baseline_rmse": round(cpu_rmse, 4),
+        "rank": RANK,
+        "iterations": ITERATIONS,
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

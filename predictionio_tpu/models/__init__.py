@@ -1,0 +1,8 @@
+"""Engine templates: the workloads the framework ships with.
+
+Parity targets (SURVEY §2.7): the reference's maintained template families
+— recommendation (explicit ALS), classification (NaiveBayes),
+similar-product (implicit ALS + item-item cosine), e-commerce
+recommendation (weighted implicit ALS + serve-time business rules) — all
+re-founded on the TPU ops in ``predictionio_tpu.ops``.
+"""

@@ -1,0 +1,10 @@
+"""TPU compute ops: the execution substrate replacing Spark/MLlib.
+
+The reference delegates all numeric work to Spark MLlib (ALS.train,
+ALS.trainImplicit, NaiveBayes.train — external dependency, SURVEY §2.7).
+This package is the TPU-native replacement: batched linear-algebra
+formulations of the same algorithms that map onto the MXU (dense batched
+matmuls + Cholesky solves, static shapes via degree bucketing), with
+Pallas kernels for the fused hot paths and shard_map parallel versions in
+``predictionio_tpu.parallel``.
+"""

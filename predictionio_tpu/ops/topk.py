@@ -1,0 +1,56 @@
+"""On-device scoring + top-k for serving.
+
+The deployed engine scores ``user_vector @ V^T`` on-device and takes the
+top-k (reference predict path: MatrixFactorizationModel.recommendProducts
+invoked from examples/.../ALSAlgorithm.scala:88 — an RDD job per query in
+the reference; a single fused device op here). Supports exclusion of
+already-seen / blacklisted items via score masking (the e-commerce
+template's business rules, examples/scala-parallel-ecommercerecommendation/
+weighted-items/src/main/scala/ALSAlgorithm.scala:234-265).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_items(user_vector, item_factors, k: int, exclude_mask=None):
+    """Scores one user vector against all items; returns (scores, ids).
+
+    ``exclude_mask``: optional [num_items] bool/0-1 array; masked items
+    can never appear in the result.
+    """
+    scores = item_factors @ user_vector  # [I]
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask.astype(bool), NEG_INF, scores)
+    k = min(k, item_factors.shape[0])
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_items_batch(user_vectors, item_factors, k: int, exclude_mask=None):
+    """Batched variant: [B, D] user vectors -> ([B, k] scores, [B, k] ids)."""
+    scores = user_vectors @ item_factors.T  # [B, I]
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask.astype(bool)[None, :], NEG_INF, scores)
+    k = min(k, item_factors.shape[0])
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_similar(item_vector, item_factors, k: int, exclude_mask=None):
+    """Cosine item-item similarity top-k (similarproduct template's scoring,
+    examples/scala-parallel-similarproduct/multi/src/main/scala/
+    ALSAlgorithm.scala:147,193,244)."""
+    norms = jnp.linalg.norm(item_factors, axis=1) * jnp.linalg.norm(item_vector)
+    scores = (item_factors @ item_vector) / jnp.maximum(norms, 1e-12)
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask.astype(bool), NEG_INF, scores)
+    k = min(k, item_factors.shape[0])
+    return jax.lax.top_k(scores, k)

@@ -1,0 +1,17 @@
+"""Multi-chip parallelism: meshes, shardings, and collective ALS steps.
+
+The reference's distribution substrate is Spark (RDD partitioning, shuffle,
+broadcast — SURVEY §2.7 parallelism note). The TPU-native replacement:
+
+- a ``jax.sharding.Mesh`` over the slice's devices (ICI) / hosts (DCN),
+- ``shard_map`` partitioned compute with explicit XLA collectives
+  (``all_gather`` for factor exchange, ``psum`` for Gramian reduction) —
+  the Spark shuffle of MLlib ALS's factor exchange becomes an all-gather
+  riding ICI each half-iteration,
+- evaluation-candidate parallelism (the embarrassingly-parallel
+  ``batchEval``) maps to independent sharded runs.
+"""
+
+from predictionio_tpu.parallel.mesh import make_mesh, device_count
+
+__all__ = ["make_mesh", "device_count"]

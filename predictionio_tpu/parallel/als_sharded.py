@@ -1,0 +1,254 @@
+"""Mesh-sharded ALS: the multi-chip training step.
+
+MLlib ALS distributes by blocking users x items across executors and
+shuffling factor blocks each half-iteration (external Spark dep; SURVEY
+§2.7). The TPU-native design (ALX pattern, PAPERS.md):
+
+- both factor matrices live **sharded row-wise** over the mesh's ``data``
+  axis (P("data") on dim 0),
+- each half-iteration ``all_gather``s the *opposite* factor matrix over
+  ICI (it is the smaller working set), solves the local shard's normal
+  equations with the same batched bucket solves as single-chip, and leaves
+  the updated factors sharded in place,
+- the implicit-feedback Gramian Y^T Y is computed shard-locally and
+  ``psum``-reduced — a [D, D] allreduce instead of MLlib's shuffle.
+
+Bucket arrays are padded and uploaded to the mesh **once** before the
+iteration loop (they are training-constant); padding rows solve an
+identity system and scatter into a dummy factor row. Factor rows beyond
+the true count are zero-initialized so they contribute nothing to the
+psum'd Gramian.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops import als as als_ops
+
+
+# ---------------------------------------------------------------------------
+# Host-side: pad buckets for even sharding, upload once
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceBucket:
+    """A PaddedBucket padded to the shard count and resident on the mesh.
+
+    Padding rows have mask == 0 and scatter into ``dummy_row`` (an extra
+    factor row appended for this purpose).
+    """
+
+    row_ids: jax.Array  # [B'] int32 (replicated; used for host-side scatter)
+    col_ids: jax.Array  # [B', K] sharded P(axis)
+    ratings: jax.Array  # [B', K] sharded P(axis)
+    mask: jax.Array  # [B', K] sharded P(axis)
+
+
+def upload_buckets(
+    buckets: Sequence[als_ops.PaddedBucket],
+    mesh: Mesh,
+    axis: str,
+    dummy_row: int,
+) -> list[DeviceBucket]:
+    """Pad each bucket so B is divisible by the mesh axis size and place
+    the arrays sharded on the mesh. Done once per training run."""
+    shards = mesh.shape[axis]
+    sharding = NamedSharding(mesh, P(axis))
+    out = []
+    for bucket in buckets:
+        B, K = bucket.col_ids.shape
+        pad = (-B) % shards
+        row_ids = np.concatenate(
+            [bucket.row_ids, np.full(pad, dummy_row, dtype=np.int32)]
+        )
+        col_ids = np.concatenate([bucket.col_ids, np.zeros((pad, K), np.int32)])
+        ratings = np.concatenate([bucket.ratings, np.zeros((pad, K), np.float32)])
+        mask = np.concatenate([bucket.mask, np.zeros((pad, K), np.float32)])
+        out.append(
+            DeviceBucket(
+                row_ids=jnp.asarray(row_ids),
+                col_ids=jax.device_put(col_ids, sharding),
+                ratings=jax.device_put(ratings, sharding),
+                mask=jax.device_put(mask, sharding),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device-side: shard_map'ed half-step
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh",
+        "axis",
+        "implicit",
+        "alpha",
+        "weighted_reg",
+        "implicit_weighted_reg",
+        "compute_dtype",
+        "use_pallas",
+    ),
+)
+def sharded_solve_bucket(
+    factors_other,  # [R+pad, D] sharded P(axis) on dim 0
+    col_ids,  # [B', K] sharded P(axis)
+    ratings,
+    mask,
+    reg: float,
+    *,
+    mesh: Mesh,
+    axis: str = "data",
+    implicit: bool = False,
+    alpha: float = 1.0,
+    weighted_reg: bool = True,
+    implicit_weighted_reg: bool = False,
+    compute_dtype: str = "float32",
+    use_pallas: bool = False,
+):
+    """Solve one bucket with factors_other sharded row-wise.
+
+    Inside each shard: all_gather(factors_other) over ICI -> local batched
+    solve. For implicit feedback the global Gramian is psum-reduced from
+    shard-local partial Gramians first.
+    """
+
+    def local(f_other_shard, col_ids_l, ratings_l, mask_l):
+        f_other = jax.lax.all_gather(f_other_shard, axis, tiled=True)
+        if implicit:
+            part = als_ops.compute_gram(f_other_shard, compute_dtype)
+            gram = jax.lax.psum(part, axis)
+            return als_ops.solve_bucket_implicit(
+                f_other,
+                gram,
+                col_ids_l,
+                ratings_l,
+                mask_l,
+                reg=reg,
+                alpha=alpha,
+                weighted_reg=implicit_weighted_reg,
+                compute_dtype=compute_dtype,
+                use_pallas=use_pallas,
+            )
+        return als_ops.solve_bucket_explicit(
+            f_other,
+            col_ids_l,
+            ratings_l,
+            mask_l,
+            reg=reg,
+            weighted_reg=weighted_reg,
+            compute_dtype=compute_dtype,
+            use_pallas=use_pallas,
+        )
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+    )(factors_other, col_ids, ratings, mask)
+
+
+# ---------------------------------------------------------------------------
+# Full sharded training
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ShardedALSState:
+    """Factors resident on the mesh, each with one trailing dummy row."""
+
+    mesh: Mesh
+    axis: str
+    U: jax.Array  # [num_rows+pad, D] sharded P(axis)
+    V: jax.Array  # [num_cols+pad, D] sharded P(axis)
+    num_rows: int
+    num_cols: int
+
+
+def _padded_len(n: int, shards: int) -> int:
+    return n + 1 + ((-(n + 1)) % shards)  # +1 dummy row, then round up
+
+
+def init_sharded_factors(
+    data: als_ops.RatingsData, params: als_ops.ALSParams, mesh: Mesh, axis: str = "data"
+) -> ShardedALSState:
+    shards = mesh.shape[axis]
+    key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
+    u_len = _padded_len(data.num_rows, shards)
+    v_len = _padded_len(data.num_cols, shards)
+    U = als_ops.init_factors(u_len, params.rank, key_u)
+    V = als_ops.init_factors(v_len, params.rank, key_v)
+    # zero the dummy/pad rows: they are never solved but WOULD otherwise
+    # pollute the psum'd implicit Gramian with their random init
+    U = U.at[data.num_rows:].set(0.0)
+    V = V.at[data.num_cols:].set(0.0)
+    sharding = NamedSharding(mesh, P(axis))
+    return ShardedALSState(
+        mesh=mesh,
+        axis=axis,
+        U=jax.device_put(U, sharding),
+        V=jax.device_put(V, sharding),
+        num_rows=data.num_rows,
+        num_cols=data.num_cols,
+    )
+
+
+def sharded_half_step(
+    state: ShardedALSState,
+    factors_self,
+    factors_other,
+    device_buckets: Sequence[DeviceBucket],
+    params: als_ops.ALSParams,
+):
+    """Update factors_self (sharded) from factors_other (sharded), over
+    pre-uploaded buckets."""
+    for db in device_buckets:
+        x = sharded_solve_bucket(
+            factors_other,
+            db.col_ids,
+            db.ratings,
+            db.mask,
+            params.reg,
+            mesh=state.mesh,
+            axis=state.axis,
+            implicit=params.implicit,
+            alpha=params.alpha,
+            weighted_reg=params.weighted_reg,
+            implicit_weighted_reg=params.implicit_weighted_reg,
+            compute_dtype=params.compute_dtype,
+            use_pallas=params.use_pallas,
+        )
+        # scatter updated rows; padding rows hit the dummy row harmlessly
+        factors_self = factors_self.at[db.row_ids].set(x)
+    return factors_self
+
+
+def sharded_als_train(
+    data: als_ops.RatingsData,
+    params: als_ops.ALSParams,
+    mesh: Mesh,
+    axis: str = "data",
+) -> tuple[jax.Array, jax.Array]:
+    """Full ALS with mesh-resident factors. Returns (U, V) trimmed to the
+    true row counts (still device arrays; shard layout preserved until the
+    caller re-shards or fetches)."""
+    state = init_sharded_factors(data, params, mesh, axis)
+    row_dbs = upload_buckets(data.row_buckets, mesh, axis, state.U.shape[0] - 1)
+    col_dbs = upload_buckets(data.col_buckets, mesh, axis, state.V.shape[0] - 1)
+    for _ in range(params.iterations):
+        state.U = sharded_half_step(state, state.U, state.V, row_dbs, params)
+        state.V = sharded_half_step(state, state.V, state.U, col_dbs, params)
+    return state.U[: data.num_rows], state.V[: data.num_cols]

@@ -1,0 +1,47 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+def make_mesh(axes: Sequence[tuple[str, int]] | None = None):
+    """Build a Mesh from (name, size) axes; one ``-1`` absorbs the rest.
+
+    Default: 1-D ``("data", n_devices)``. Axis sizes must multiply to at
+    most the device count.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if not axes:
+        return Mesh(np.array(devices), ("data",))
+    names = [n for n, _ in axes]
+    sizes = [int(s) for _, s in axes]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1])) or 1
+        inferred = len(devices) // known
+        if inferred == 0:
+            raise ValueError(
+                f"mesh axes {list(zip(names, sizes))}: fixed sizes need "
+                f"{known} devices but only {len(devices)} available"
+            )
+        sizes[sizes.index(-1)] = inferred
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh axes {list(zip(names, sizes))} need {total} devices, "
+            f"have {len(devices)}"
+        )
+    return Mesh(np.array(devices[:total]).reshape(sizes), tuple(names))

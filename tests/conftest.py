@@ -8,12 +8,20 @@ reference's Spark local[4] stand-in for a cluster
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU regardless of the ambient platform: unit tests are specified
+# against the virtual multi-device CPU mesh (TPU runs happen via bench.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# the environment's TPU plugin re-pins jax_platforms at interpreter boot;
+# override it after import so tests really run on the virtual CPU mesh
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,318 @@
+"""ALS op tests: bucketing, solve exactness, convergence, pallas parity,
+and the mesh-sharded path on the virtual 8-device CPU mesh."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from predictionio_tpu.ops import als  # noqa: E402
+from predictionio_tpu.ops.topk import (  # noqa: E402
+    top_k_items,
+    top_k_items_batch,
+    top_k_similar,
+)
+
+
+def synthetic_ratings(num_u=60, num_i=40, rank=4, density=0.3, seed=0, noise=0.0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(num_u, rank)) / np.sqrt(rank)
+    V = rng.normal(size=(num_i, rank)) / np.sqrt(rank)
+    full = U @ V.T
+    mask = rng.random((num_u, num_i)) < density
+    rows, cols = np.nonzero(mask)
+    vals = full[rows, cols] + noise * rng.normal(size=rows.shape)
+    return rows.astype(np.int32), cols.astype(np.int32), vals.astype(np.float32)
+
+
+class TestBucketing:
+    def test_buckets_cover_all_entries(self):
+        rows, cols, vals = synthetic_ratings()
+        buckets = als.build_padded_buckets(rows, cols, vals, bucket_widths=(4, 16, 64))
+        seen = {}
+        for b in buckets:
+            for bi, row in enumerate(b.row_ids):
+                n = int(b.mask[bi].sum())
+                assert n <= b.width
+                for k in range(n):
+                    seen[(int(row), int(b.col_ids[bi, k]))] = float(b.ratings[bi, k])
+        expected = {(int(r), int(c)): float(v) for r, c, v in zip(rows, cols, vals)}
+        assert seen == expected
+
+    def test_row_in_exactly_one_bucket(self):
+        rows, cols, vals = synthetic_ratings()
+        buckets = als.build_padded_buckets(rows, cols, vals, bucket_widths=(4, 16, 64))
+        all_rows = np.concatenate([b.row_ids for b in buckets])
+        assert len(all_rows) == len(np.unique(all_rows)) == len(np.unique(rows))
+
+    def test_oversized_rows_truncate_to_largest_width(self):
+        rows = np.zeros(10, dtype=np.int32)
+        cols = np.arange(10, dtype=np.int32)
+        vals = np.arange(10, dtype=np.float32)  # 0..9, keep the largest 4
+        [bucket] = als.build_padded_buckets(rows, cols, vals, bucket_widths=(2, 4))
+        assert bucket.width == 4
+        assert set(bucket.col_ids[0].tolist()) == {9, 8, 7, 6}
+
+    def test_empty(self):
+        assert als.build_padded_buckets(
+            np.array([], np.int32), np.array([], np.int32), np.array([], np.float32)
+        ) == []
+
+
+class TestSolveExactness:
+    """Batched bucket solve must equal a direct per-row normal-equation
+    solve done in numpy (the 'executor-side Cholesky' ground truth)."""
+
+    def test_explicit_matches_numpy(self):
+        rows, cols, vals = synthetic_ratings(num_u=20, num_i=15)
+        D, reg = 5, 0.1
+        rng = np.random.default_rng(1)
+        V = rng.normal(size=(15, D)).astype(np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 20, 15, bucket_widths=(8, 32))
+
+        U_new = np.zeros((20, D), dtype=np.float32)
+        for b in data.row_buckets:
+            x = als.solve_bucket_explicit(
+                jnp.asarray(V), b.col_ids, b.ratings, b.mask, reg=reg
+            )
+            U_new[b.row_ids] = np.asarray(x)
+
+        for u in range(20):
+            sel = rows == u
+            if not sel.any():
+                continue
+            Vu = V[cols[sel]]
+            A = Vu.T @ Vu + reg * sel.sum() * np.eye(D)
+            b_ = Vu.T @ vals[sel]
+            expect = np.linalg.solve(A, b_)
+            np.testing.assert_allclose(U_new[u], expect, rtol=2e-4, atol=2e-5)
+
+    def test_implicit_matches_numpy(self):
+        rows, cols, vals = synthetic_ratings(num_u=12, num_i=9)
+        vals = np.abs(vals) + 0.1  # implicit counts are positive
+        D, reg, alpha = 4, 0.05, 2.0
+        rng = np.random.default_rng(2)
+        V = rng.normal(size=(9, D)).astype(np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 12, 9, bucket_widths=(16,))
+        gram = np.asarray(als.compute_gram(jnp.asarray(V)))
+
+        U_new = np.zeros((12, D), dtype=np.float32)
+        for b in data.row_buckets:
+            x = als.solve_bucket_implicit(
+                jnp.asarray(V), jnp.asarray(gram), b.col_ids, b.ratings, b.mask,
+                reg=reg, alpha=alpha,
+            )
+            U_new[b.row_ids] = np.asarray(x)
+
+        for u in range(12):
+            sel = rows == u
+            if not sel.any():
+                continue
+            Vu = V[cols[sel]]
+            cm1 = alpha * vals[sel]
+            A = V.T @ V + Vu.T @ (cm1[:, None] * Vu) + reg * np.eye(D)
+            b_ = Vu.T @ (1.0 + cm1)
+            expect = np.linalg.solve(A, b_)
+            np.testing.assert_allclose(U_new[u], expect, rtol=2e-3, atol=2e-4)
+
+    def test_zero_degree_row_solves_to_zero(self):
+        V = jnp.ones((4, 3))
+        x = als.solve_bucket_explicit(
+            V,
+            np.zeros((1, 2), np.int32),
+            np.zeros((1, 2), np.float32),
+            np.zeros((1, 2), np.float32),
+            reg=0.1,
+        )
+        assert np.allclose(np.asarray(x), 0.0)
+        assert not np.isnan(np.asarray(x)).any()
+
+
+class TestTraining:
+    def test_explicit_als_fits_low_rank(self):
+        rows, cols, vals = synthetic_ratings(num_u=80, num_i=50, rank=3, density=0.4)
+        data = als.build_ratings_data(rows, cols, vals, 80, 50, bucket_widths=(8, 32, 64))
+        params = als.ALSParams(rank=6, iterations=12, reg=0.005)
+        U, V = als.als_train(data, params)
+        err = als.rmse(U, V, rows, cols, vals)
+        assert err < 0.06, f"train RMSE {err} too high"
+
+    def test_implicit_als_separates_observed(self):
+        rng = np.random.default_rng(3)
+        # two user groups, each consuming one item group
+        rows, cols, vals = [], [], []
+        for u in range(40):
+            group = u % 2
+            for _ in range(8):
+                i = rng.integers(0, 15) + group * 15
+                rows.append(u)
+                cols.append(i)
+                vals.append(1.0)
+        data = als.build_ratings_data(
+            np.array(rows, np.int32), np.array(cols, np.int32),
+            np.array(vals, np.float32), 40, 30, bucket_widths=(16,),
+        )
+        params = als.ALSParams(rank=4, iterations=8, reg=0.05, implicit=True, alpha=5.0)
+        U, V = als.als_train(data, params)
+        scores = np.asarray(U @ V.T)
+        in_group = np.mean([scores[u, (u % 2) * 15 : (u % 2) * 15 + 15].mean() for u in range(40)])
+        out_group = np.mean([scores[u, (1 - u % 2) * 15 : (1 - u % 2) * 15 + 15].mean() for u in range(40)])
+        assert in_group > out_group + 0.3
+
+    def test_bf16_compute_close_to_f32(self):
+        rows, cols, vals = synthetic_ratings(num_u=40, num_i=30, rank=3, density=0.5)
+        data = als.build_ratings_data(rows, cols, vals, 40, 30, bucket_widths=(32,))
+        f32 = als.als_train(data, als.ALSParams(rank=4, iterations=5, reg=0.01))
+        bf16 = als.als_train(
+            data, als.ALSParams(rank=4, iterations=5, reg=0.01, compute_dtype="bfloat16")
+        )
+        e32 = als.rmse(*f32, rows, cols, vals)
+        e16 = als.rmse(*bf16, rows, cols, vals)
+        assert e16 < max(2.5 * e32, 0.15)
+
+
+class TestPallasParity:
+    def test_gramian_rhs_matches_xla(self):
+        from predictionio_tpu.ops.als_pallas import gramian_rhs_pallas
+
+        rng = np.random.default_rng(4)
+        vg = rng.normal(size=(5, 8, 4)).astype(np.float32)
+        w = rng.random((5, 8)).astype(np.float32)
+        r = rng.random((5, 8)).astype(np.float32)
+        A1, b1 = als._gramian_rhs(jnp.asarray(vg), jnp.asarray(w), jnp.asarray(r))
+        A2, b2 = gramian_rhs_pallas(jnp.asarray(vg), jnp.asarray(w), jnp.asarray(r))
+        np.testing.assert_allclose(np.asarray(A1), np.asarray(A2), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(b1), np.asarray(b2), rtol=1e-5, atol=1e-5)
+
+    def test_full_train_with_pallas_kernel(self):
+        rows, cols, vals = synthetic_ratings(num_u=30, num_i=20, rank=3, density=0.5)
+        data = als.build_ratings_data(rows, cols, vals, 30, 20, bucket_widths=(16,))
+        U, V = als.als_train(
+            data, als.ALSParams(rank=4, iterations=4, reg=0.01, use_pallas=True)
+        )
+        assert als.rmse(U, V, rows, cols, vals) < 0.2
+
+
+class TestTopK:
+    def test_topk_correct(self):
+        V = jnp.asarray(np.diag([1.0, 2.0, 3.0, 4.0]).astype(np.float32))
+        u = jnp.ones(4)
+        scores, ids = top_k_items(u, V, k=2)
+        assert ids.tolist() == [3, 2]
+        assert scores.tolist() == [4.0, 3.0]
+
+    def test_topk_exclusion(self):
+        V = jnp.asarray(np.diag([1.0, 2.0, 3.0, 4.0]).astype(np.float32))
+        u = jnp.ones(4)
+        mask = jnp.asarray([0, 0, 0, 1])
+        _, ids = top_k_items(u, V, k=2, exclude_mask=mask)
+        assert 3 not in ids.tolist()
+
+    def test_topk_batch(self):
+        rng = np.random.default_rng(5)
+        V = jnp.asarray(rng.normal(size=(10, 4)).astype(np.float32))
+        us = jnp.asarray(rng.normal(size=(3, 4)).astype(np.float32))
+        scores, ids = top_k_items_batch(us, V, k=5)
+        full = np.asarray(us @ V.T)
+        for b in range(3):
+            assert ids[b].tolist() == np.argsort(-full[b])[:5].tolist()
+
+    def test_cosine_similar_excludes_self(self):
+        rng = np.random.default_rng(6)
+        V = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+        mask = jnp.zeros(8).at[2].set(1)
+        scores, ids = top_k_similar(V[2], V, k=3, exclude_mask=mask)
+        assert 2 not in ids.tolist()
+        assert (np.asarray(scores) <= 1.0 + 1e-5).all()
+
+
+class TestShardedALS:
+    """Multi-chip path on the virtual 8-device CPU mesh (conftest sets
+    xla_force_host_platform_device_count=8)."""
+
+    @pytest.fixture()
+    def mesh(self):
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        assert len(jax.devices()) == 8, "conftest should provide 8 CPU devices"
+        return make_mesh([("data", 8)])
+
+    def test_sharded_half_step_matches_single(self, mesh):
+        from predictionio_tpu.parallel import als_sharded
+
+        rows, cols, vals = synthetic_ratings(num_u=37, num_i=23, rank=3)
+        data = als.build_ratings_data(rows, cols, vals, 37, 23, bucket_widths=(8, 32))
+        rng = np.random.default_rng(7)
+        D = 4
+        V = rng.normal(size=(23, D)).astype(np.float32)
+
+        # single-device reference
+        U_ref = np.zeros((37, D), np.float32)
+        for b in data.row_buckets:
+            x = als.solve_bucket_explicit(
+                jnp.asarray(V), b.col_ids, b.ratings, b.mask, reg=0.05
+            )
+            U_ref[b.row_ids] = np.asarray(x)
+
+        # sharded: V padded with dummy row to shard evenly
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        v_pad = als_sharded._padded_len(23, 8)
+        V_p = np.zeros((v_pad, D), np.float32)
+        V_p[:23] = V
+        sharding = NamedSharding(mesh, P("data"))
+        V_sh = jax.device_put(V_p, sharding)
+        U_sh = jax.device_put(
+            np.zeros((als_sharded._padded_len(37, 8), D), np.float32), sharding
+        )
+        state = als_sharded.ShardedALSState(
+            mesh=mesh, axis="data", U=U_sh, V=V_sh, num_rows=37, num_cols=23
+        )
+        params = als.ALSParams(rank=D, reg=0.05)
+        row_dbs = als_sharded.upload_buckets(
+            data.row_buckets, mesh, "data", state.U.shape[0] - 1
+        )
+        U_new = als_sharded.sharded_half_step(
+            state, state.U, state.V, row_dbs, params
+        )
+        np.testing.assert_allclose(
+            np.asarray(U_new)[:37], U_ref, rtol=2e-4, atol=2e-5
+        )
+
+    def test_sharded_train_converges(self, mesh):
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+
+        rows, cols, vals = synthetic_ratings(num_u=48, num_i=32, rank=3, density=0.5)
+        data = als.build_ratings_data(rows, cols, vals, 48, 32, bucket_widths=(8, 32))
+        params = als.ALSParams(rank=6, iterations=8, reg=0.005)
+        U, V = sharded_als_train(data, params, mesh)
+        assert U.shape == (48, 6) and V.shape == (32, 6)
+        err = als.rmse(U, V, rows, cols, vals)
+        assert err < 0.08, f"sharded train RMSE {err}"
+
+    def test_sharded_implicit_matches_single_chip(self, mesh):
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+
+        rows, cols, vals = synthetic_ratings(num_u=24, num_i=18, rank=3, density=0.5)
+        vals = np.abs(vals) + 0.5
+        data = als.build_ratings_data(rows, cols, vals, 24, 18, bucket_widths=(16,))
+        params = als.ALSParams(rank=4, iterations=3, reg=0.05, implicit=True, alpha=2.0)
+        U1, V1 = als.als_train(data, params)
+        U8, V8 = sharded_als_train(data, params, mesh)
+        # same seed, same math -> same factors (up to f32 roundoff)
+        np.testing.assert_allclose(np.asarray(U1), np.asarray(U8), rtol=5e-3, atol=5e-4)
+        np.testing.assert_allclose(np.asarray(V1), np.asarray(V8), rtol=5e-3, atol=5e-4)
+
+    def test_sharded_implicit_runs(self, mesh):
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+
+        rows, cols, vals = synthetic_ratings(num_u=32, num_i=24, rank=3, density=0.4)
+        vals = np.abs(vals) + 0.5
+        data = als.build_ratings_data(rows, cols, vals, 32, 24, bucket_widths=(16,))
+        params = als.ALSParams(rank=4, iterations=3, reg=0.05, implicit=True, alpha=2.0)
+        U, V = sharded_als_train(data, params, mesh)
+        assert not np.isnan(np.asarray(U)).any()
+        assert not np.isnan(np.asarray(V)).any()

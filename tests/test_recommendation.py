@@ -1,0 +1,154 @@
+"""End-to-end recommendation template test: events -> train -> persist ->
+deploy -> predict (the QuickStartTest lifecycle of the reference,
+tests/pio_tests/scenarios/quickstart_test.py:50-105, minus HTTP)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineParams, WorkflowContext
+from predictionio_tpu.core.workflow import prepare_deploy, run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models import recommendation as rec
+
+CTX = WorkflowContext(mode="Test")
+
+
+@pytest.fixture()
+def seeded_app(storage):
+    apps = storage.get_metadata_apps()
+    app_id = apps.insert(App(0, "RecApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    # 30 users x 20 items; user u likes items with same parity
+    for u in range(30):
+        for _ in range(10):
+            i = int(rng.integers(0, 10)) * 2 + (u % 2)
+            rating = 5.0 if (i % 2) == (u % 2) else 1.0
+            events.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties={"rating": rating},
+                ),
+                app_id,
+            )
+    # a few buy events (implicit 4.0)
+    for u in range(5):
+        events.insert(
+            Event(
+                event="buy",
+                entity_type="user",
+                entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{u % 2}",
+            ),
+            app_id,
+        )
+    return storage
+
+
+def make_ep(**algo_kw):
+    defaults = dict(rank=8, num_iterations=8, lambda_=0.05)
+    defaults.update(algo_kw)
+    return EngineParams(
+        datasource=("", rec.DataSourceParams(app_name="RecApp")),
+        algorithms=[("als", rec.ALSAlgorithmParams(**defaults))],
+    )
+
+
+class TestDataSource:
+    def test_reads_rate_and_buy(self, seeded_app):
+        ds = rec.RecommendationDataSource(rec.DataSourceParams(app_name="RecApp"))
+        td = ds.read_training(CTX)
+        assert len(td.ratings) == 305
+        assert 4.0 in td.ratings  # buy mapped to 4.0
+        td.sanity_check()
+
+    def test_sanity_check_empty(self, storage):
+        storage.get_metadata_apps().insert(App(0, "EmptyApp"))
+        ds = rec.RecommendationDataSource(rec.DataSourceParams(app_name="EmptyApp"))
+        td = ds.read_training(CTX)
+        with pytest.raises(ValueError):
+            td.sanity_check()
+
+
+class TestTrainPredict:
+    def test_full_lifecycle(self, seeded_app):
+        engine = rec.engine()
+        instance_id = run_train(
+            engine,
+            make_ep(),
+            engine_id="rec",
+            engine_factory="predictionio_tpu.models.recommendation.engine",
+            storage=seeded_app,
+        )
+        inst = seeded_app.get_metadata_engine_instances().get_latest_completed(
+            "rec", "0", "default"
+        )
+        assert inst.id == instance_id
+
+        _, algos, models, serving = prepare_deploy(engine, inst, storage=seeded_app)
+        [algo], [model] = algos, models
+        assert isinstance(model, rec.ALSModel)
+
+        q = rec.Query(user="u0", num=4)
+        result = serving.serve(q, [algo.predict(model, q)])
+        assert len(result.itemScores) == 4
+        # preference structure recovered: even user ranks even items on top
+        top = result.itemScores[0]
+        assert int(top.item[1:]) % 2 == 0
+        # scores sorted descending
+        scores = [s.score for s in result.itemScores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unseen_user_empty_result(self, seeded_app):
+        engine = rec.engine()
+        algo = rec.ALSAlgorithm(rec.ALSAlgorithmParams(rank=4, num_iterations=2))
+        td = rec.RecommendationDataSource(
+            rec.DataSourceParams(app_name="RecApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        assert algo.predict(model, rec.Query(user="stranger")).itemScores == []
+
+    def test_batch_predict_matches_single(self, seeded_app):
+        algo = rec.ALSAlgorithm(rec.ALSAlgorithmParams(rank=4, num_iterations=3))
+        td = rec.RecommendationDataSource(
+            rec.DataSourceParams(app_name="RecApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        queries = [(0, rec.Query("u1", 3)), (1, rec.Query("nope", 2)), (2, rec.Query("u2", 3))]
+        batch = dict(algo.batch_predict(model, queries))
+        assert batch[1].itemScores == []
+        for ix, q in [(0, queries[0][1]), (2, queries[2][1])]:
+            single = algo.predict(model, q)
+            assert [s.item for s in batch[ix].itemScores] == [
+                s.item for s in single.itemScores
+            ]
+
+    def test_eval_folds(self, seeded_app):
+        engine = rec.engine()
+        results = engine.eval(CTX, make_ep(num_iterations=2, rank=4))
+        assert len(results) == 3
+        total = sum(len(served) for _, served in results)
+        assert total == 305  # every rating lands in exactly one fold
+
+    def test_model_pickles_and_predicts_after_restore(self, seeded_app):
+        import pickle
+
+        algo = rec.ALSAlgorithm(rec.ALSAlgorithmParams(rank=4, num_iterations=2))
+        td = rec.RecommendationDataSource(
+            rec.DataSourceParams(app_name="RecApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        _ = model.device_factors()  # materialize device cache, must not pickle
+        restored = pickle.loads(pickle.dumps(model))
+        r1 = algo.predict(model, rec.Query("u3", 3))
+        r2 = algo.predict(restored, rec.Query("u3", 3))
+        assert [s.item for s in r1.itemScores] == [s.item for s in r2.itemScores]
