@@ -1,0 +1,288 @@
+"""CLI command implementations (reference tools/.../commands/*.scala).
+
+The ``pio`` verbs call these; the admin server reuses the app commands
+(reference admin/CommandClient.scala wraps the same logic).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    Channel,
+    Storage,
+    get_storage,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class CommandError(RuntimeError):
+    pass
+
+
+# -- app commands (commands/App.scala) --------------------------------------
+
+
+def app_new(
+    name: str,
+    app_id: int = 0,
+    description: str | None = None,
+    access_key: str = "",
+    storage: Storage | None = None,
+) -> dict[str, Any]:
+    storage = storage or get_storage()
+    apps = storage.get_metadata_apps()
+    if apps.get_by_name(name) is not None:
+        raise CommandError(f"App {name} already exists. Aborting.")
+    new_id = apps.insert(App(app_id, name, description))
+    if new_id is None:
+        raise CommandError(f"Unable to create new app (id {app_id} taken?).")
+    storage.get_events().init(new_id)
+    key = storage.get_metadata_access_keys().insert(
+        AccessKey(access_key, appid=new_id)
+    )
+    if key is None:
+        raise CommandError("Unable to create new access key.")
+    return {"id": new_id, "name": name, "access_key": key}
+
+
+def app_list(storage: Storage | None = None) -> list[dict[str, Any]]:
+    storage = storage or get_storage()
+    keys = storage.get_metadata_access_keys()
+    out = []
+    for app in storage.get_metadata_apps().get_all():
+        app_keys = keys.get_by_appid(app.id)
+        out.append(
+            {
+                "id": app.id,
+                "name": app.name,
+                "description": app.description,
+                "access_key": app_keys[0].key if app_keys else "",
+            }
+        )
+    return out
+
+
+def app_show(name: str, storage: Storage | None = None) -> dict[str, Any]:
+    storage = storage or get_storage()
+    app = storage.get_metadata_apps().get_by_name(name)
+    if app is None:
+        raise CommandError(f"App {name} does not exist. Aborting.")
+    keys = storage.get_metadata_access_keys().get_by_appid(app.id)
+    channels = storage.get_metadata_channels().get_by_appid(app.id)
+    return {
+        "id": app.id,
+        "name": app.name,
+        "description": app.description,
+        "access_keys": [{"key": k.key, "events": k.events} for k in keys],
+        "channels": [{"id": c.id, "name": c.name} for c in channels],
+    }
+
+
+def app_delete(name: str, storage: Storage | None = None) -> None:
+    storage = storage or get_storage()
+    app = storage.get_metadata_apps().get_by_name(name)
+    if app is None:
+        raise CommandError(f"App {name} does not exist. Aborting.")
+    events = storage.get_events()
+    for ch in storage.get_metadata_channels().get_by_appid(app.id):
+        events.remove(app.id, ch.id)
+        storage.get_metadata_channels().delete(ch.id)
+    events.remove(app.id)
+    for key in storage.get_metadata_access_keys().get_by_appid(app.id):
+        storage.get_metadata_access_keys().delete(key.key)
+    storage.get_metadata_apps().delete(app.id)
+
+
+def app_data_delete(
+    name: str, channel: str | None = None, storage: Storage | None = None
+) -> None:
+    storage = storage or get_storage()
+    app = storage.get_metadata_apps().get_by_name(name)
+    if app is None:
+        raise CommandError(f"App {name} does not exist. Aborting.")
+    events = storage.get_events()
+    if channel is None:
+        events.remove(app.id)
+        events.init(app.id)
+        return
+    chans = [
+        c
+        for c in storage.get_metadata_channels().get_by_appid(app.id)
+        if c.name == channel
+    ]
+    if not chans:
+        raise CommandError(f"Channel {channel} does not exist. Aborting.")
+    events.remove(app.id, chans[0].id)
+    events.init(app.id, chans[0].id)
+
+
+def channel_new(
+    app_name: str, channel_name: str, storage: Storage | None = None
+) -> dict[str, Any]:
+    storage = storage or get_storage()
+    app = storage.get_metadata_apps().get_by_name(app_name)
+    if app is None:
+        raise CommandError(f"App {app_name} does not exist. Aborting.")
+    if not Channel.is_valid_name(channel_name):
+        raise CommandError(
+            f"Unable to create new channel. The channel name {channel_name} is "
+            "invalid (1-16 alphanumeric or '-' characters)."
+        )
+    channel_id = storage.get_metadata_channels().insert(
+        Channel(0, channel_name, app.id)
+    )
+    if channel_id is None:
+        raise CommandError(f"Channel {channel_name} already exists. Aborting.")
+    storage.get_events().init(app.id, channel_id)
+    return {"id": channel_id, "name": channel_name, "app_id": app.id}
+
+
+def channel_delete(
+    app_name: str, channel_name: str, storage: Storage | None = None
+) -> None:
+    storage = storage or get_storage()
+    app = storage.get_metadata_apps().get_by_name(app_name)
+    if app is None:
+        raise CommandError(f"App {app_name} does not exist. Aborting.")
+    chans = [
+        c
+        for c in storage.get_metadata_channels().get_by_appid(app.id)
+        if c.name == channel_name
+    ]
+    if not chans:
+        raise CommandError(f"Channel {channel_name} does not exist. Aborting.")
+    storage.get_events().remove(app.id, chans[0].id)
+    storage.get_metadata_channels().delete(chans[0].id)
+
+
+# -- access key commands (commands/AccessKey.scala) -------------------------
+
+
+def accesskey_new(
+    app_name: str,
+    key: str = "",
+    events: list[str] | None = None,
+    storage: Storage | None = None,
+) -> str:
+    storage = storage or get_storage()
+    app = storage.get_metadata_apps().get_by_name(app_name)
+    if app is None:
+        raise CommandError(f"App {app_name} does not exist. Aborting.")
+    created = storage.get_metadata_access_keys().insert(
+        AccessKey(key, appid=app.id, events=list(events or []))
+    )
+    if created is None:
+        raise CommandError("Unable to create new access key.")
+    return created
+
+
+def accesskey_list(
+    app_name: str | None = None, storage: Storage | None = None
+) -> list[dict[str, Any]]:
+    storage = storage or get_storage()
+    keys = storage.get_metadata_access_keys()
+    if app_name is None:
+        all_keys = keys.get_all()
+    else:
+        app = storage.get_metadata_apps().get_by_name(app_name)
+        if app is None:
+            raise CommandError(f"App {app_name} does not exist. Aborting.")
+        all_keys = keys.get_by_appid(app.id)
+    return [{"key": k.key, "app_id": k.appid, "events": k.events} for k in all_keys]
+
+
+def accesskey_delete(key: str, storage: Storage | None = None) -> None:
+    storage = storage or get_storage()
+    if not storage.get_metadata_access_keys().delete(key):
+        raise CommandError(f"Access key {key} does not exist. Aborting.")
+
+
+def _resolve_app_name(appid_or_name: str, storage: Storage) -> str:
+    """Accept an app name or a numeric app id (reference --appid flag)."""
+    apps = storage.get_metadata_apps()
+    if apps.get_by_name(appid_or_name) is not None:
+        return appid_or_name
+    if appid_or_name.isdigit():
+        app = apps.get(int(appid_or_name))
+        if app is not None:
+            return app.name
+    raise CommandError(f"App {appid_or_name} does not exist. Aborting.")
+
+
+# -- export / import (tools/export/EventsToFile.scala, imprt/FileToEvents) --
+
+
+def export_events(
+    app_name: str,
+    output_path: str,
+    channel: str | None = None,
+    storage: Storage | None = None,
+) -> int:
+    """Dump an app's events as JSON-lines (one event per line)."""
+    from predictionio_tpu.data import store
+
+    storage = storage or get_storage()
+    app_name = _resolve_app_name(app_name, storage)
+    events = store.find(app_name, channel_name=channel, storage=storage)
+    with open(output_path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e.to_dict(for_api=False), sort_keys=True) + "\n")
+    return len(events)
+
+
+def import_events(
+    app_name: str,
+    input_path: str,
+    channel: str | None = None,
+    storage: Storage | None = None,
+) -> int:
+    from predictionio_tpu.data import store
+    from predictionio_tpu.data.event import validate
+
+    storage = storage or get_storage()
+    app_name = _resolve_app_name(app_name, storage)
+    app_id, channel_id = store.app_name_to_id(app_name, channel, storage)
+    count = 0
+    batch: list[Event] = []
+    with open(input_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            event = Event.from_dict(json.loads(line))
+            validate(event)
+            batch.append(event)
+            if len(batch) >= 500:
+                storage.get_events().batch_insert(batch, app_id, channel_id)
+                count += len(batch)
+                batch = []
+    if batch:
+        storage.get_events().batch_insert(batch, app_id, channel_id)
+        count += len(batch)
+    return count
+
+
+# -- status (commands/Management.scala:56-160) ------------------------------
+
+
+def status(storage: Storage | None = None) -> dict[str, Any]:
+    storage = storage or get_storage()
+    storage.verify_all_data_objects()
+    import jax
+
+    repos = {}
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        name, typ = storage.repository_source(repo)
+        repos[repo] = {"source": name, "type": typ}
+    return {
+        "storage": repos,
+        "devices": [str(d) for d in jax.devices()],
+        "default_backend": jax.default_backend(),
+    }

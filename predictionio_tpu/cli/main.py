@@ -1,46 +1,411 @@
-"""`pio` CLI entry point (reference tools/.../console/Console.scala:78).
+"""`pio` command-line interface.
 
-Verbs land here incrementally; unknown verbs print usage and exit 1.
+Capability parity with the reference console
+(tools/.../console/Console.scala:37-768): the full verb set — app /
+accesskey / channel management, train, deploy, undeploy, eval,
+eventserver, adminserver, dashboard, export, import, status, version,
+build (a no-op syntax check here: Python engines need no sbt assembly).
+
+The reference forks spark-submit JVMs per verb (Runner.scala:185-308);
+here drivers run in-process — the process boundary that mattered (CLI vs
+long-running servers) is kept: ``deploy``/``eventserver`` stay in the
+foreground unless backgrounded by the caller.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import sys
 
+from predictionio_tpu import __version__
 
-USAGE = """pio <command> [options]
 
-Commands (TPU-native PredictionIO):
-  status                     check storage configuration
-  version                    print version
+def _engine_from_args(args) -> tuple:
+    """Resolve (engine, variant dict, factory name) from --engine-factory /
+    --variant (engine.json)."""
+    from predictionio_tpu.core.engine import resolve_engine_factory
+    from predictionio_tpu.core.workflow import load_variant
 
-Run 'pio <command> --help' for command help."""
+    variant: dict = {}
+    if getattr(args, "variant", None):
+        variant = load_variant(args.variant)
+    factory = getattr(args, "engine_factory", None) or variant.get("engineFactory")
+    if not factory:
+        raise SystemExit(
+            "error: specify --engine-factory dotted.path or a --variant JSON "
+            "with an engineFactory field"
+        )
+    engine = resolve_engine_factory(factory)
+    return engine, variant, factory
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def cmd_status(args) -> int:
+    from predictionio_tpu.cli import commands
+
+    info = commands.status()
+    print(json.dumps(info, indent=2))
+    print("(sanity check) All storage repositories verified.")
+    return 0
+
+
+def cmd_build(args) -> int:
+    """Python engines need no assembly; verify the factory imports."""
+    if getattr(args, "engine_factory", None) or getattr(args, "variant", None):
+        _engine_from_args(args)
+        print("Engine factory resolves; build OK.")
+    else:
+        print("Nothing to build for Python engines; use --engine-factory to verify.")
+    return 0
+
+
+def cmd_app(args) -> int:
+    from predictionio_tpu.cli import commands
+
+    try:
+        if args.app_command == "new":
+            info = commands.app_new(
+                args.name, app_id=args.id or 0, description=args.description,
+                access_key=args.access_key or "",
+            )
+            print(f"Created a new app:")
+            print(f"      Name: {info['name']}")
+            print(f"        ID: {info['id']}")
+            print(f"Access Key: {info['access_key']}")
+        elif args.app_command == "list":
+            for a in commands.app_list():
+                print(f"{a['id']:>6} | {a['name']} | {a['access_key']}")
+        elif args.app_command == "show":
+            info = commands.app_show(args.name)
+            print(json.dumps(info, indent=2))
+        elif args.app_command == "delete":
+            commands.app_delete(args.name)
+            print(f"Deleted app {args.name}.")
+        elif args.app_command == "data-delete":
+            commands.app_data_delete(args.name, channel=args.channel)
+            print(f"Deleted data of app {args.name}.")
+        elif args.app_command == "channel-new":
+            info = commands.channel_new(args.name, args.channel)
+            print(f"Created channel {info['name']} (id {info['id']}).")
+        elif args.app_command == "channel-delete":
+            commands.channel_delete(args.name, args.channel)
+            print(f"Deleted channel {args.channel}.")
+        else:
+            print(
+                "usage: pio app "
+                "{new,list,show,delete,data-delete,channel-new,channel-delete}",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    except commands.CommandError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+
+
+def cmd_accesskey(args) -> int:
+    from predictionio_tpu.cli import commands
+
+    try:
+        if args.ak_command == "new":
+            key = commands.accesskey_new(args.app_name, events=args.event or [])
+            print(f"Created new access key: {key}")
+        elif args.ak_command == "list":
+            for k in commands.accesskey_list(args.app_name):
+                print(f"{k['key']} | app {k['app_id']} | events {k['events'] or 'ALL'}")
+        elif args.ak_command == "delete":
+            commands.accesskey_delete(args.key)
+            print(f"Deleted access key {args.key}.")
+        else:
+            print("usage: pio accesskey {new,list,delete}", file=sys.stderr)
+            return 1
+        return 0
+    except commands.CommandError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+
+    engine, variant, factory = _engine_from_args(args)
+    engine_params = engine.params_from_variant(variant)
+    wp = WorkflowParams(
+        batch=args.batch or "",
+        verbose=args.verbose,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    instance_id = run_train(
+        engine,
+        engine_params,
+        engine_id=variant.get("id", "default"),
+        engine_version=variant.get("version", "0"),
+        engine_variant=args.variant or "default",
+        engine_factory=factory,
+        workflow_params=wp,
+    )
+    print(f"Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.core.workflow_eval import run_evaluation
+
+    instance_id, result = run_evaluation(
+        evaluation_class=args.evaluation_class,
+        engine_params_generator_class=args.engine_params_generator_class,
+        batch=args.batch or "",
+    )
+    print(result.to_one_liner())
+    print(f"Evaluation completed. Evaluation instance ID: {instance_id}")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    engine, variant, factory = _engine_from_args(args)
+    storage = get_storage()
+    instances = storage.get_metadata_engine_instances()
+    if args.engine_instance_id:
+        instance = instances.get(args.engine_instance_id)
+        if instance is None:
+            print(f"engine instance {args.engine_instance_id} not found", file=sys.stderr)
+            return 1
+    else:
+        instance = instances.get_latest_completed(
+            variant.get("id", "default"),
+            variant.get("version", "0"),
+            args.variant or "default",
+        )
+        if instance is None:
+            print(
+                "No valid engine instance found for this engine; "
+                "have you run `pio train` yet?",
+                file=sys.stderr,
+            )
+            return 1
+    server = EngineServer(
+        engine,
+        instance,
+        storage=storage,
+        host=args.ip,
+        port=args.port,
+        feedback=args.feedback,
+        event_server_url=(
+            f"http://{args.event_server_ip}:{args.event_server_port}"
+            if args.feedback
+            else None
+        ),
+        access_key=args.accesskey,
+    )
+    # foreground, like the reference: backgrounding is the caller's job
+    # (shell &, supervisor); a daemon thread would die with this process
+    server.start(background=False)
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        urllib.request.urlopen(urllib.request.Request(url, data=b""), timeout=10)
+        print("Undeployed.")
+        return 0
+    except Exception as e:
+        print(f"undeploy failed: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.server.event_server import EventServer
+
+    server = EventServer(host=args.ip, port=args.port, stats=args.stats)
+    server.start(background=False)
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.server.admin_server import AdminServer
+
+    AdminServer(host=args.ip, port=args.port).start(background=False)
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.server.dashboard import Dashboard
+
+    Dashboard(host=args.ip, port=args.port).start(background=False)
+    return 0
+
+
+def cmd_export(args) -> int:
+    from predictionio_tpu.cli import commands
+    from predictionio_tpu.data.store import EventStoreError
+
+    try:
+        n = commands.export_events(
+            args.appid_or_name, args.output, channel=args.channel
+        )
+    except (commands.CommandError, EventStoreError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(f"Exported {n} events to {args.output}.")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from predictionio_tpu.cli import commands
+    from predictionio_tpu.data.store import EventStoreError
+
+    try:
+        n = commands.import_events(args.appid_or_name, args.input, channel=args.channel)
+    except (commands.CommandError, EventStoreError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(f"Imported {n} events.")
+    return 0
+
+
+def cmd_template(args) -> int:
+    # deprecated no-op in the reference too (Console.scala template verbs)
+    print(
+        "The template command is deprecated; engine templates are Python "
+        "packages — copy one from predictionio_tpu.models as a starting point."
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pio", description="PredictionIO-TPU console")
+    sub = p.add_subparsers(dest="command")
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    b = sub.add_parser("build")
+    b.add_argument("--engine-factory")
+    b.add_argument("--variant")
+    b.set_defaults(fn=cmd_build)
+
+    a = sub.add_parser("app")
+    asub = a.add_subparsers(dest="app_command")
+    for name in ("new", "show", "delete", "data-delete"):
+        ap = asub.add_parser(name)
+        ap.add_argument("name")
+        if name == "new":
+            ap.add_argument("--id", type=int, default=0)
+            ap.add_argument("--description")
+            ap.add_argument("--access-key", default="")
+        if name == "data-delete":
+            ap.add_argument("--channel")
+    asub.add_parser("list")
+    for name in ("channel-new", "channel-delete"):
+        cp = asub.add_parser(name)
+        cp.add_argument("name")
+        cp.add_argument("channel")
+    a.set_defaults(fn=cmd_app)
+
+    ak = sub.add_parser("accesskey")
+    aksub = ak.add_subparsers(dest="ak_command")
+    akn = aksub.add_parser("new")
+    akn.add_argument("app_name")
+    akn.add_argument("--event", action="append")
+    akl = aksub.add_parser("list")
+    akl.add_argument("app_name", nargs="?")
+    akd = aksub.add_parser("delete")
+    akd.add_argument("key")
+    ak.set_defaults(fn=cmd_accesskey)
+
+    t = sub.add_parser("train")
+    t.add_argument("--engine-factory")
+    t.add_argument("--variant")
+    t.add_argument("--batch", default="")
+    t.add_argument("--verbose", action="count", default=0)
+    t.add_argument("--skip-sanity-check", action="store_true")
+    t.add_argument("--stop-after-read", action="store_true")
+    t.add_argument("--stop-after-prepare", action="store_true")
+    t.set_defaults(fn=cmd_train)
+
+    ev = sub.add_parser("eval")
+    ev.add_argument("evaluation_class")
+    ev.add_argument("engine_params_generator_class", nargs="?")
+    ev.add_argument("--batch", default="")
+    ev.set_defaults(fn=cmd_eval)
+
+    d = sub.add_parser("deploy")
+    d.add_argument("--engine-factory")
+    d.add_argument("--variant")
+    d.add_argument("--engine-instance-id")
+    d.add_argument("--ip", default="0.0.0.0")
+    d.add_argument("--port", type=int, default=8000)
+    d.add_argument("--feedback", action="store_true")
+    d.add_argument("--event-server-ip", default="0.0.0.0")
+    d.add_argument("--event-server-port", type=int, default=7070)
+    d.add_argument("--accesskey")
+    d.set_defaults(fn=cmd_deploy)
+
+    u = sub.add_parser("undeploy")
+    u.add_argument("--ip", default="0.0.0.0")
+    u.add_argument("--port", type=int, default=8000)
+    u.set_defaults(fn=cmd_undeploy)
+
+    es = sub.add_parser("eventserver")
+    es.add_argument("--ip", default="0.0.0.0")
+    es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--stats", action="store_true")
+    es.set_defaults(fn=cmd_eventserver)
+
+    ad = sub.add_parser("adminserver")
+    ad.add_argument("--ip", default="0.0.0.0")
+    ad.add_argument("--port", type=int, default=7071)
+    ad.set_defaults(fn=cmd_adminserver)
+
+    db = sub.add_parser("dashboard")
+    db.add_argument("--ip", default="0.0.0.0")
+    db.add_argument("--port", type=int, default=9000)
+    db.set_defaults(fn=cmd_dashboard)
+
+    ex = sub.add_parser("export")
+    ex.add_argument("--appid-or-name", required=True)
+    ex.add_argument("--output", required=True)
+    ex.add_argument("--channel")
+    ex.set_defaults(fn=cmd_export)
+
+    im = sub.add_parser("import")
+    im.add_argument("--appid-or-name", required=True)
+    im.add_argument("--input", required=True)
+    im.add_argument("--channel")
+    im.set_defaults(fn=cmd_import)
+
+    tpl = sub.add_parser("template")
+    tpl.add_argument("rest", nargs="*")
+    tpl.set_defaults(fn=cmd_template)
+
+    return p
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = sys.argv[1:] if argv is None else list(argv)
-    if not args or args[0] in ("help", "--help", "-h"):
-        print(USAGE)
+    parser = build_parser()
+    raw = sys.argv[1:] if argv is None else list(argv)
+    if raw[:1] == ["help"]:
+        parser.print_help()
         return 0
-    verb = args[0]
-    if verb == "version":
-        from predictionio_tpu import __version__
-
-        print(__version__)
-        return 0
-    if verb == "status":
-        from predictionio_tpu.data.storage import REPOSITORIES, get_storage
-
-        storage = get_storage()
-        storage.verify_all_data_objects()
-        for repo in REPOSITORIES:
-            name, typ = storage.repository_source(repo)
-            print(f"{repo}: source={name} type={typ}")
-        print("(sanity check) All storage repositories verified.")
-        return 0
-    print(f"pio: unknown command {verb!r}", file=sys.stderr)
-    print(USAGE, file=sys.stderr)
-    return 1
+    args = parser.parse_args(raw)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 1
+    return args.fn(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,190 @@
+"""MetricEvaluator + Evaluation: tuning sweeps over EngineParams.
+
+Capability parity with the reference evaluation layer
+(core/.../controller/MetricEvaluator.scala:64-263, Evaluation.scala,
+EngineParamsGenerator.scala): score every candidate EngineParams with a
+primary metric (+ optional side metrics), pick the best by the metric's
+ordering, optionally write ``best.json`` with the winning params, and
+render one-liner / HTML / JSON result views persisted on the
+EvaluationInstance.
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from predictionio_tpu.core.context import WorkflowContext
+from predictionio_tpu.core.engine import Engine, WorkflowParams
+from predictionio_tpu.core.metrics import Metric
+from predictionio_tpu.core.params import EngineParams, EngineParamsGenerator
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class MetricScores:
+    score: float
+    other_scores: list[float] = field(default_factory=list)
+
+
+@dataclass
+class MetricEvaluatorResult:
+    best_score: MetricScores
+    best_engine_params: EngineParams
+    best_idx: int
+    metric_header: str
+    other_metric_headers: list[str]
+    engine_params_scores: list[tuple[EngineParams, MetricScores]]
+
+    def to_one_liner(self) -> str:
+        return f"[{self.best_score.score:.4f}] {self.metric_header}"
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "bestScore": self.best_score.score,
+                "bestIndex": self.best_idx,
+                "metricHeader": self.metric_header,
+                "otherMetricHeaders": self.other_metric_headers,
+                "bestEngineParams": self.best_engine_params.to_jsonable(),
+                "scores": [
+                    {
+                        "engineParams": ep.to_jsonable(),
+                        "score": ms.score,
+                        "otherScores": ms.other_scores,
+                    }
+                    for ep, ms in self.engine_params_scores
+                ],
+            },
+            sort_keys=True,
+        )
+
+    def to_html(self) -> str:
+        rows = "".join(
+            f"<tr><td>{i}</td><td>{ms.score:.6f}</td>"
+            f"<td>{[round(s, 6) for s in ms.other_scores]}</td>"
+            f"<td><pre>{html_mod.escape(json.dumps(ep.to_jsonable(), indent=2))}"
+            f"</pre></td></tr>"
+            for i, (ep, ms) in enumerate(self.engine_params_scores)
+        )
+        return (
+            f"<html><body><h1>Evaluation: {html_mod.escape(self.metric_header)}</h1>"
+            f"<p>Best score: {self.best_score.score:.6f} "
+            f"(candidate #{self.best_idx})</p>"
+            f"<table border='1'><tr><th>#</th><th>{self.metric_header}</th>"
+            f"<th>{self.other_metric_headers}</th><th>Params</th></tr>"
+            f"{rows}</table></body></html>"
+        )
+
+
+class MetricEvaluator:
+    """Evaluates each candidate and selects the best
+    (MetricEvaluator.evaluateBase, MetricEvaluator.scala:218-260)."""
+
+    def __init__(
+        self,
+        metric: Metric,
+        other_metrics: Sequence[Metric] = (),
+        output_path: str | None = None,
+    ):
+        self.metric = metric
+        self.other_metrics = list(other_metrics)
+        self.output_path = output_path
+
+    def evaluate(
+        self,
+        ctx: WorkflowContext,
+        engine: Engine,
+        engine_params_list: Sequence[EngineParams],
+        workflow_params: WorkflowParams | None = None,
+    ) -> MetricEvaluatorResult:
+        if not engine_params_list:
+            raise ValueError("engine_params_list must not be empty")
+        scores: list[tuple[EngineParams, MetricScores]] = []
+        for i, ep in enumerate(engine_params_list):
+            eval_data = engine.eval(ctx, ep, workflow_params)
+            ms = MetricScores(
+                score=self.metric.calculate(eval_data),
+                other_scores=[m.calculate(eval_data) for m in self.other_metrics],
+            )
+            logger.info(
+                "candidate %d/%d: %s = %s",
+                i + 1,
+                len(engine_params_list),
+                self.metric.header,
+                ms.score,
+            )
+            scores.append((ep, ms))
+
+        best_idx = 0
+        for i in range(1, len(scores)):
+            if self.metric.compare(scores[i][1].score, scores[best_idx][1].score) > 0:
+                best_idx = i
+        best_ep, best_ms = scores[best_idx]
+        result = MetricEvaluatorResult(
+            best_score=best_ms,
+            best_engine_params=best_ep,
+            best_idx=best_idx,
+            metric_header=self.metric.header,
+            other_metric_headers=[m.header for m in self.other_metrics],
+            engine_params_scores=scores,
+        )
+        if self.output_path:
+            self.save_engine_json(result, self.output_path)
+        return result
+
+    def save_engine_json(self, result: MetricEvaluatorResult, path: str) -> None:
+        """Write the best params as an engine-variant JSON (the reference's
+        best.json via saveEngineJson, MetricEvaluator.scala:185-216)."""
+        ep = result.best_engine_params
+        variant = {
+            "datasource": {"name": ep.datasource[0], "params": ep.datasource[1].to_dict()},
+            "preparator": {"name": ep.preparator[0], "params": ep.preparator[1].to_dict()},
+            "algorithms": [
+                {"name": name, "params": params.to_dict()}
+                for name, params in ep.algorithms
+            ],
+            "serving": {"name": ep.serving[0], "params": ep.serving[1].to_dict()},
+        }
+        with open(path, "w") as f:
+            json.dump(variant, f, indent=2, sort_keys=True)
+        logger.info("best engine params written to %s", path)
+
+
+class Evaluation:
+    """Binds an engine to an evaluator for `pio eval`
+    (reference controller/Evaluation.scala; ``engine_metric`` wraps a bare
+    Metric in a MetricEvaluator exactly like ``engineMetric_=``)."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        metric: Metric | None = None,
+        evaluator: MetricEvaluator | None = None,
+        engine_params_generator: EngineParamsGenerator | None = None,
+    ):
+        if evaluator is None and metric is None:
+            raise ValueError("Evaluation needs a metric or an evaluator")
+        self.engine = engine
+        self.evaluator = evaluator or MetricEvaluator(metric)
+        self.engine_params_generator = engine_params_generator
+
+    def run(
+        self,
+        ctx: WorkflowContext,
+        engine_params_list: Sequence[EngineParams] | None = None,
+        workflow_params: WorkflowParams | None = None,
+    ) -> MetricEvaluatorResult:
+        if engine_params_list is None:
+            if self.engine_params_generator is None:
+                raise ValueError(
+                    "no engine_params_list given and no generator configured"
+                )
+            engine_params_list = self.engine_params_generator.engine_params_list
+        return self.evaluator.evaluate(
+            ctx, self.engine, engine_params_list, workflow_params
+        )

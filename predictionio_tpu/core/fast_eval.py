@@ -1,0 +1,119 @@
+"""FastEvalEngine: prefix-memoizing evaluation over parameter sweeps.
+
+Capability parity with the reference FastEvalEngine
+(core/.../controller/FastEvalEngine.scala:46-346): during a sweep, many
+candidates share pipeline prefixes (same datasource params -> same eval
+sets; same +preparator -> same prepared data; same +algorithms -> same
+models and batch predictions). The workflow caches each prefix so shared
+stages compute once across candidates.
+
+Cache keys mirror the reference's DataSourcePrefix / PreparatorPrefix /
+AlgorithmsPrefix / ServingPrefix (:46-160), keyed on params JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Sequence
+
+from predictionio_tpu.core.context import WorkflowContext
+from predictionio_tpu.core.engine import Engine, WorkflowParams
+from predictionio_tpu.core.params import EngineParams, Params
+
+logger = logging.getLogger(__name__)
+
+
+def _key(*pairs: tuple[str, Params]) -> str:
+    return json.dumps(
+        [[name, params.to_dict()] for name, params in pairs], sort_keys=True
+    )
+
+
+class FastEvalEngineWorkflow:
+    """Holds the prefix caches for one sweep (reference
+    FastEvalEngineWorkflow, :46-310)."""
+
+    def __init__(self, engine: Engine, ctx: WorkflowContext):
+        self.engine = engine
+        self.ctx = ctx
+        self.datasource_cache: dict[str, Any] = {}
+        self.preparator_cache: dict[str, Any] = {}
+        self.algorithms_cache: dict[str, Any] = {}
+        self.hits = {"datasource": 0, "preparator": 0, "algorithms": 0}
+        self.misses = {"datasource": 0, "preparator": 0, "algorithms": 0}
+
+    def _eval_sets(self, ep: EngineParams):
+        key = _key(ep.datasource)
+        if key not in self.datasource_cache:
+            self.misses["datasource"] += 1
+            datasource = self.engine.make_datasource(ep)
+            self.datasource_cache[key] = datasource.read_eval(self.ctx)
+        else:
+            self.hits["datasource"] += 1
+        return key, self.datasource_cache[key]
+
+    def _prepared(self, ep: EngineParams):
+        ds_key, eval_sets = self._eval_sets(ep)
+        key = ds_key + "|" + _key(ep.preparator)
+        if key not in self.preparator_cache:
+            self.misses["preparator"] += 1
+            preparator = self.engine.make_preparator(ep)
+            self.preparator_cache[key] = [
+                (preparator.prepare(self.ctx, td), info, qa)
+                for td, info, qa in eval_sets
+            ]
+        else:
+            self.hits["preparator"] += 1
+        return key, self.preparator_cache[key]
+
+    def _predictions(self, ep: EngineParams):
+        """Per eval set: list over algorithms of {query_ix: prediction}."""
+        prep_key, prepared_sets = self._prepared(ep)
+        key = prep_key + "|" + _key(*ep.algorithms)
+        if key not in self.algorithms_cache:
+            self.misses["algorithms"] += 1
+            per_set = []
+            for pd, info, qa in prepared_sets:
+                algorithms = self.engine.make_algorithms(ep)
+                models = [a.train(self.ctx, pd) for a in algorithms]
+                indexed = list(enumerate(q for q, _ in qa))
+                per_algo = [
+                    dict(a.batch_predict(m, indexed))
+                    for a, m in zip(algorithms, models)
+                ]
+                per_set.append((per_algo, info, qa))
+            self.algorithms_cache[key] = per_set
+        else:
+            self.hits["algorithms"] += 1
+        return self.algorithms_cache[key]
+
+    def eval(self, ep: EngineParams):
+        serving = self.engine.make_serving(ep)
+        results = []
+        for per_algo, info, qa in self._predictions(ep):
+            served = [
+                (q, serving.serve(q, [pa[ix] for pa in per_algo]), a)
+                for ix, (q, a) in enumerate(qa)
+            ]
+            results.append((info, served))
+        return results
+
+
+class FastEvalEngine(Engine):
+    """Engine whose batch_eval memoizes shared prefixes
+    (reference FastEvalEngine :313-346). Train/deploy behavior is
+    unchanged; only evaluation uses the caches."""
+
+    def batch_eval(
+        self,
+        ctx: WorkflowContext,
+        engine_params_list: Sequence[EngineParams],
+        workflow_params: WorkflowParams | None = None,
+    ):
+        workflow = FastEvalEngineWorkflow(self, ctx)
+        out = [(ep, workflow.eval(ep)) for ep in engine_params_list]
+        logger.info(
+            "FastEvalEngine cache hits=%s misses=%s", workflow.hits, workflow.misses
+        )
+        return out

@@ -1,0 +1,8 @@
+"""HTTP servers: event ingestion, engine query serving, admin, dashboard.
+
+Capability parity with the reference's spray/akka servers
+(data/.../api/EventServer.scala, core/.../workflow/CreateServer.scala,
+tools/.../admin/AdminAPI.scala, tools/.../dashboard/Dashboard.scala) on a
+threaded stdlib HTTP stack — the serving path's device work (top-k
+scoring) stays a single fused jax call per request.
+"""

@@ -1,0 +1,104 @@
+"""Admin server: REST management API (default port 7071).
+
+Capability parity with the reference admin server
+(tools/.../admin/AdminAPI.scala:39-160, admin/CommandClient.scala):
+``GET /`` status, ``GET /cmd/app`` list, ``POST /cmd/app`` create,
+``DELETE /cmd/app/<name>`` delete, ``DELETE /cmd/app/<name>/data``
+wipe event data.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from predictionio_tpu.cli import commands
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+
+logger = logging.getLogger(__name__)
+
+
+class AdminServer:
+    def __init__(self, storage: Storage | None = None, host: str = "0.0.0.0", port: int = 7071):
+        self.storage = storage or get_storage()
+        self.app = HTTPApp(self._router(), host=host, port=port)
+        self.host = host
+
+    def _router(self) -> Router:
+        router = Router()
+        server = self
+
+        @router.route("GET", "/")
+        def status(request: Request) -> Response:
+            return Response.json({"status": "alive"})
+
+        @router.route("GET", "/cmd/app")
+        def list_apps(request: Request) -> Response:
+            apps = commands.app_list(storage=server.storage)
+            return Response.json(
+                {
+                    "status": 1,
+                    "apps": [
+                        {
+                            "name": a["name"],
+                            "id": a["id"],
+                            "accessKey": a["access_key"],
+                        }
+                        for a in apps
+                    ],
+                }
+            )
+
+        @router.route("POST", "/cmd/app")
+        def new_app(request: Request) -> Response:
+            body = request.json() or {}
+            name = body.get("name")
+            if not name:
+                return Response.error("app name is required", 400)
+            try:
+                info = commands.app_new(
+                    name,
+                    app_id=int(body.get("id") or 0),
+                    description=body.get("description"),
+                    storage=server.storage,
+                )
+            except commands.CommandError as e:
+                return Response.json({"status": 0, "message": str(e)}, status=400)
+            return Response.json(
+                {
+                    "status": 1,
+                    "id": info["id"],
+                    "name": info["name"],
+                    "accessKey": info["access_key"],
+                }
+            )
+
+        @router.route("DELETE", "/cmd/app/<name>")
+        def delete_app(request: Request) -> Response:
+            try:
+                commands.app_delete(
+                    request.path_params["name"], storage=server.storage
+                )
+            except commands.CommandError as e:
+                return Response.json({"status": 0, "message": str(e)}, status=404)
+            return Response.json({"status": 1})
+
+        @router.route("DELETE", "/cmd/app/<name>/data")
+        def delete_app_data(request: Request) -> Response:
+            try:
+                commands.app_data_delete(
+                    request.path_params["name"], storage=server.storage
+                )
+            except commands.CommandError as e:
+                return Response.json({"status": 0, "message": str(e)}, status=404)
+            return Response.json({"status": 1})
+
+        return router
+
+    def start(self, background: bool = True) -> int:
+        port = self.app.start(background=background)
+        logger.info("Admin Server listening on %s:%d", self.host, port)
+        return port
+
+    def stop(self) -> None:
+        self.app.stop()

@@ -1,0 +1,94 @@
+"""Dashboard: browse completed evaluation instances (default port 9000).
+
+Capability parity with the reference dashboard
+(tools/.../dashboard/Dashboard.scala:40-160): an index of completed
+evaluations (most recent first) with links to per-instance
+``evaluator_results.{txt,html,json}``.
+"""
+
+from __future__ import annotations
+
+import html
+import logging
+
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+
+logger = logging.getLogger(__name__)
+
+
+class Dashboard:
+    def __init__(self, storage: Storage | None = None, host: str = "0.0.0.0", port: int = 9000):
+        self.storage = storage or get_storage()
+        self.host = host
+        self.app = HTTPApp(self._router(), host=host, port=port)
+
+    def _router(self) -> Router:
+        router = Router()
+        server = self
+
+        @router.route("GET", "/")
+        def index(request: Request) -> Response:
+            instances = server.storage.get_metadata_evaluation_instances().get_completed()
+            rows = "".join(
+                f"<tr><td>{html.escape(i.id)}</td>"
+                f"<td>{html.escape(i.evaluation_class)}</td>"
+                f"<td>{i.start_time:%Y-%m-%d %H:%M:%S}</td>"
+                f"<td>{i.end_time:%Y-%m-%d %H:%M:%S}</td>"
+                f"<td>{html.escape(i.evaluator_results)}</td>"
+                f"<td><a href='/engine_instances/{i.id}/evaluator_results.txt'>txt</a> "
+                f"<a href='/engine_instances/{i.id}/evaluator_results.html'>HTML</a> "
+                f"<a href='/engine_instances/{i.id}/evaluator_results.json'>JSON</a>"
+                f"</td></tr>"
+                for i in instances
+            )
+            page = (
+                "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
+                "<body><h1>Completed evaluations</h1>"
+                "<table border='1'><tr><th>ID</th><th>Evaluation</th>"
+                "<th>Started</th><th>Finished</th><th>One-liner</th>"
+                f"<th>Results</th></tr>{rows}</table></body></html>"
+            )
+            return Response.html(page)
+
+        @router.route("GET", "/engine_instances/<iid>/evaluator_results.txt")
+        def results_txt(request: Request) -> Response:
+            i = server._get(request.path_params["iid"])
+            if i is None:
+                return Response.error("Not Found", 404)
+            return Response(
+                200, ("text/plain; charset=utf-8", i.evaluator_results.encode())
+            )
+
+        @router.route("GET", "/engine_instances/<iid>/evaluator_results.html")
+        def results_html(request: Request) -> Response:
+            i = server._get(request.path_params["iid"])
+            if i is None:
+                return Response.error("Not Found", 404)
+            return Response.html(i.evaluator_results_html or "<html></html>")
+
+        @router.route("GET", "/engine_instances/<iid>/evaluator_results.json")
+        def results_json(request: Request) -> Response:
+            i = server._get(request.path_params["iid"])
+            if i is None:
+                return Response.error("Not Found", 404)
+            return Response(
+                200,
+                (
+                    "application/json; charset=utf-8",
+                    (i.evaluator_results_json or "{}").encode(),
+                ),
+            )
+
+        return router
+
+    def _get(self, iid: str):
+        return self.storage.get_metadata_evaluation_instances().get(iid)
+
+    def start(self, background: bool = True) -> int:
+        port = self.app.start(background=background)
+        logger.info("Dashboard listening on %s:%d", self.host, port)
+        return port
+
+    def stop(self) -> None:
+        self.app.stop()

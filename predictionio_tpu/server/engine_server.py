@@ -1,0 +1,283 @@
+"""Engine Server: deployed-engine query serving (default port 8000).
+
+Capability parity with the reference CreateServer
+(core/.../workflow/CreateServer.scala:105-663):
+
+- ``POST /queries.json`` — deserialize query via the algorithm's query
+  class, ``serving.supplement``, score every algorithm, ``serving.serve``,
+  JSON response (:470-500). Per-request bookkeeping: requestCount,
+  avgServingSec, lastServingSec (:399-403).
+- ``GET /`` — status page with engine info and serving stats.
+- ``POST /reload`` — hot-swap to the newest COMPLETED engine instance
+  (:316-342); key-authenticated.
+- ``POST /stop`` — key-authenticated shutdown (:260-285).
+- ``GET /plugins.json`` + output blocker/sniffer plugins (:578-581).
+- Feedback loop (:514-577): when enabled, asynchronously POSTs a
+  ``predict`` event (entityType ``pio_pr``) with query+prediction back to
+  the Event Server, generating/propagating ``prId``.
+
+The reference scores algorithms sequentially per request with a
+"TODO: Parallelize" note (:494-496); here multi-algorithm scoring still
+iterates host-side but each algorithm's scoring is one fused device call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+import urllib.request
+import uuid
+from typing import Any
+
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.workflow import prepare_deploy
+from predictionio_tpu.data.storage import EngineInstance, Storage, get_storage
+from predictionio_tpu.server import plugins as plugin_mod
+from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+
+logger = logging.getLogger(__name__)
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    return obj
+
+
+def _query_from_json(query_class: type | None, data: dict[str, Any]) -> Any:
+    """JSON -> query object (reference JsonExtractor.extract on
+    algo.queryClass, CreateServer.scala:479-485)."""
+    if query_class is None:
+        return data
+    if dataclasses.is_dataclass(query_class):
+        names = {f.name for f in dataclasses.fields(query_class)}
+        return query_class(**{k: v for k, v in data.items() if k in names})
+    return query_class(**data)
+
+
+class EngineServer:
+    def __init__(
+        self,
+        engine: Engine,
+        instance: EngineInstance,
+        storage: Storage | None = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        server_key: str | None = None,
+        feedback: bool = False,
+        event_server_url: str | None = None,
+        access_key: str | None = None,
+    ):
+        self.engine = engine
+        self.storage = storage or get_storage()
+        self.host = host
+        self.server_key = server_key
+        self.feedback = feedback
+        self.event_server_url = event_server_url
+        self.access_key = access_key
+        self._lock = threading.RLock()
+        self._load(instance)
+
+        self.request_count = 0
+        self.serving_seconds = 0.0
+        self.last_serving_sec = 0.0
+        self.start_time = time.time()
+
+        self.plugins = plugin_mod.load_plugins(plugin_mod.EngineServerPlugin)
+        self.plugin_context: dict[str, Any] = {"storage": self.storage}
+        for p in self.plugins:
+            p.start(self.plugin_context)
+
+        self.app = HTTPApp(self._router(), host=host, port=port)
+
+    def _load(self, instance: EngineInstance) -> None:
+        engine_params, algorithms, models, serving = prepare_deploy(
+            self.engine, instance, storage=self.storage
+        )
+        with self._lock:
+            self.instance = instance
+            self.engine_params = engine_params
+            self.algorithms = algorithms
+            self.models = models
+            self.serving = serving
+        logger.info("engine instance %s loaded for serving", instance.id)
+
+    # -- query path --------------------------------------------------------
+    def handle_query(self, body: dict[str, Any]) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        with self._lock:
+            algorithms, models, serving = self.algorithms, self.models, self.serving
+        query_class = algorithms[0].query_class
+        query = _query_from_json(query_class, body)
+        supplemented = serving.supplement(query)
+        predictions = [
+            a.predict(m, supplemented) for a, m in zip(algorithms, models)
+        ]
+        result = serving.serve(query, predictions)
+        response = _to_jsonable(result)
+
+        pr_id: str | None = None
+        if self.feedback:
+            pr_id = body.get("prId") or uuid.uuid4().hex[:16]
+            self._send_feedback(body, response, pr_id)
+            if isinstance(response, dict):
+                response = {**response, "prId": pr_id}
+
+        for p in self.plugins:
+            if p.plugin_type == plugin_mod.OUTPUT_BLOCKER:
+                response = p.process(
+                    self.instance.engine_variant, body, response, self.plugin_context
+                )
+            else:
+                p.process(
+                    self.instance.engine_variant, body, response, self.plugin_context
+                )
+
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.request_count += 1
+            self.serving_seconds += dt
+            self.last_serving_sec = dt
+        return response
+
+    def _send_feedback(self, query: dict, prediction: Any, pr_id: str) -> None:
+        """Async predict-event POST back to the event server
+        (CreateServer.scala:514-577)."""
+        if not (self.event_server_url and self.access_key):
+            logger.warning("feedback enabled but event server/access key missing")
+            return
+
+        def post():
+            payload = json.dumps(
+                {
+                    "event": "predict",
+                    "entityType": "pio_pr",
+                    "entityId": pr_id,
+                    "properties": {"query": query, "prediction": prediction},
+                    "prId": pr_id,
+                }
+            ).encode()
+            url = (
+                f"{self.event_server_url.rstrip('/')}/events.json"
+                f"?accessKey={self.access_key}"
+            )
+            try:
+                req = urllib.request.Request(
+                    url, data=payload, headers={"Content-Type": "application/json"}
+                )
+                urllib.request.urlopen(req, timeout=10).read()
+            except Exception:
+                logger.exception("feedback event POST failed")
+
+        threading.Thread(target=post, daemon=True).start()
+
+    # -- control -----------------------------------------------------------
+    def reload(self) -> bool:
+        """Swap to the latest completed instance (reference /reload)."""
+        latest = self.storage.get_metadata_engine_instances().get_latest_completed(
+            self.instance.engine_id,
+            self.instance.engine_version,
+            self.instance.engine_variant,
+        )
+        if latest is None:
+            return False
+        self._load(latest)
+        return True
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            avg = (
+                self.serving_seconds / self.request_count
+                if self.request_count
+                else 0.0
+            )
+            return {
+                "status": "alive",
+                "engineInstanceId": self.instance.id,
+                "engineFactory": self.instance.engine_factory,
+                "engineVariant": self.instance.engine_variant,
+                "startTime": self.start_time,
+                "requestCount": self.request_count,
+                "avgServingSec": round(avg, 6),
+                "lastServingSec": round(self.last_serving_sec, 6),
+                "plugins": [p.plugin_name for p in self.plugins],
+            }
+
+    # -- routes ------------------------------------------------------------
+    def _router(self) -> Router:
+        router = Router()
+        server = self
+
+        @router.route("GET", "/")
+        def status(request: Request) -> Response:
+            return Response.json(server.status())
+
+        @router.route("POST", "/queries.json")
+        def queries(request: Request) -> Response:
+            body = request.json()
+            if not isinstance(body, dict):
+                return Response.error("request body must be a JSON object", 400)
+            try:
+                return Response.json(server.handle_query(body))
+            except (TypeError, KeyError, ValueError) as e:
+                return Response.error(f"Your query is not valid. {e}", 400)
+
+        @router.route("POST", "/reload")
+        def reload(request: Request) -> Response:
+            if not server._auth_control(request):
+                return Response.error("Invalid accessKey.", 401)
+            ok = server.reload()
+            if not ok:
+                return Response.error("no completed engine instance found", 404)
+            return Response.json({"message": "Reloading..."})
+
+        @router.route("POST", "/stop")
+        def stop(request: Request) -> Response:
+            if not server._auth_control(request):
+                return Response.error("Invalid accessKey.", 401)
+            threading.Thread(target=server.stop, daemon=True).start()
+            return Response.json({"message": "Shutting down..."})
+
+        @router.route("GET", "/plugins.json")
+        def plugins_route(request: Request) -> Response:
+            return Response.json(
+                {
+                    "plugins": {
+                        p.plugin_name: {
+                            "outputblocker": p.plugin_type
+                            == plugin_mod.OUTPUT_BLOCKER,
+                            "description": p.plugin_description,
+                        }
+                        for p in server.plugins
+                    }
+                }
+            )
+
+        @router.route("GET", "/plugins/<name>.json")
+        def plugin_rest(request: Request) -> Response:
+            name = request.path_params["name"]
+            for p in server.plugins:
+                if p.plugin_name == name:
+                    return Response.json(p.handle_rest(dict(request.query)))
+            return Response.error("plugin not found", 404)
+
+        return router
+
+    def _auth_control(self, request: Request) -> bool:
+        """/reload and /stop are guarded by the server key when set
+        (reference common KeyAuthentication)."""
+        if not self.server_key:
+            return True
+        return request.query.get("accessKey") == self.server_key
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, background: bool = True) -> int:
+        port = self.app.start(background=background)
+        logger.info("Engine Server listening on %s:%d", self.host, port)
+        return port
+
+    def stop(self) -> None:
+        self.app.stop()

@@ -1,0 +1,291 @@
+"""Event Server: REST ingestion API (default port 7070).
+
+Capability parity with the reference Event Server
+(data/.../api/EventServer.scala:54-663):
+
+- ``GET /``                       welcome status
+- ``POST /events.json``           single event, 201 Created + eventId
+- ``GET /events.json``            query (startTime/untilTime/entityType/
+                                  entityId/event/targetEntityType/
+                                  targetEntityId/limit/reversed)
+- ``GET|DELETE /events/<id>.json`` point read/delete
+- ``POST /batch/events.json``     at most **50** events per request
+                                  (:376-390), per-event status list
+- ``GET /stats.json``             ingestion stats (when enabled)
+- ``POST /webhooks/<name>.json``  JSON webhooks; ``.form`` form flavor
+- ``GET /webhooks/<name>.json``   connector presence check
+
+Auth mirrors the reference: per-app ``accessKey`` via query param or
+HTTP basic username, optional ``channel`` query param resolved against
+the app's channels, per-key event-name allowlists
+(api/EventServer.scala:92-150). Input blocker/sniffer plugins intercept
+ingestion.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any
+
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    parse_time,
+    validate,
+)
+from predictionio_tpu.data.storage import AccessKey, Storage, get_storage
+from predictionio_tpu.server import plugins as plugin_mod
+from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+from predictionio_tpu.server.stats import Stats
+from predictionio_tpu.server.webhooks import (
+    ConnectorError,
+    FormConnector,
+    JsonConnector,
+    default_connectors,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_BATCH_SIZE = 50  # reference EventServer.scala:70
+
+
+@dataclass
+class AuthData:
+    app_id: int
+    channel_id: int | None
+    events: list[str]
+
+
+class EventServer:
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        host: str = "0.0.0.0",
+        port: int = 7070,
+        stats: bool = False,
+        connectors: dict | None = None,
+    ):
+        self.storage = storage or get_storage()
+        self.stats_enabled = stats
+        self.stats = Stats()
+        self.connectors = (
+            connectors if connectors is not None else default_connectors()
+        )
+        self.plugins = plugin_mod.load_plugins(plugin_mod.EventServerPlugin)
+        self.plugin_context: dict[str, Any] = {"storage": self.storage}
+        for p in self.plugins:
+            p.start(self.plugin_context)
+        self.app = HTTPApp(self._router(), host=host, port=port)
+
+    # -- auth --------------------------------------------------------------
+    def _auth(self, request: Request) -> AuthData | Response:
+        key = request.access_key
+        if not key:
+            return Response.error("Missing accessKey.", 401)
+        access_key: AccessKey | None = self.storage.get_metadata_access_keys().get(key)
+        if access_key is None:
+            return Response.error("Invalid accessKey.", 401)
+        channel_id: int | None = None
+        if "channel" in request.query:
+            channels = self.storage.get_metadata_channels().get_by_appid(
+                access_key.appid
+            )
+            match = [c for c in channels if c.name == request.query["channel"]]
+            if not match:
+                return Response.error("Invalid channel.", 401)
+            channel_id = match[0].id
+        return AuthData(access_key.appid, channel_id, access_key.events)
+
+    def _check_event_allowed(self, auth: AuthData, event_name: str) -> bool:
+        return not auth.events or event_name in auth.events
+
+    # -- event ingestion ---------------------------------------------------
+    def _ingest_one(self, auth: AuthData, event_json: dict) -> tuple[int, dict]:
+        """Returns (status_code, body) per event — used by both single and
+        batch paths so semantics match (validation, plugins, allowlist)."""
+        try:
+            for p in self.plugins:
+                if p.plugin_type == plugin_mod.INPUT_BLOCKER:
+                    event_json = p.process(event_json, self.plugin_context) or event_json
+                else:
+                    p.process(dict(event_json), self.plugin_context)
+            event = Event.from_dict(event_json)
+            validate(event)
+        except (EventValidationError, KeyError, TypeError, ValueError) as e:
+            return 400, {"message": str(e)}
+        if not self._check_event_allowed(auth, event.event):
+            return 403, {
+                "message": f"event {event.event} is not allowed by this access key"
+            }
+        event_id = self.storage.get_events().insert(
+            event, auth.app_id, auth.channel_id
+        )
+        if self.stats_enabled:
+            self.stats.update(auth.app_id, 201, event.event, event.entity_type)
+        return 201, {"eventId": event_id}
+
+    # -- routes ------------------------------------------------------------
+    def _router(self) -> Router:
+        router = Router()
+        server = self
+
+        @router.route("GET", "/")
+        def welcome(request: Request) -> Response:
+            return Response.json({"status": "alive"})
+
+        @router.route("POST", "/events.json")
+        def create_event(request: Request) -> Response:
+            auth = server._auth(request)
+            if isinstance(auth, Response):
+                return auth
+            body = request.json()
+            if not isinstance(body, dict):
+                return Response.error("request body must be a JSON object", 400)
+            status, payload = server._ingest_one(auth, body)
+            return Response.json(payload, status=status)
+
+        @router.route("GET", "/events.json")
+        def find_events(request: Request) -> Response:
+            auth = server._auth(request)
+            if isinstance(auth, Response):
+                return auth
+            q = request.query
+            try:
+                limit = int(q.get("limit", 20))
+                events = server.storage.get_events().find(
+                    app_id=auth.app_id,
+                    channel_id=auth.channel_id,
+                    start_time=parse_time(q["startTime"]) if q.get("startTime") else None,
+                    until_time=parse_time(q["untilTime"]) if q.get("untilTime") else None,
+                    entity_type=q.get("entityType"),
+                    entity_id=q.get("entityId"),
+                    event_names=[q["event"]] if q.get("event") else None,
+                    target_entity_type=q.get("targetEntityType", ...),
+                    target_entity_id=q.get("targetEntityId", ...),
+                    limit=None if limit == -1 else limit,
+                    reversed_order=q.get("reversed") == "true",
+                )
+            except (EventValidationError, ValueError) as e:
+                return Response.error(str(e), 400)
+            if not events:
+                return Response.error("Not Found", 404)
+            return Response.json([e.to_dict() for e in events])
+
+        @router.route("GET", "/events/<event_id>.json")
+        def get_event(request: Request) -> Response:
+            auth = server._auth(request)
+            if isinstance(auth, Response):
+                return auth
+            event = server.storage.get_events().get(
+                request.path_params["event_id"], auth.app_id, auth.channel_id
+            )
+            if event is None:
+                return Response.error("Not Found", 404)
+            return Response.json(event.to_dict())
+
+        @router.route("DELETE", "/events/<event_id>.json")
+        def delete_event(request: Request) -> Response:
+            auth = server._auth(request)
+            if isinstance(auth, Response):
+                return auth
+            found = server.storage.get_events().delete(
+                request.path_params["event_id"], auth.app_id, auth.channel_id
+            )
+            if not found:
+                return Response.error("Not Found", 404)
+            return Response.json({"message": "Found"})
+
+        @router.route("POST", "/batch/events.json")
+        def batch_events(request: Request) -> Response:
+            auth = server._auth(request)
+            if isinstance(auth, Response):
+                return auth
+            body = request.json()
+            if not isinstance(body, list):
+                return Response.error("request body must be a JSON array", 400)
+            if len(body) > MAX_BATCH_SIZE:
+                return Response.error(
+                    f"Batch request must have less than or equal to "
+                    f"{MAX_BATCH_SIZE} events",
+                    400,
+                )
+            results = []
+            for item in body:
+                if not isinstance(item, dict):
+                    results.append({"status": 400, "message": "not a JSON object"})
+                    continue
+                status, payload = server._ingest_one(auth, item)
+                results.append({"status": status, **payload})
+            return Response.json(results)
+
+        @router.route("GET", "/stats.json")
+        def stats(request: Request) -> Response:
+            auth = server._auth(request)
+            if isinstance(auth, Response):
+                return auth
+            if not server.stats_enabled:
+                return Response.error(
+                    "To see stats, launch Event Server with --stats argument.", 404
+                )
+            return Response.json(server.stats.get(auth.app_id))
+
+        @router.route("POST", "/webhooks/<name>.json")
+        def webhook_json(request: Request) -> Response:
+            return server._webhook(request, form=False)
+
+        @router.route("POST", "/webhooks/<name>.form")
+        def webhook_form(request: Request) -> Response:
+            return server._webhook(request, form=True)
+
+        @router.route("GET", "/webhooks/<name>.json")
+        def webhook_check_json(request: Request) -> Response:
+            return server._webhook_check(request, JsonConnector)
+
+        @router.route("GET", "/webhooks/<name>.form")
+        def webhook_check_form(request: Request) -> Response:
+            return server._webhook_check(request, FormConnector)
+
+        return router
+
+    def _webhook(self, request: Request, form: bool) -> Response:
+        auth = self._auth(request)
+        if isinstance(auth, Response):
+            return auth
+        name = request.path_params["name"]
+        connector = self.connectors.get(name)
+        want = FormConnector if form else JsonConnector
+        if not isinstance(connector, want):
+            return Response.error(f"webhooks connection for {name} is not supported.", 404)
+        try:
+            data = request.form() if form else request.json()
+            if data is None:
+                return Response.error("empty payload", 400)
+            event_json = connector.to_event_json(data)
+            status, payload = self._ingest_one(auth, event_json)
+        except ConnectorError as e:
+            return Response.error(str(e), 400)
+        return Response.json(payload, status=status)
+
+    def _webhook_check(self, request: Request, want: type) -> Response:
+        auth = self._auth(request)
+        if isinstance(auth, Response):
+            return auth
+        name = request.path_params["name"]
+        if not isinstance(self.connectors.get(name), want):
+            return Response.error(f"webhooks connection for {name} is not supported.", 404)
+        return Response.json({"message": "Ok"})
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, background: bool = True) -> int:
+        port = self.app.start(background=background)
+        logger.info("Event Server listening on %s:%d", self.app.host, port)
+        return port
+
+    def stop(self) -> None:
+        self.app.stop()
+
+
+def create_event_server(**kwargs) -> EventServer:
+    """Reference createEventServer (api/EventServer.scala:633)."""
+    return EventServer(**kwargs)

@@ -1,0 +1,192 @@
+"""Minimal threaded HTTP server + routing shared by all framework servers.
+
+Plays the role of spray-can/akka-http in the reference (request routing,
+JSON marshalling, access-key auth), with no third-party dependencies.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import re
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, str]
+    headers: dict[str, str]
+    body: bytes
+    path_params: dict[str, str] = field(default_factory=dict)
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+    def form(self) -> dict[str, str]:
+        parsed = parse_qs(self.body.decode("utf-8"), keep_blank_values=True)
+        return {k: v[0] for k, v in parsed.items()}
+
+    @property
+    def access_key(self) -> str | None:
+        """accessKey from query param or HTTP basic auth username
+        (reference EventServer withAccessKeyFromQueryOrBasicAuth,
+        api/EventServer.scala:92-120)."""
+        if "accessKey" in self.query:
+            return self.query["accessKey"]
+        auth = self.headers.get("authorization", "")
+        if auth.lower().startswith("basic "):
+            try:
+                decoded = base64.b64decode(auth[6:]).decode("utf-8")
+                return decoded.split(":", 1)[0] or None
+            except Exception:
+                return None
+        return None
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: Any = None  # JSON-serializable, or (content_type, bytes)
+    headers: dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def json(obj: Any, status: int = 200) -> "Response":
+        return Response(status=status, body=obj)
+
+    @staticmethod
+    def error(message: str, status: int) -> "Response":
+        return Response(status=status, body={"message": message})
+
+    @staticmethod
+    def html(text: str, status: int = 200) -> "Response":
+        return Response(status=status, body=("text/html; charset=utf-8", text.encode()))
+
+
+Handler = Callable[[Request], Response]
+
+
+class Router:
+    """Method+path-pattern routing. Patterns use ``<name>`` segments."""
+
+    def __init__(self) -> None:
+        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        regex = re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern)
+        self._routes.append((method.upper(), re.compile(f"^{regex}$"), handler))
+
+    def route(self, method: str, pattern: str):
+        def deco(fn: Handler) -> Handler:
+            self.add(method, pattern, fn)
+            return fn
+
+        return deco
+
+    def dispatch(self, request: Request) -> Response:
+        path_matched = False
+        for method, regex, handler in self._routes:
+            m = regex.match(request.path)
+            if not m:
+                continue
+            path_matched = True
+            if method != request.method:
+                continue
+            request.path_params = m.groupdict()
+            return handler(request)
+        if path_matched:
+            return Response.error("method not allowed", 405)
+        return Response.error("not found", 404)
+
+
+class HTTPApp:
+    """A router bound to a ThreadingHTTPServer with start/stop lifecycle."""
+
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0):
+        self.router = router
+        self.host = host
+        self.port = port
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self, background: bool = True) -> int:
+        """Bind and serve. Returns the bound port."""
+        app = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                logger.debug("%s %s", self.address_string(), fmt % args)
+
+            def _handle(self):
+                parsed = urlparse(self.path)
+                q = {
+                    k: v[0]
+                    for k, v in parse_qs(parsed.query, keep_blank_values=True).items()
+                }
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                request = Request(
+                    method=self.command,
+                    path=parsed.path,
+                    query=q,
+                    headers={k.lower(): v for k, v in self.headers.items()},
+                    body=body,
+                )
+                try:
+                    response = app.router.dispatch(request)
+                except json.JSONDecodeError:
+                    response = Response.error("invalid JSON body", 400)
+                except Exception:
+                    logger.exception("unhandled error on %s %s", self.command, parsed.path)
+                    response = Response.error("internal error", 500)
+                self._send(response)
+
+            def _send(self, response: Response):
+                if isinstance(response.body, tuple):
+                    content_type, payload = response.body
+                else:
+                    content_type = "application/json; charset=utf-8"
+                    payload = json.dumps(
+                        response.body if response.body is not None else {}
+                    ).encode("utf-8")
+                self.send_response(response.status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in response.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_DELETE = do_PUT = _handle
+
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self.port = self._server.server_address[1]
+        if background:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True
+            )
+            self._thread.start()
+        else:
+            try:
+                self._server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+        return self.port
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

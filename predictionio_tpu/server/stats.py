@@ -1,0 +1,61 @@
+"""Event-server ingestion statistics.
+
+Parity with the reference Stats/StatsActor
+(data/.../api/Stats.scala:43-82, api/StatsActor.scala:36): per-minute
+buckets counting (appId, event name, entityType, status) served at
+``/stats.json`` when stats are enabled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class _Key:
+    app_id: int
+    status: int
+    event: str
+    entity_type: str
+
+
+class Stats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # minute bucket -> key -> count
+        self._buckets: dict[int, dict[_Key, int]] = defaultdict(
+            lambda: defaultdict(int)
+        )
+        self.start_time = time.time()
+
+    def update(self, app_id: int, status: int, event: str, entity_type: str) -> None:
+        minute = int(time.time() // 60)
+        with self._lock:
+            self._buckets[minute][_Key(app_id, status, event, entity_type)] += 1
+
+    def get(self, app_id: int) -> dict:
+        """Aggregate counts for one app across all buckets
+        (the reference reports previous-minute and cumulative views;
+        cumulative is what its tests assert on)."""
+        with self._lock:
+            agg: dict[tuple, int] = defaultdict(int)
+            for bucket in self._buckets.values():
+                for key, count in bucket.items():
+                    if key.app_id == app_id:
+                        agg[(key.status, key.event, key.entity_type)] += count
+        return {
+            "startTime": self.start_time,
+            "statusCount": _group(agg, 0),
+            "eventCount": _group(agg, 1),
+            "entityTypeCount": _group(agg, 2),
+        }
+
+
+def _group(agg: dict[tuple, int], ix: int) -> dict:
+    out: dict = defaultdict(int)
+    for key, count in agg.items():
+        out[str(key[ix])] += count
+    return dict(out)

@@ -1,0 +1,200 @@
+"""CLI lifecycle integration test: the reference QuickStartTest analog
+(tests/pio_tests/scenarios/quickstart_test.py:50-105) — app new -> import
+events -> train -> deploy -> HTTP query -> undeploy, all through the real
+`pio` CLI in subprocesses against an isolated storage basedir."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def pio(args, env, timeout=180, check=True):
+    proc = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=timeout,
+        cwd=REPO,
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"pio {' '.join(args)} failed rc={proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+        )
+    return proc
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def cli_env(tmp_path):
+    env = dict(os.environ)
+    env["PIO_FS_BASEDIR"] = str(tmp_path / "store")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+class TestCLILifecycle:
+    def test_quickstart(self, cli_env, tmp_path):
+        # -- pio status / version
+        out = pio(["version"], cli_env).stdout.strip()
+        assert out
+        pio(["status"], cli_env)
+
+        # -- app new
+        out = pio(["app", "new", "QuickApp"], cli_env).stdout
+        access_key = [
+            line.split(":", 1)[1].strip()
+            for line in out.splitlines()
+            if line.startswith("Access Key:")
+        ][0]
+        assert access_key
+
+        # -- import sample events (JSON-lines, reference FileToEvents)
+        events_file = tmp_path / "events.jsonl"
+        with open(events_file, "w") as f:
+            for u in range(10):
+                for i in range(6):
+                    f.write(
+                        json.dumps(
+                            {
+                                "event": "rate",
+                                "entityType": "user",
+                                "entityId": f"u{u}",
+                                "targetEntityType": "item",
+                                "targetEntityId": f"i{(u + i) % 8}",
+                                "properties": {"rating": float((u * i) % 5 + 1)},
+                                "eventTime": "2020-01-01T00:00:00.000Z",
+                            }
+                        )
+                        + "\n"
+                    )
+        out = pio(
+            ["import", "--appid-or-name", "QuickApp", "--input", str(events_file)],
+            cli_env,
+        ).stdout
+        assert "Imported 60 events." in out
+
+        # -- export roundtrip
+        export_file = tmp_path / "export.jsonl"
+        out = pio(
+            ["export", "--appid-or-name", "QuickApp", "--output", str(export_file)],
+            cli_env,
+        ).stdout
+        assert "Exported 60 events" in out
+        assert len(export_file.read_text().splitlines()) == 60
+
+        # -- train via variant JSON (engine.json analog)
+        variant = {
+            "id": "quick",
+            "engineFactory": "predictionio_tpu.models.recommendation.engine",
+            "datasource": {"params": {"app_name": "QuickApp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "num_iterations": 3}}
+            ],
+        }
+        variant_file = tmp_path / "engine.json"
+        variant_file.write_text(json.dumps(variant))
+        out = pio(["train", "--variant", str(variant_file)], cli_env).stdout
+        assert "Training completed" in out
+
+        # -- deploy (background subprocess), query over HTTP, undeploy
+        port = free_port()
+        server = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "predictionio_tpu.cli.main",
+                "deploy",
+                "--variant",
+                str(variant_file),
+                "--ip",
+                "127.0.0.1",
+                "--port",
+                str(port),
+            ],
+            env=cli_env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        try:
+            deadline = time.time() + 120
+            last_err = None
+            while time.time() < deadline:
+                if server.poll() is not None:
+                    raise AssertionError(
+                        f"deploy exited early: {server.stderr.read().decode()}"
+                    )
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=2
+                    ) as resp:
+                        if resp.status == 200:
+                            break
+                except Exception as e:
+                    last_err = e
+                    time.sleep(0.5)
+            else:
+                raise AssertionError(f"engine server never came up: {last_err}")
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json",
+                data=json.dumps({"user": "u1", "num": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.loads(resp.read())
+            assert len(body["itemScores"]) == 3
+
+            out = pio(
+                ["undeploy", "--ip", "127.0.0.1", "--port", str(port)], cli_env
+            ).stdout
+            assert "Undeployed." in out
+            server.wait(timeout=30)
+        finally:
+            if server.poll() is None:
+                server.kill()
+
+    def test_app_and_accesskey_verbs(self, cli_env):
+        pio(["app", "new", "VerbApp"], cli_env)
+        out = pio(["app", "list"], cli_env).stdout
+        assert "VerbApp" in out
+        out = pio(["app", "show", "VerbApp"], cli_env).stdout
+        assert json.loads(out)["name"] == "VerbApp"
+        # channels
+        pio(["app", "channel-new", "VerbApp", "live"], cli_env)
+        assert "live" in pio(["app", "show", "VerbApp"], cli_env).stdout
+        pio(["app", "channel-delete", "VerbApp", "live"], cli_env)
+        # access keys
+        out = pio(
+            ["accesskey", "new", "VerbApp", "--event", "rate"], cli_env
+        ).stdout
+        key = out.split(":", 1)[1].strip()
+        assert key in pio(["accesskey", "list", "VerbApp"], cli_env).stdout
+        pio(["accesskey", "delete", key], cli_env)
+        # duplicate app fails politely
+        proc = pio(["app", "new", "VerbApp"], cli_env, check=False)
+        assert proc.returncode == 1
+        assert "already exists" in proc.stderr
+        pio(["app", "data-delete", "VerbApp"], cli_env)
+        pio(["app", "delete", "VerbApp"], cli_env)
+        assert "VerbApp" not in pio(["app", "list"], cli_env).stdout

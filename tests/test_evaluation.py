@@ -1,0 +1,263 @@
+"""Evaluation subsystem tests (mirrors reference MetricTest,
+MetricEvaluatorTest, EvaluationTest, FastEvalEngineTest)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from predictionio_tpu.core import EngineParams, WorkflowContext
+from predictionio_tpu.core.evaluation import Evaluation, MetricEvaluator
+from predictionio_tpu.core.fast_eval import FastEvalEngine, FastEvalEngineWorkflow
+from predictionio_tpu.core.metrics import (
+    AverageMetric,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.core.params import EngineParamsGenerator
+from predictionio_tpu.core.workflow_eval import run_evaluation
+from predictionio_tpu.data.storage import EvaluationInstanceStatus
+
+from tests.test_engine import (  # the fake engine zoo
+    AlgoParams,
+    DSParams,
+    make_engine,
+    make_params,
+)
+
+CTX = WorkflowContext(mode="EvalTest")
+
+
+class PredictionError(AverageMetric):
+    """|prediction tuple's query echo - actual| on the fake engine: the
+    fake serving returns ('served', q, preds); actual = 100*s + i."""
+
+    def calculate_point(self, q, p, a):
+        return float(a - q)  # deterministic per (set, index): 90s
+
+
+class EvenOnlyMetric(OptionAverageMetric):
+    def calculate_point(self, q, p, a):
+        return float(q) if q % 2 == 0 else None
+
+
+class TestMetrics:
+    def eval_data(self):
+        return make_engine().eval(CTX, make_params())
+
+    def test_average(self):
+        data = self.eval_data()
+        # a - q = 90*s for every point in set s; sets 0 and 1, 3 points each
+        assert PredictionError().calculate(data) == pytest.approx(45.0)
+
+    def test_option_average_skips_none(self):
+        data = self.eval_data()
+        # queries: set0: 0,1,2; set1: 10,11,12 -> evens 0,2,10,12 -> mean 6
+        assert EvenOnlyMetric().calculate(data) == pytest.approx(6.0)
+
+    def test_stdev(self):
+        data = self.eval_data()
+
+        class S(StdevMetric):
+            def calculate_point(self, q, p, a):
+                return float(a - q)
+
+        assert S().calculate(data) == pytest.approx(45.0)  # values {0,90}
+
+    def test_sum(self):
+        data = self.eval_data()
+
+        class S(SumMetric):
+            def calculate_point(self, q, p, a):
+                return 1.0
+
+        assert S().calculate(data) == 6.0
+
+    def test_zero(self):
+        assert ZeroMetric().calculate(self.eval_data()) == 0.0
+
+    def test_compare_orderings(self):
+        m = PredictionError()
+        assert m.compare(2.0, 1.0) > 0
+        m.smaller_is_better = True
+        assert m.compare(2.0, 1.0) < 0
+        assert m.compare(float("nan"), 1.0) < 0
+
+
+class VaryingMetric(AverageMetric):
+    """Scores candidates by their first algorithm's id (via prediction)."""
+
+    def calculate_point(self, q, p, a):
+        # p = ('served', q, ((aid, tid, q), ...))
+        return float(p[2][0][0])
+
+
+class TestMetricEvaluator:
+    def test_picks_best_candidate(self, tmp_path):
+        candidates = [
+            make_params(algo_ids=(1,)),
+            make_params(algo_ids=(5,)),
+            make_params(algo_ids=(3,)),
+        ]
+        out = tmp_path / "best.json"
+        evaluator = MetricEvaluator(
+            VaryingMetric(), other_metrics=[ZeroMetric()], output_path=str(out)
+        )
+        result = evaluator.evaluate(CTX, make_engine(), candidates)
+        assert result.best_idx == 1
+        assert result.best_score.score == 5.0
+        assert result.best_engine_params.algorithms[0][1].id == 5
+        assert result.other_metric_headers == ["ZeroMetric"]
+        # best.json written as a loadable variant
+        variant = json.loads(out.read_text())
+        assert variant["algorithms"][0]["params"]["id"] == 5
+        ep = make_engine().params_from_variant(variant)
+        assert ep.algorithms[0][1].id == 5
+
+    def test_smaller_is_better(self):
+        class SmallBest(VaryingMetric):
+            smaller_is_better = True
+
+        result = MetricEvaluator(SmallBest()).evaluate(
+            CTX,
+            make_engine(),
+            [make_params(algo_ids=(4,)), make_params(algo_ids=(2,))],
+        )
+        assert result.best_idx == 1
+
+    def test_result_renderings(self):
+        result = MetricEvaluator(VaryingMetric()).evaluate(
+            CTX, make_engine(), [make_params(algo_ids=(2,))]
+        )
+        assert "VaryingMetric" in result.to_one_liner()
+        assert "<html>" in result.to_html()
+        parsed = json.loads(result.to_json())
+        assert parsed["bestScore"] == 2.0
+
+
+EVAL_SINGLETON = Evaluation(engine=make_engine(), metric=VaryingMetric())
+
+
+class Generator(EngineParamsGenerator):
+    def __init__(self):
+        self.engine_params_list = [
+            make_params(algo_ids=(1,)),
+            make_params(algo_ids=(7,)),
+        ]
+
+
+class TestRunEvaluation:
+    def test_lifecycle_and_persistence(self, storage):
+        instance_id, result = run_evaluation(
+            f"{__name__}.EVAL_SINGLETON",
+            f"{__name__}.Generator",
+            batch="test-sweep",
+            storage=storage,
+        )
+        assert result.best_score.score == 7.0
+        inst = storage.get_metadata_evaluation_instances().get(instance_id)
+        assert inst.status == EvaluationInstanceStatus.EVALCOMPLETED
+        assert inst.evaluator_results == result.to_one_liner()
+        assert json.loads(inst.evaluator_results_json)["bestScore"] == 7.0
+        assert inst in storage.get_metadata_evaluation_instances().get_completed()
+
+    def test_failure_marks_failed(self, storage):
+        class BoomMetric(AverageMetric):
+            def calculate_point(self, q, p, a):
+                raise RuntimeError("boom")
+
+        bad = Evaluation(engine=make_engine(), metric=BoomMetric())
+        with pytest.raises(RuntimeError):
+            run_evaluation(bad, Generator(), storage=storage)
+        [inst] = storage.get_metadata_evaluation_instances().get_all()
+        assert inst.status == "FAILED"
+
+    def test_dashboard_serves_results(self, storage):
+        from tests.test_servers import http
+        from predictionio_tpu.server.dashboard import Dashboard
+
+        run_evaluation(EVAL_SINGLETON, Generator(), storage=storage)
+        dash = Dashboard(storage=storage, host="127.0.0.1", port=0)
+        port = dash.start()
+        try:
+            import urllib.request
+
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10
+            ).read().decode()
+            assert "Completed evaluations" in page and "VaryingMetric" in page
+            iid = storage.get_metadata_evaluation_instances().get_completed()[0].id
+            status, body = http(
+                "GET",
+                f"http://127.0.0.1:{port}/engine_instances/{iid}/evaluator_results.json",
+            )
+            assert status == 200 and body["bestScore"] == 7.0
+        finally:
+            dash.stop()
+
+
+class CountingEngineWorkflowTest:
+    pass
+
+
+class TestFastEval:
+    def make_fast_engine(self):
+        from tests.test_engine import (
+            Algo0,
+            DataSource0,
+            Preparator0,
+            Serving0,
+        )
+
+        # counting wrappers to observe stage executions
+        counts = {"read": 0, "prepare": 0, "train": 0}
+
+        class CountingDS(DataSource0):
+            def read_eval(self, ctx):
+                counts["read"] += 1
+                return super().read_eval(ctx)
+
+        class CountingPrep(Preparator0):
+            def prepare(self, ctx, td):
+                counts["prepare"] += 1
+                return super().prepare(ctx, td)
+
+        class CountingAlgo(Algo0):
+            def train(self, ctx, pd):
+                counts["train"] += 1
+                return super().train(ctx, pd)
+
+        engine = FastEvalEngine(
+            {"": CountingDS}, {"": CountingPrep}, {"": CountingAlgo}, {"": Serving0}
+        )
+        return engine, counts
+
+    def test_shared_prefixes_computed_once(self):
+        engine, counts = self.make_fast_engine()
+        candidates = [
+            make_params(ds_id=1, p_id=1, algo_ids=(1,)),
+            make_params(ds_id=1, p_id=1, algo_ids=(2,)),  # shares ds+prep
+            make_params(ds_id=1, p_id=2, algo_ids=(2,)),  # shares ds only
+            make_params(ds_id=1, p_id=1, algo_ids=(1,)),  # full cache hit
+        ]
+        results = engine.batch_eval(CTX, candidates)
+        assert len(results) == 4
+        # one distinct datasource prefix -> read_eval runs exactly once
+        assert counts["read"] == 1
+        # (ds,prep) prefixes: (1,1) and (1,2) -> 2 prefixes x 2 eval sets
+        assert counts["prepare"] == 4
+        # (ds,prep,algos) prefixes: (1,1,[1]), (1,1,[2]), (1,2,[2])
+        # -> 3 prefixes x 2 eval sets x 1 algo
+        assert counts["train"] == 6
+
+    def test_cache_correctness_vs_plain_engine(self):
+        engine, _ = self.make_fast_engine()
+        plain = make_engine()
+        candidates = [make_params(algo_ids=(1,)), make_params(algo_ids=(2,))]
+        fast_results = engine.batch_eval(CTX, candidates)
+        plain_results = plain.batch_eval(CTX, candidates)
+        for (ep_f, rf), (ep_p, rp) in zip(fast_results, plain_results):
+            assert rf == rp

@@ -1,0 +1,397 @@
+"""Event/engine/admin server HTTP tests (mirrors reference EventServiceSpec,
+SegmentIOAuthSpec, AdminAPISpec — real sockets on localhost)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.cli import commands
+from predictionio_tpu.data.storage import AccessKey
+
+
+def http(method, url, body=None, headers=None):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            return e.code, json.loads(payload or b"{}")
+        except json.JSONDecodeError:
+            return e.code, {"raw": payload.decode()}
+
+
+@pytest.fixture()
+def event_server(storage):
+    from predictionio_tpu.server.event_server import EventServer
+
+    info = commands.app_new("EventApp", storage=storage)
+    server = EventServer(storage=storage, host="127.0.0.1", port=0, stats=True)
+    port = server.start()
+    yield {
+        "base": f"http://127.0.0.1:{port}",
+        "key": info["access_key"],
+        "app_id": info["id"],
+        "storage": storage,
+        "server": server,
+    }
+    server.stop()
+
+
+EVENT = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.5},
+}
+
+
+class TestEventServer:
+    def test_welcome(self, event_server):
+        status, body = http("GET", event_server["base"] + "/")
+        assert status == 200 and body["status"] == "alive"
+
+    def test_create_and_get_event(self, event_server):
+        base, key = event_server["base"], event_server["key"]
+        status, body = http("POST", f"{base}/events.json?accessKey={key}", EVENT)
+        assert status == 201 and "eventId" in body
+        eid = body["eventId"]
+        status, body = http("GET", f"{base}/events/{eid}.json?accessKey={key}")
+        assert status == 200
+        assert body["entityId"] == "u1"
+        assert body["properties"]["rating"] == 4.5
+        # query listing
+        status, body = http("GET", f"{base}/events.json?accessKey={key}")
+        assert status == 200 and len(body) == 1
+        # delete
+        status, _ = http("DELETE", f"{base}/events/{eid}.json?accessKey={key}")
+        assert status == 200
+        status, _ = http("GET", f"{base}/events/{eid}.json?accessKey={key}")
+        assert status == 404
+
+    def test_auth_required(self, event_server):
+        base = event_server["base"]
+        status, _ = http("POST", f"{base}/events.json", EVENT)
+        assert status == 401
+        status, _ = http("POST", f"{base}/events.json?accessKey=wrong", EVENT)
+        assert status == 401
+
+    def test_basic_auth_key(self, event_server):
+        import base64
+
+        base, key = event_server["base"], event_server["key"]
+        cred = base64.b64encode(f"{key}:".encode()).decode()
+        status, _ = http(
+            "POST",
+            f"{base}/events.json",
+            EVENT,
+            headers={"Authorization": f"Basic {cred}"},
+        )
+        assert status == 201
+
+    def test_invalid_event_rejected(self, event_server):
+        base, key = event_server["base"], event_server["key"]
+        bad = dict(EVENT, event="$unset", properties={})
+        bad.pop("targetEntityType")
+        bad.pop("targetEntityId")
+        status, body = http("POST", f"{base}/events.json?accessKey={key}", bad)
+        assert status == 400
+
+    def test_event_name_allowlist(self, event_server):
+        storage = event_server["storage"]
+        restricted = storage.get_metadata_access_keys().insert(
+            AccessKey("", appid=event_server["app_id"], events=["view"])
+        )
+        base = event_server["base"]
+        status, _ = http("POST", f"{base}/events.json?accessKey={restricted}", EVENT)
+        assert status == 403
+        view = dict(EVENT, event="view")
+        status, _ = http("POST", f"{base}/events.json?accessKey={restricted}", view)
+        assert status == 201
+
+    def test_batch_limit_50(self, event_server):
+        base, key = event_server["base"], event_server["key"]
+        batch = [EVENT] * 51
+        status, body = http("POST", f"{base}/batch/events.json?accessKey={key}", batch)
+        assert status == 400
+        batch = [EVENT, dict(EVENT, event="")]  # second invalid
+        status, body = http("POST", f"{base}/batch/events.json?accessKey={key}", batch)
+        assert status == 200
+        assert body[0]["status"] == 201
+        assert body[1]["status"] == 400
+
+    def test_channel_auth(self, event_server):
+        base, key = event_server["base"], event_server["key"]
+        status, _ = http(
+            "POST", f"{base}/events.json?accessKey={key}&channel=nope", EVENT
+        )
+        assert status == 401
+        commands.channel_new("EventApp", "live", storage=event_server["storage"])
+        status, _ = http(
+            "POST", f"{base}/events.json?accessKey={key}&channel=live", EVENT
+        )
+        assert status == 201
+        # channel isolation: default channel has no events
+        status, body = http("GET", f"{base}/events.json?accessKey={key}")
+        assert status == 404
+
+    def test_stats(self, event_server):
+        base, key = event_server["base"], event_server["key"]
+        http("POST", f"{base}/events.json?accessKey={key}", EVENT)
+        status, body = http("GET", f"{base}/stats.json?accessKey={key}")
+        assert status == 200
+        assert body["eventCount"]["rate"] == 1
+
+    def test_segmentio_webhook(self, event_server):
+        base, key = event_server["base"], event_server["key"]
+        payload = {
+            "version": "2",
+            "type": "track",
+            "userId": "sio-user",
+            "event": "Signed Up",
+            "properties": {"plan": "Pro"},
+            "timestamp": "2020-01-02T03:04:05.000Z",
+        }
+        status, body = http(
+            "POST", f"{base}/webhooks/segmentio.json?accessKey={key}", payload
+        )
+        assert status == 201
+        status, events = http(
+            "GET", f"{base}/events.json?accessKey={key}&entityId=sio-user"
+        )
+        assert status == 200
+        assert events[0]["event"] == "track"
+        assert events[0]["properties"]["event"] == "Signed Up"
+
+    def test_mailchimp_webhook_form(self, event_server):
+        from urllib.parse import urlencode
+
+        base, key = event_server["base"], event_server["key"]
+        form = urlencode(
+            {
+                "type": "subscribe",
+                "fired_at": "2009-03-26 21:35:57",
+                "data[id]": "8a25ff1d98",
+                "data[list_id]": "a6b5da1054",
+                "data[email]": "api@mailchimp.com",
+            }
+        ).encode()
+        status, body = http(
+            "POST", f"{base}/webhooks/mailchimp.form?accessKey={key}", form
+        )
+        assert status == 201
+        status, events = http(
+            "GET", f"{base}/events.json?accessKey={key}&entityId=8a25ff1d98"
+        )
+        assert events[0]["event"] == "subscribe"
+        assert events[0]["targetEntityId"] == "a6b5da1054"
+
+    def test_unknown_webhook(self, event_server):
+        base, key = event_server["base"], event_server["key"]
+        status, _ = http("POST", f"{base}/webhooks/unknown.json?accessKey={key}", {})
+        assert status == 404
+
+
+@pytest.fixture()
+def deployed_engine(storage):
+    """Train the recommendation engine and deploy it on a local port."""
+    import numpy as np
+
+    from predictionio_tpu.core import EngineParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.models import recommendation as rec
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    info = commands.app_new("ServeApp", storage=storage)
+    events = storage.get_events()
+    rng = np.random.default_rng(0)
+    for u in range(12):
+        for _ in range(6):
+            i = int(rng.integers(0, 8))
+            events.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                ),
+                info["id"],
+            )
+    engine = rec.engine()
+    ep = EngineParams(
+        datasource=("", rec.DataSourceParams(app_name="ServeApp")),
+        algorithms=[("als", rec.ALSAlgorithmParams(rank=4, num_iterations=3))],
+    )
+    run_train(engine, ep, engine_id="serve", storage=storage)
+    instance = storage.get_metadata_engine_instances().get_latest_completed(
+        "serve", "0", "default"
+    )
+    server = EngineServer(
+        engine, instance, storage=storage, host="127.0.0.1", port=0,
+        server_key="secret",
+    )
+    port = server.start()
+    yield {
+        "base": f"http://127.0.0.1:{port}",
+        "server": server,
+        "storage": storage,
+        "engine": engine,
+        "ep": ep,
+    }
+    server.stop()
+
+
+class TestEngineServer:
+    def test_status_page(self, deployed_engine):
+        status, body = http("GET", deployed_engine["base"] + "/")
+        assert status == 200
+        assert body["status"] == "alive"
+        assert body["requestCount"] == 0
+
+    def test_query(self, deployed_engine):
+        base = deployed_engine["base"]
+        status, body = http("POST", f"{base}/queries.json", {"user": "u1", "num": 3})
+        assert status == 200
+        assert len(body["itemScores"]) == 3
+        status, page = http("GET", base + "/")
+        assert page["requestCount"] == 1
+        assert page["lastServingSec"] > 0
+
+    def test_query_unknown_user(self, deployed_engine):
+        status, body = http(
+            "POST", deployed_engine["base"] + "/queries.json", {"user": "zz"}
+        )
+        assert status == 200 and body["itemScores"] == []
+
+    def test_bad_query(self, deployed_engine):
+        status, body = http(
+            "POST", deployed_engine["base"] + "/queries.json", [1, 2]
+        )
+        assert status == 400
+
+    def test_reload_hot_swaps_latest(self, deployed_engine):
+        from predictionio_tpu.core.workflow import run_train
+
+        base = deployed_engine["base"]
+        old_id = deployed_engine["server"].instance.id
+        # unauthorized without key
+        status, _ = http("POST", f"{base}/reload")
+        assert status == 401
+        # train a new instance, then reload with key
+        run_train(
+            deployed_engine["engine"], deployed_engine["ep"], engine_id="serve",
+            storage=deployed_engine["storage"],
+        )
+        status, _ = http("POST", f"{base}/reload?accessKey=secret")
+        assert status == 200
+        assert deployed_engine["server"].instance.id != old_id
+
+    def test_plugins_endpoint(self, deployed_engine):
+        status, body = http("GET", deployed_engine["base"] + "/plugins.json")
+        assert status == 200 and "plugins" in body
+
+
+class TestAdminServer:
+    def test_app_crud_over_http(self, storage):
+        from predictionio_tpu.server.admin_server import AdminServer
+
+        server = AdminServer(storage=storage, host="127.0.0.1", port=0)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            status, body = http("GET", base + "/")
+            assert body["status"] == "alive"
+            status, body = http("POST", f"{base}/cmd/app", {"name": "AdminApp"})
+            assert status == 200 and body["status"] == 1 and body["accessKey"]
+            status, body = http("GET", f"{base}/cmd/app")
+            assert [a["name"] for a in body["apps"]] == ["AdminApp"]
+            status, body = http("POST", f"{base}/cmd/app", {"name": "AdminApp"})
+            assert status == 400
+            status, body = http("DELETE", f"{base}/cmd/app/AdminApp/data")
+            assert body["status"] == 1
+            status, body = http("DELETE", f"{base}/cmd/app/AdminApp")
+            assert body["status"] == 1
+            status, body = http("GET", f"{base}/cmd/app")
+            assert body["apps"] == []
+        finally:
+            server.stop()
+
+
+class TestFeedbackLoop:
+    def test_predict_event_posted_back(self, storage):
+        """Deploy with feedback: a query must produce a pio_pr predict
+        event in the event store (reference CreateServer.scala:514-577)."""
+        import time
+
+        from predictionio_tpu.server.event_server import EventServer
+
+        # reuse deployed_engine wiring manually to control feedback flags
+        import numpy as np
+
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.models import recommendation as rec
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        info = commands.app_new("FbApp", storage=storage)
+        for u in range(6):
+            for i in range(4):
+                storage.get_events().insert(
+                    Event(
+                        event="rate", entity_type="user", entity_id=f"u{u}",
+                        target_entity_type="item", target_entity_id=f"i{i}",
+                        properties={"rating": float((u + i) % 5 + 1)},
+                    ),
+                    info["id"],
+                )
+        es = EventServer(storage=storage, host="127.0.0.1", port=0)
+        es_port = es.start()
+        engine = rec.engine()
+        ep = EngineParams(
+            datasource=("", rec.DataSourceParams(app_name="FbApp")),
+            algorithms=[("als", rec.ALSAlgorithmParams(rank=2, num_iterations=2))],
+        )
+        run_train(engine, ep, engine_id="fb", storage=storage)
+        instance = storage.get_metadata_engine_instances().get_latest_completed(
+            "fb", "0", "default"
+        )
+        server = EngineServer(
+            engine, instance, storage=storage, host="127.0.0.1", port=0,
+            feedback=True,
+            event_server_url=f"http://127.0.0.1:{es_port}",
+            access_key=info["access_key"],
+        )
+        port = server.start()
+        try:
+            status, body = http(
+                "POST", f"http://127.0.0.1:{port}/queries.json", {"user": "u1"}
+            )
+            assert status == 200 and body["prId"]
+            deadline = time.time() + 5
+            feedback_events = []
+            while time.time() < deadline and not feedback_events:
+                feedback_events = storage.get_events().find(
+                    info["id"], entity_type="pio_pr"
+                )
+                time.sleep(0.05)
+            assert feedback_events, "no feedback event arrived"
+            fe = feedback_events[0]
+            assert fe.event == "predict"
+            assert fe.pr_id == body["prId"]
+            assert fe.properties["query"]["user"] == "u1"
+        finally:
+            server.stop()
+            es.stop()
