@@ -1,0 +1,6 @@
+"""e2: reusable engine-building helpers.
+
+Capability parity with the reference ``e2/`` module
+(e2/src/main/scala/org/apache/predictionio/e2/): CategoricalNaiveBayes,
+MarkovChain, BinaryVectorizer, and the k-fold cross-validation splitter.
+"""

@@ -1,0 +1,43 @@
+"""One-hot encoder over (property, value) pairs.
+
+Capability parity with the reference BinaryVectorizer
+(e2/.../engine/BinaryVectorizer.scala:47-90): fit collects the distinct
+(property, value) pairs of the selected properties into a stable index;
+transform produces a dense 0/1 vector.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+
+@dataclass
+class BinaryVectorizer:
+    index: dict[tuple[str, str], int]
+
+    @property
+    def num_features(self) -> int:
+        return len(self.index)
+
+    @staticmethod
+    def fit(
+        maps: Iterable[Mapping[str, str]], properties: Sequence[str]
+    ) -> "BinaryVectorizer":
+        pairs: dict[tuple[str, str], int] = {}
+        wanted = set(properties)
+        for m in maps:
+            for k, v in m.items():
+                if k in wanted and (k, str(v)) not in pairs:
+                    pairs[(k, str(v))] = len(pairs)
+        return BinaryVectorizer(index=pairs)
+
+    def to_vector(self, m: Mapping[str, str]) -> np.ndarray:
+        vec = np.zeros(len(self.index), dtype=np.float32)
+        for k, v in m.items():
+            ix = self.index.get((k, str(v)))
+            if ix is not None:
+                vec[ix] = 1.0
+        return vec

@@ -1,0 +1,30 @@
+"""k-fold cross-validation splitter.
+
+Capability parity with the reference CommonHelperFunctions.splitData
+(e2/.../evaluation/CrossValidation.scala:36-66): element i belongs to
+eval fold ``i % k``; each fold yields (training subset, fold info,
+eval subset).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def split_data(
+    k: int,
+    data: Sequence[T],
+    make_training: Callable[[list[T]], Any] = list,
+    make_qa: Callable[[T], Any] = lambda x: x,
+) -> list[tuple[Any, dict, list[Any]]]:
+    """Returns k folds of (training_data, {"fold": i}, eval_points)."""
+    if k < 2:
+        raise ValueError("k must be >= 2")
+    folds = []
+    for fold in range(k):
+        train = [x for i, x in enumerate(data) if i % k != fold]
+        evals = [make_qa(x) for i, x in enumerate(data) if i % k == fold]
+        folds.append((make_training(train), {"fold": fold}, evals))
+    return folds

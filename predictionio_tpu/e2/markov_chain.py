@@ -1,0 +1,60 @@
+"""Markov chain over a sparse transition-count matrix.
+
+Capability parity with the reference MarkovChain
+(e2/.../engine/MarkovChain.scala:26-88): train keeps the top-N outgoing
+transitions per state, row-normalized; predict multiplies a state
+distribution by the transition matrix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclass
+class MarkovChainModel:
+    n_states: int
+    top_n: int
+    # per state: (next_state_ids, probabilities), both [<=top_n]
+    transitions: list[tuple[np.ndarray, np.ndarray]]
+
+    def predict(self, current: Sequence[float]) -> np.ndarray:
+        """Next-state distribution: current(row vector) . P."""
+        current = np.asarray(current, dtype=np.float64)
+        if current.shape != (self.n_states,):
+            raise ValueError(f"expected state vector of length {self.n_states}")
+        out = np.zeros(self.n_states)
+        for state, weight in enumerate(current):
+            if weight == 0.0:
+                continue
+            ids, probs = self.transitions[state]
+            out[ids] += weight * probs
+        return out
+
+    def transition_prob(self, i: int, j: int) -> float:
+        ids, probs = self.transitions[i]
+        hits = probs[ids == j]
+        return float(hits[0]) if len(hits) else 0.0
+
+
+def train(
+    counts: Sequence[tuple[int, int, float]], n_states: int, top_n: int
+) -> MarkovChainModel:
+    """counts: (from_state, to_state, count) coordinate entries."""
+    by_row: dict[int, dict[int, float]] = {}
+    for i, j, c in counts:
+        by_row.setdefault(int(i), {})[int(j)] = by_row.get(int(i), {}).get(int(j), 0.0) + float(c)
+    transitions: list[tuple[np.ndarray, np.ndarray]] = []
+    for state in range(n_states):
+        row = by_row.get(state, {})
+        if not row:
+            transitions.append((np.array([], np.int64), np.array([], np.float64)))
+            continue
+        items = sorted(row.items(), key=lambda kv: -kv[1])[:top_n]
+        ids = np.array([j for j, _ in items], dtype=np.int64)
+        vals = np.array([c for _, c in items], dtype=np.float64)
+        transitions.append((ids, vals / vals.sum()))
+    return MarkovChainModel(n_states=n_states, top_n=top_n, transitions=transitions)

@@ -1,0 +1,105 @@
+"""Categorical Naive Bayes over string-valued features.
+
+Capability parity with the reference CategoricalNaiveBayes
+(e2/.../engine/CategoricalNaiveBayes.scala:24-173): labeled points whose
+features are categorical strings per position; the model exposes
+``predict`` (most likely label) and ``log_score`` with an optional
+default for feature values unseen at training time.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Sequence
+
+
+@dataclass(frozen=True)
+class LabeledPoint:
+    label: str
+    features: tuple[str, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "features", tuple(self.features))
+
+
+@dataclass
+class CategoricalNaiveBayesModel:
+    priors: dict[str, float]  # label -> log prior
+    likelihoods: dict[str, list[dict[str, float]]]  # label -> per-pos log P(v|l)
+
+    def log_score(
+        self,
+        point: LabeledPoint,
+        default_likelihood: Callable[[Iterable[float]], float] | None = None,
+    ) -> float | None:
+        """Log joint score of point under its label; None when the label is
+        unknown or a feature value is unseen and no default is given
+        (reference logScore, CategoricalNaiveBayes.scala:88-130)."""
+        if point.label not in self.priors:
+            return None
+        like = self.likelihoods[point.label]
+        if len(point.features) != len(like):
+            raise ValueError(
+                f"point has {len(point.features)} features; model expects {len(like)}"
+            )
+        total = self.priors[point.label]
+        for pos, value in enumerate(point.features):
+            if value in like[pos]:
+                total += like[pos][value]
+            elif default_likelihood is not None:
+                total += default_likelihood(like[pos].values())
+            else:
+                return None
+        return total
+
+    def predict(self, features: Sequence[str]) -> str:
+        """Most likely label; unseen feature values score -inf for that
+        label (reference predict:140-149 with the default NegativeInfinity
+        likelihood)."""
+        best_label, best_score = None, -math.inf
+        for label in sorted(self.priors):
+            like = self.likelihoods[label]
+            score = self.priors[label]
+            for pos, value in enumerate(features):
+                score += like[pos].get(value, -math.inf)
+            if best_label is None or score > best_score:
+                best_label, best_score = label, score
+        if best_label is None:
+            raise ValueError("model has no labels")
+        return best_label
+
+
+def train(points: Iterable[LabeledPoint]) -> CategoricalNaiveBayesModel:
+    """Count-based fit (reference object CategoricalNaiveBayes.train:24-86,
+    combineByKey label/feature counting -> log-likelihoods)."""
+    points = list(points)
+    if not points:
+        raise ValueError("cannot train on zero points")
+    n_features = len(points[0].features)
+    label_counts: dict[str, int] = defaultdict(int)
+    # label -> position -> value -> count
+    value_counts: dict[str, list[dict[str, int]]] = {}
+    for p in points:
+        if len(p.features) != n_features:
+            raise ValueError("inconsistent feature arity")
+        label_counts[p.label] += 1
+        per_pos = value_counts.setdefault(
+            p.label, [defaultdict(int) for _ in range(n_features)]
+        )
+        for pos, v in enumerate(p.features):
+            per_pos[pos][v] += 1
+
+    total = len(points)
+    priors = {
+        label: math.log(count / total) for label, count in label_counts.items()
+    }
+    likelihoods: dict[str, list[dict[str, float]]] = {}
+    for label, per_pos in value_counts.items():
+        denom = label_counts[label]
+        likelihoods[label] = [
+            {v: math.log(c / denom) for v, c in pos_map.items()}
+            for pos_map in per_pos
+        ]
+    return CategoricalNaiveBayesModel(priors=priors, likelihoods=likelihoods)

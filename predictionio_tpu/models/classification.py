@@ -1,0 +1,193 @@
+"""Classification engine template: Naive Bayes over entity attributes.
+
+Capability parity with the reference template
+``examples/scala-parallel-classification/add-algorithm``:
+
+- DataSource reads ``$set`` user entities carrying numeric attributes
+  (``attr0``/``attr1``/``attr2`` by default) and a ``plan`` label
+  (DataSource.scala) via the aggregated-properties view,
+- NaiveBayesAlgorithm trains MLlib multinomial NB with lambda smoothing
+  (NaiveBayesAlgorithm.scala:33-37) — here the jit multinomial NB in
+  ``predictionio_tpu.ops.naive_bayes``,
+- the add-algorithm variant registers a second algorithm under a named
+  key ("naive"/"randomforest"); here the second algorithm is a
+  CategoricalNaiveBayes over discretized attributes, exercising the same
+  multi-algorithm engine mechanics.
+
+Query: ``{"features": [d, d, d]}`` -> ``{"label": d}``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.e2 import naive_bayes as categorical_nb
+from predictionio_tpu.ops import naive_bayes as nb_ops
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Query:
+    features: list[float] = field(default_factory=list)
+
+
+@dataclass
+class PredictedResult:
+    label: float = 0.0
+
+
+@dataclass
+class DataSourceParams(Params):
+    app_name: str = ""
+    attribute_names: tuple[str, ...] = ("attr0", "attr1", "attr2")
+    label_name: str = "plan"
+    entity_type: str = "user"
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    labels: np.ndarray = field(default_factory=lambda: np.zeros(0))
+    features: np.ndarray = field(default_factory=lambda: np.zeros((0, 0)))
+
+    def sanity_check(self) -> None:
+        if len(self.labels) == 0:
+            raise ValueError("TrainingData has no labeled points")
+
+
+class ClassificationDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        props = store.aggregate_properties(
+            app_name=self.params.app_name,
+            entity_type=self.params.entity_type,
+            required=list(self.params.attribute_names) + [self.params.label_name],
+        )
+        labels, rows = [], []
+        for entity_id, pm in props.items():
+            try:
+                labels.append(pm.get_double(self.params.label_name))
+                rows.append([pm.get_double(a) for a in self.params.attribute_names])
+            except Exception:
+                logger.warning("skipping entity %s with malformed attributes", entity_id)
+        return TrainingData(
+            labels=np.asarray(labels, dtype=np.float32),
+            features=np.asarray(rows, dtype=np.float32).reshape(
+                len(rows), len(self.params.attribute_names)
+            ),
+        )
+
+    def read_eval(self, ctx: WorkflowContext):
+        from predictionio_tpu.e2.cross_validation import split_data
+
+        td = self.read_training(ctx)
+        points = list(zip(td.labels.tolist(), td.features.tolist()))
+
+        def make_training(subset):
+            return TrainingData(
+                labels=np.asarray([l for l, _ in subset], dtype=np.float32),
+                features=np.asarray([f for _, f in subset], dtype=np.float32),
+            )
+
+        def make_qa(point):
+            label, feats = point
+            return (Query(features=list(feats)), label)
+
+        return split_data(3, points, make_training, make_qa)
+
+
+@dataclass
+class NaiveBayesParams(Params):
+    lambda_: float = 1.0
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    params_class = NaiveBayesParams
+    query_class = Query
+
+    def train(self, ctx: WorkflowContext, td: TrainingData) -> nb_ops.NaiveBayesModel:
+        return nb_ops.train(td.labels, td.features, lambda_=self.params.lambda_)
+
+    def predict(self, model: nb_ops.NaiveBayesModel, query: Query) -> PredictedResult:
+        label = nb_ops.predict(model, np.asarray(query.features, dtype=np.float32))
+        return PredictedResult(label=float(label))
+
+    def batch_predict(self, model, queries):
+        feats = np.asarray([q.features for _, q in queries], dtype=np.float32)
+        if len(feats) == 0:
+            return []
+        labels = nb_ops.predict(model, feats)
+        return [
+            (ix, PredictedResult(label=float(l)))
+            for (ix, _), l in zip(queries, np.atleast_1d(labels))
+        ]
+
+
+@dataclass
+class CategoricalNBParams(Params):
+    bins: int = 4
+
+
+class CategoricalNBAlgorithm(Algorithm):
+    """Second algorithm for the add-algorithm variant: discretizes numeric
+    attributes into bins and runs the e2 CategoricalNaiveBayes."""
+
+    params_class = CategoricalNBParams
+    query_class = Query
+
+    def _bin_edges(self, features: np.ndarray) -> np.ndarray:
+        lo, hi = features.min(axis=0), features.max(axis=0)
+        return np.linspace(lo, hi, self.params.bins + 1)[1:-1]  # interior edges
+
+    def train(self, ctx: WorkflowContext, td: TrainingData):
+        edges = self._bin_edges(td.features)
+        points = [
+            categorical_nb.LabeledPoint(
+                label=str(label),
+                features=tuple(
+                    str(int(np.searchsorted(edges[:, j], row[j])))
+                    for j in range(td.features.shape[1])
+                ),
+            )
+            for label, row in zip(td.labels, td.features)
+        ]
+        model = categorical_nb.train(points)
+        return {"model": model, "edges": edges}
+
+    def predict(self, bundle, query: Query) -> PredictedResult:
+        edges = bundle["edges"]
+        feats = tuple(
+            str(int(np.searchsorted(edges[:, j], v)))
+            for j, v in enumerate(query.features)
+        )
+        return PredictedResult(label=float(bundle["model"].predict(feats)))
+
+
+def engine() -> Engine:
+    """Reference ClassificationEngine factory (add-algorithm Engine.scala:
+    Map("naive" -> NaiveBayesAlgorithm, "randomforest" -> ...))."""
+    return Engine(
+        datasource_classes=ClassificationDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={
+            "naive": NaiveBayesAlgorithm,
+            "categorical": CategoricalNBAlgorithm,
+        },
+        serving_classes=FirstServing,
+    )

@@ -1,0 +1,339 @@
+"""E-commerce recommendation template: weighted implicit ALS + live
+serve-time business rules.
+
+Capability parity with the reference template
+``examples/scala-parallel-ecommercerecommendation/weighted-items``:
+
+- DataSource reads user/item ``$set`` entities and ``view``/``buy``
+  events,
+- ALSAlgorithm trains ``ALS.trainImplicit`` on view counts
+  (ALSAlgorithm.scala:136),
+- predict applies, per request: unseen-item filtering from a **live**
+  event-store read of the user's seen events, the unavailable-items
+  constraint read live from the latest ``$set`` of constraint entity
+  ``unavailableItems`` (:234-265), category/white/black-list filters,
+  and per-group item weight multipliers (:295, WeightsGroup),
+- cold-start users are scored from their recently viewed items' factor
+  vectors (predictNewUser, :332-410).
+
+TPU note: the device op is one fused score+top-k; the live business
+rules become a host-side exclusion mask built before the device call so
+the event-store read never stalls the device path mid-computation.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops import als as als_ops
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Query:
+    user: str = ""
+    num: int = 4
+    categories: list[str] | None = None
+    whiteList: list[str] | None = None
+    blackList: list[str] | None = None
+
+
+@dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass
+class PredictedResult:
+    itemScores: list[ItemScore] = field(default_factory=list)
+
+
+@dataclass
+class DataSourceParams(Params):
+    app_name: str = ""
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    users: list[str] = field(default_factory=list)
+    items: dict[str, list[str]] = field(default_factory=dict)
+    view_events: list[tuple[str, str]] = field(default_factory=list)
+    buy_events: list[tuple[str, str]] = field(default_factory=list)
+
+    def sanity_check(self) -> None:
+        if not self.view_events:
+            raise ValueError(
+                "viewEvents in TrainingData cannot be empty. Please check if "
+                "DataSource generates TrainingData correctly."
+            )
+
+
+class ECommerceDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        app = self.params.app_name
+        users = list(store.aggregate_properties(app, entity_type="user"))
+        items = {
+            iid: pm.get_opt("categories", default=[]) or []
+            for iid, pm in store.aggregate_properties(app, entity_type="item").items()
+        }
+        views = [
+            (e.entity_id, e.target_entity_id)
+            for e in store.find(
+                app, entity_type="user", event_names=["view"],
+                target_entity_type="item",
+            )
+        ]
+        buys = [
+            (e.entity_id, e.target_entity_id)
+            for e in store.find(
+                app, entity_type="user", event_names=["buy"],
+                target_entity_type="item",
+            )
+        ]
+        return TrainingData(
+            users=users, items=items, view_events=views, buy_events=buys
+        )
+
+
+@dataclass
+class WeightsGroup:
+    items: list[str] = field(default_factory=list)
+    weight: float = 1.0
+
+
+@dataclass
+class ECommAlgorithmParams(Params):
+    app_name: str = ""  # for live serve-time event reads
+    unseen_only: bool = True
+    seen_events: tuple[str, ...] = ("view", "buy")
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+    weights: list[dict] = field(default_factory=list)  # [{items, weight}]
+
+
+@dataclass
+class ECommModel:
+    user_index: BiMap
+    item_index: BiMap
+    user_factors: np.ndarray
+    item_factors: np.ndarray
+    categories: dict[str, list[str]]
+
+    def __post_init__(self):
+        self._device = None
+
+    def device_factors(self):
+        if self._device is None:
+            import jax.numpy as jnp
+
+            self._device = (
+                jnp.asarray(self.user_factors),
+                jnp.asarray(self.item_factors),
+            )
+        return self._device
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_device"] = None
+        return state
+
+
+class ECommAlgorithm(Algorithm):
+    params_class = ECommAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx: WorkflowContext, td: TrainingData) -> ECommModel:
+        counts: dict[tuple[str, str], float] = defaultdict(float)
+        for u, i in td.view_events:
+            counts[(u, i)] += 1.0
+        if not counts:
+            raise ValueError("cannot train on zero view events")
+        ratings = [(u, i, c) for (u, i), c in counts.items()]
+        user_index = BiMap.string_int(u for u, _, _ in ratings)
+        item_index = BiMap.string_int(list(td.items) + [i for _, i, _ in ratings])
+        rows = user_index.to_index_array([u for u, _, _ in ratings])
+        cols = item_index.to_index_array([i for _, i, _ in ratings])
+        vals = np.asarray([c for _, _, c in ratings], dtype=np.float32)
+        data = als_ops.build_ratings_data(
+            rows, cols, vals, len(user_index), len(item_index)
+        )
+        U, V = als_ops.als_train(
+            data,
+            als_ops.ALSParams(
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                reg=self.params.lambda_,
+                implicit=True,
+                alpha=self.params.alpha,
+                seed=self.params.seed,
+            ),
+        )
+        return ECommModel(
+            user_index=user_index,
+            item_index=item_index,
+            user_factors=np.asarray(U),
+            item_factors=np.asarray(V),
+            categories=dict(td.items),
+        )
+
+    # -- live business rules (host-side, before the device call) ----------
+    def _seen_items(self, user: str) -> set[str]:
+        """Live read of the user's seen events (reference :234-249)."""
+        try:
+            events = store.find_by_entity(
+                app_name=self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.seen_events),
+                target_entity_type="item",
+                limit=None,
+            )
+        except Exception:
+            logger.exception("seen-items read failed; serving without filter")
+            return set()
+        return {e.target_entity_id for e in events if e.target_entity_id}
+
+    def _unavailable_items(self) -> set[str]:
+        """Live read of the latest unavailableItems constraint
+        (reference :250-265)."""
+        try:
+            events = store.find_by_entity(
+                app_name=self.params.app_name,
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                event_names=["$set"],
+                limit=1,
+                latest=True,
+            )
+        except Exception:
+            logger.exception("constraint read failed; serving without filter")
+            return set()
+        if not events:
+            return set()
+        return set(events[0].properties.get_opt("items", default=[]) or [])
+
+    def _recent_item_vector(self, model: ECommModel, user: str):
+        """Cold-start: mean factor vector of recently viewed items
+        (reference predictNewUser :332-410)."""
+        try:
+            events = store.find_by_entity(
+                app_name=self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=["view"],
+                target_entity_type="item",
+                limit=10,
+                latest=True,
+            )
+        except Exception:
+            return None
+        ixs = [
+            model.item_index[e.target_entity_id]
+            for e in events
+            if e.target_entity_id in model.item_index
+        ]
+        if not ixs:
+            return None
+        return model.item_factors[ixs].mean(axis=0)
+
+    def _mask_and_weights(
+        self, model: ECommModel, query: Query
+    ) -> tuple[np.ndarray, np.ndarray]:
+        n = len(model.item_index)
+        mask = np.zeros(n, dtype=bool)
+        if query.whiteList is not None:
+            allowed = {
+                model.item_index[i] for i in query.whiteList if i in model.item_index
+            }
+            mask |= ~np.isin(np.arange(n), list(allowed))
+        for iid in query.blackList or []:
+            if iid in model.item_index:
+                mask[model.item_index[iid]] = True
+        if query.categories is not None:
+            wanted = set(query.categories)
+            for iid, ix in model.item_index.items():
+                if not wanted.intersection(model.categories.get(iid, ())):
+                    mask[ix] = True
+        for iid in self._unavailable_items():
+            if iid in model.item_index:
+                mask[model.item_index[iid]] = True
+        if self.params.unseen_only:
+            for iid in self._seen_items(query.user):
+                if iid in model.item_index:
+                    mask[model.item_index[iid]] = True
+
+        weights = np.ones(n, dtype=np.float32)
+        for group in self.params.weights:
+            w = float(group.get("weight", 1.0))
+            for iid in group.get("items", []):
+                if iid in model.item_index:
+                    weights[model.item_index[iid]] = w
+        return mask, weights
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.topk import top_k_items
+
+        U, V = model.device_factors()
+        known = query.user in model.user_index
+        if known:
+            user_vec = U[model.user_index[query.user]]
+        else:
+            recent = self._recent_item_vector(model, query.user)
+            if recent is None:
+                logger.info(
+                    "user %s has no factors and no recent views; empty result",
+                    query.user,
+                )
+                return PredictedResult(itemScores=[])
+            user_vec = jnp.asarray(recent)
+
+        mask, weights = self._mask_and_weights(model, query)
+        scores, ids = top_k_items(
+            user_vec,
+            V * jnp.asarray(weights)[:, None],
+            k=int(query.num),
+            exclude_mask=jnp.asarray(mask),
+        )
+        inv = model.item_index.inverse
+        return PredictedResult(
+            itemScores=[
+                ItemScore(item=inv[int(i)], score=float(s))
+                for s, i in zip(np.asarray(scores), np.asarray(ids))
+                if s > -1e29
+            ]
+        )
+
+
+def engine() -> Engine:
+    """Reference ECommerceRecommendationEngine factory."""
+    return Engine(
+        datasource_classes=ECommerceDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"als": ECommAlgorithm},
+        serving_classes=FirstServing,
+    )

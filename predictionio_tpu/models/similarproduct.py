@@ -1,0 +1,274 @@
+"""Similar-product engine template: implicit ALS + item-item cosine.
+
+Capability parity with the reference template
+``examples/scala-parallel-similarproduct/multi``:
+
+- DataSource reads ``$set`` user/item entities (items carry
+  ``categories``) plus ``view`` and ``like``/``dislike`` events,
+- ALSAlgorithm trains MLlib ``ALS.trainImplicit`` on view counts and
+  scores candidate items by summed cosine similarity against the query
+  items' factor vectors (ALSAlgorithm.scala:147,193,244),
+- LikeAlgorithm (the "multi" variant's second algorithm) trains on
+  like=1 / dislike=-1 signals (LikeAlgorithm.scala),
+- Serving sums per-item scores across algorithms and re-ranks (the
+  multi variant's Serving.scala).
+
+Query: ``{"items": [...], "num": N, "categories": [...]?,
+"whiteList": [...]?, "blackList": [...]?}`` ->
+``{"itemScores": [{"item": ..., "score": ...}]}``.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    IdentityPreparator,
+    Params,
+    SanityCheck,
+    Serving,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops import als as als_ops
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Query:
+    items: list[str] = field(default_factory=list)
+    num: int = 4
+    categories: list[str] | None = None
+    whiteList: list[str] | None = None
+    blackList: list[str] | None = None
+
+
+@dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass
+class PredictedResult:
+    itemScores: list[ItemScore] = field(default_factory=list)
+
+
+@dataclass
+class DataSourceParams(Params):
+    app_name: str = ""
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    users: list[str] = field(default_factory=list)
+    items: dict[str, list[str]] = field(default_factory=dict)  # id -> categories
+    view_events: list[tuple[str, str]] = field(default_factory=list)
+    like_events: list[tuple[str, str, bool]] = field(default_factory=list)
+
+    def sanity_check(self) -> None:
+        if not self.view_events and not self.like_events:
+            raise ValueError("TrainingData has no view/like events")
+
+
+class SimilarProductDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        app = self.params.app_name
+        users = list(store.aggregate_properties(app, entity_type="user"))
+        item_props = store.aggregate_properties(app, entity_type="item")
+        items = {
+            iid: pm.get_opt("categories", default=[]) or []
+            for iid, pm in item_props.items()
+        }
+        views = [
+            (e.entity_id, e.target_entity_id)
+            for e in store.find(
+                app, entity_type="user", event_names=["view"],
+                target_entity_type="item",
+            )
+        ]
+        likes = [
+            (e.entity_id, e.target_entity_id, e.event == "like")
+            for e in store.find(
+                app, entity_type="user", event_names=["like", "dislike"],
+                target_entity_type="item",
+            )
+        ]
+        return TrainingData(
+            users=users, items=items, view_events=views, like_events=likes
+        )
+
+
+@dataclass
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+
+
+@dataclass
+class SimilarProductModel:
+    item_index: BiMap
+    item_factors: np.ndarray  # [I, D]
+    categories: dict[str, list[str]]
+
+    def __post_init__(self):
+        self._device = None
+
+    def device_factors(self):
+        if self._device is None:
+            import jax.numpy as jnp
+
+            norms = np.linalg.norm(self.item_factors, axis=1, keepdims=True)
+            normalized = self.item_factors / np.maximum(norms, 1e-12)
+            self._device = jnp.asarray(normalized)
+        return self._device
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_device"] = None
+        return state
+
+
+def _exclude_mask(model: SimilarProductModel, query: Query) -> np.ndarray | None:
+    """Build the candidate-exclusion mask from query items, category,
+    white/black lists (reference ALSAlgorithm.scala:193-244 filters)."""
+    n = len(model.item_index)
+    mask = np.zeros(n, dtype=bool)
+    for iid in query.items:  # never recommend the query items themselves
+        if iid in model.item_index:
+            mask[model.item_index[iid]] = True
+    if query.whiteList is not None:
+        allowed = {
+            model.item_index[i] for i in query.whiteList if i in model.item_index
+        }
+        mask |= ~np.isin(np.arange(n), list(allowed))
+    if query.blackList:
+        for iid in query.blackList:
+            if iid in model.item_index:
+                mask[model.item_index[iid]] = True
+    if query.categories is not None:
+        wanted = set(query.categories)
+        for iid, ix in model.item_index.items():
+            if not wanted.intersection(model.categories.get(iid, ())):
+                mask[ix] = True
+    return mask
+
+
+def _score_similar(model: SimilarProductModel, query: Query) -> PredictedResult:
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.topk import top_k_items
+
+    known = [model.item_index[i] for i in query.items if i in model.item_index]
+    if not known:
+        logger.info("no query items with factors; returning empty result")
+        return PredictedResult(itemScores=[])
+    V = model.device_factors()  # row-normalized: dot == cosine
+    query_vec = V[jnp.asarray(np.asarray(known, dtype=np.int32))].sum(axis=0)
+    mask = _exclude_mask(model, query)
+    scores, ids = top_k_items(
+        query_vec, V, k=int(query.num), exclude_mask=jnp.asarray(mask)
+    )
+    inv = model.item_index.inverse
+    return PredictedResult(
+        itemScores=[
+            ItemScore(item=inv[int(i)], score=float(s))
+            for s, i in zip(np.asarray(scores), np.asarray(ids))
+            if s > -1e29  # drop fully-masked placeholders
+        ]
+    )
+
+
+class ALSAlgorithm(Algorithm):
+    """Implicit ALS on view counts; cosine item-item scoring."""
+
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def _ratings(self, td: TrainingData) -> list[tuple[str, str, float]]:
+        counts: dict[tuple[str, str], float] = defaultdict(float)
+        for u, i in td.view_events:
+            counts[(u, i)] += 1.0
+        return [(u, i, c) for (u, i), c in counts.items()]
+
+    def train(self, ctx: WorkflowContext, td: TrainingData) -> SimilarProductModel:
+        ratings = self._ratings(td)
+        if not ratings:
+            raise ValueError("cannot train on zero events")
+        user_index = BiMap.string_int(u for u, _, _ in ratings)
+        item_index = BiMap.string_int(list(td.items) + [i for _, i, _ in ratings])
+        rows = user_index.to_index_array([u for u, _, _ in ratings])
+        cols = item_index.to_index_array([i for _, i, _ in ratings])
+        vals = np.asarray([c for _, _, c in ratings], dtype=np.float32)
+        data = als_ops.build_ratings_data(
+            rows, cols, vals, len(user_index), len(item_index)
+        )
+        params = als_ops.ALSParams(
+            rank=self.params.rank,
+            iterations=self.params.num_iterations,
+            reg=self.params.lambda_,
+            implicit=True,
+            alpha=self.params.alpha,
+            seed=self.params.seed,
+        )
+        _, V = als_ops.als_train(data, params)
+        return SimilarProductModel(
+            item_index=item_index,
+            item_factors=np.asarray(V),
+            categories=dict(td.items),
+        )
+
+    def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
+        return _score_similar(model, query)
+
+
+class LikeAlgorithm(ALSAlgorithm):
+    """like=1 / dislike=-1 signal instead of view counts
+    (reference multi/LikeAlgorithm.scala: latest like/dislike wins)."""
+
+    def _ratings(self, td: TrainingData) -> list[tuple[str, str, float]]:
+        latest: dict[tuple[str, str], float] = {}
+        for u, i, is_like in td.like_events:  # events are time-ordered
+            latest[(u, i)] = 1.0 if is_like else -1.0
+        return [(u, i, v) for (u, i), v in latest.items()]
+
+
+class SumScoreServing(Serving):
+    """Combines algorithms by summing per-item scores and re-ranking
+    (reference multi/Serving.scala)."""
+
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        combined: dict[str, float] = defaultdict(float)
+        for p in predictions:
+            for item_score in p.itemScores:
+                combined[item_score.item] += item_score.score
+        ranked = sorted(combined.items(), key=lambda kv: -kv[1])[: query.num]
+        return PredictedResult(
+            itemScores=[ItemScore(item=i, score=s) for i, s in ranked]
+        )
+
+
+def engine() -> Engine:
+    """Reference SimilarProductEngine factory (multi/Engine.scala:
+    Map("als" -> ALSAlgorithm, "likealgo" -> LikeAlgorithm))."""
+    return Engine(
+        datasource_classes=SimilarProductDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+        serving_classes=SumScoreServing,
+    )

@@ -238,8 +238,9 @@ class EngineServer:
         def stop(request: Request) -> Response:
             if not server._auth_control(request):
                 return Response.error("Invalid accessKey.", 401)
-            threading.Thread(target=server.stop, daemon=True).start()
-            return Response.json({"message": "Shutting down..."})
+            response = Response.json({"message": "Shutting down..."})
+            response.after_send = server.stop  # runs after the bytes flush
+            return response
 
         @router.route("GET", "/plugins.json")
         def plugins_route(request: Request) -> Response:

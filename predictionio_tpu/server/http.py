@@ -59,6 +59,9 @@ class Response:
     status: int = 200
     body: Any = None  # JSON-serializable, or (content_type, bytes)
     headers: dict[str, str] = field(default_factory=dict)
+    # invoked after the response bytes are written — lets a /stop route
+    # shut the server down without racing its own response flush
+    after_send: "Callable[[], None] | None" = None
 
     @staticmethod
     def json(obj: Any, status: int = 200) -> "Response":
@@ -168,6 +171,11 @@ class HTTPApp:
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(payload)
+                self.wfile.flush()
+                if response.after_send is not None:
+                    threading.Thread(
+                        target=response.after_send, daemon=True
+                    ).start()
 
             do_GET = do_POST = do_DELETE = do_PUT = _handle
 

@@ -1,0 +1,123 @@
+"""e2 helper-lib tests (mirrors reference e2 suites: NaiveBayesTest,
+MarkovChainTest, BinaryVectorizerTest, CrossValidationTest)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import binary_vectorizer, cross_validation, markov_chain
+from predictionio_tpu.e2 import naive_bayes as cnb
+from predictionio_tpu.ops import naive_bayes as nb_ops
+
+
+class TestCategoricalNaiveBayes:
+    POINTS = [
+        cnb.LabeledPoint("spam", ("free", "money")),
+        cnb.LabeledPoint("spam", ("free", "offer")),
+        cnb.LabeledPoint("ham", ("hello", "friend")),
+        cnb.LabeledPoint("ham", ("hello", "money")),
+    ]
+
+    def test_priors_and_likelihoods(self):
+        model = cnb.train(self.POINTS)
+        assert model.priors["spam"] == pytest.approx(math.log(0.5))
+        assert model.likelihoods["spam"][0]["free"] == pytest.approx(math.log(1.0))
+        assert model.likelihoods["ham"][1]["money"] == pytest.approx(math.log(0.5))
+
+    def test_predict(self):
+        model = cnb.train(self.POINTS)
+        assert model.predict(("free", "money")) == "spam"
+        assert model.predict(("hello", "friend")) == "ham"
+
+    def test_log_score_unseen_value(self):
+        model = cnb.train(self.POINTS)
+        point = cnb.LabeledPoint("spam", ("UNSEEN", "money"))
+        assert model.log_score(point) is None
+        scored = model.log_score(point, default_likelihood=lambda vals: math.log(1e-3))
+        assert scored is not None and scored < math.log(1e-3)
+
+    def test_unknown_label(self):
+        model = cnb.train(self.POINTS)
+        assert model.log_score(cnb.LabeledPoint("other", ("free", "money"))) is None
+
+
+class TestMultinomialNB:
+    def test_separates_classes(self):
+        rng = np.random.default_rng(0)
+        # class 0 heavy on feature 0, class 1 heavy on feature 2
+        n = 200
+        labels = np.repeat([0.0, 1.0], n // 2)
+        f0 = rng.poisson([8, 1, 1], (n // 2, 3))
+        f1 = rng.poisson([1, 1, 8], (n // 2, 3))
+        feats = np.vstack([f0, f1]).astype(np.float32)
+        model = nb_ops.train(labels, feats, lambda_=1.0)
+        preds = nb_ops.predict(model, feats)
+        assert (preds == labels).mean() > 0.95
+        # single query path
+        assert nb_ops.predict(model, np.array([9.0, 1.0, 0.0])) == 0.0
+        assert nb_ops.predict(model, np.array([0.0, 1.0, 9.0])) == 1.0
+
+    def test_negative_features_rejected(self):
+        with pytest.raises(ValueError):
+            nb_ops.train(np.array([0.0]), np.array([[-1.0]]))
+
+    def test_smoothing_matches_closed_form(self):
+        labels = np.array([0.0, 1.0])
+        feats = np.array([[2.0, 0.0], [0.0, 2.0]], dtype=np.float32)
+        model = nb_ops.train(labels, feats, lambda_=1.0)
+        # theta[0] = log([(2+1)/(2+2), (0+1)/(2+2)])
+        np.testing.assert_allclose(
+            np.exp(model.theta[0]), [3 / 4, 1 / 4], rtol=1e-5
+        )
+        np.testing.assert_allclose(np.exp(model.pi), [0.5, 0.5], rtol=1e-5)
+
+
+class TestMarkovChain:
+    def test_topn_row_normalization(self):
+        counts = [(0, 1, 8.0), (0, 2, 2.0), (0, 3, 1.0), (1, 0, 5.0)]
+        model = markov_chain.train(counts, n_states=4, top_n=2)
+        # state 0 keeps top-2 (1 and 2), normalized 0.8/0.2
+        assert model.transition_prob(0, 1) == pytest.approx(0.8)
+        assert model.transition_prob(0, 2) == pytest.approx(0.2)
+        assert model.transition_prob(0, 3) == 0.0
+        assert model.transition_prob(1, 0) == pytest.approx(1.0)
+
+    def test_predict_distribution(self):
+        counts = [(0, 1, 1.0), (1, 2, 1.0)]
+        model = markov_chain.train(counts, n_states=3, top_n=5)
+        out = model.predict([1.0, 0.0, 0.0])
+        np.testing.assert_allclose(out, [0.0, 1.0, 0.0])
+        out2 = model.predict(out)
+        np.testing.assert_allclose(out2, [0.0, 0.0, 1.0])
+
+
+class TestBinaryVectorizer:
+    def test_fit_transform(self):
+        maps = [
+            {"color": "red", "size": "L", "junk": "x"},
+            {"color": "blue", "size": "L"},
+        ]
+        vec = binary_vectorizer.BinaryVectorizer.fit(maps, ["color", "size"])
+        assert vec.num_features == 3  # red, L, blue
+        v = vec.to_vector({"color": "red", "size": "L"})
+        assert v.sum() == 2.0
+        v2 = vec.to_vector({"color": "green"})  # unseen -> all zeros
+        assert v2.sum() == 0.0
+
+
+class TestSplitData:
+    def test_three_folds_partition(self):
+        data = list(range(10))
+        folds = cross_validation.split_data(3, data)
+        assert len(folds) == 3
+        all_eval = [x for _, _, evals in folds for x in evals]
+        assert sorted(all_eval) == data  # every point evaluated exactly once
+        for train, info, evals in folds:
+            assert sorted(train + evals) == data
+
+    def test_k_less_than_2_rejected(self):
+        with pytest.raises(ValueError):
+            cross_validation.split_data(1, [1, 2])

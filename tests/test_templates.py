@@ -1,0 +1,344 @@
+"""Classification / similar-product / e-commerce template tests —
+the BASELINE.json config coverage beyond the recommendation engine."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineParams, WorkflowContext
+from predictionio_tpu.core.workflow import prepare_deploy, run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+
+CTX = WorkflowContext(mode="TemplateTest")
+
+
+def _set(entity_type, entity_id, props):
+    return Event(
+        event="$set", entity_type=entity_type, entity_id=entity_id, properties=props
+    )
+
+
+def _interaction(name, user, item):
+    return Event(
+        event=name, entity_type="user", entity_id=user,
+        target_entity_type="item", target_entity_id=item,
+    )
+
+
+class TestClassification:
+    @pytest.fixture()
+    def seeded(self, storage):
+        app_id = storage.get_metadata_apps().insert(App(0, "ClsApp"))
+        events = storage.get_events()
+        rng = np.random.default_rng(0)
+        for n in range(120):
+            label = float(n % 2)
+            if label == 0:
+                attrs = rng.poisson([6, 1, 1])
+            else:
+                attrs = rng.poisson([1, 1, 6])
+            events.insert(
+                _set(
+                    "user",
+                    f"u{n}",
+                    {
+                        "attr0": int(attrs[0]),
+                        "attr1": int(attrs[1]),
+                        "attr2": int(attrs[2]),
+                        "plan": label,
+                    },
+                ),
+                app_id,
+            )
+        return storage
+
+    def ep(self, algo="naive"):
+        from predictionio_tpu.models import classification as cls
+
+        params = (
+            cls.NaiveBayesParams(lambda_=1.0)
+            if algo == "naive"
+            else cls.CategoricalNBParams(bins=3)
+        )
+        return EngineParams(
+            datasource=("", cls.DataSourceParams(app_name="ClsApp")),
+            algorithms=[(algo, params)],
+        )
+
+    def test_train_and_predict(self, seeded):
+        from predictionio_tpu.models import classification as cls
+
+        engine = cls.engine()
+        run_train(engine, self.ep(), engine_id="cls", storage=seeded)
+        inst = seeded.get_metadata_engine_instances().get_latest_completed(
+            "cls", "0", "default"
+        )
+        _, [algo], [model], serving = prepare_deploy(engine, inst, storage=seeded)
+        q0 = cls.Query(features=[8.0, 1.0, 0.0])
+        q1 = cls.Query(features=[0.0, 1.0, 8.0])
+        assert serving.serve(q0, [algo.predict(model, q0)]).label == 0.0
+        assert serving.serve(q1, [algo.predict(model, q1)]).label == 1.0
+
+    def test_second_algorithm(self, seeded):
+        from predictionio_tpu.models import classification as cls
+
+        engine = cls.engine()
+        models = engine.train(CTX, self.ep(algo="categorical"))
+        algo = engine.make_algorithms(self.ep(algo="categorical"))[0]
+        pred = algo.predict(models[0], cls.Query(features=[8.0, 1.0, 0.0]))
+        assert pred.label in (0.0, 1.0)
+
+    def test_eval_accuracy_metric(self, seeded):
+        from predictionio_tpu.core.evaluation import MetricEvaluator
+        from predictionio_tpu.core.metrics import AverageMetric
+        from predictionio_tpu.models import classification as cls
+
+        class Accuracy(AverageMetric):
+            def calculate_point(self, q, p, a):
+                return 1.0 if p.label == a else 0.0
+
+        engine = cls.engine()
+        result = MetricEvaluator(Accuracy()).evaluate(CTX, engine, [self.ep()])
+        assert result.best_score.score > 0.8
+
+
+class TestSimilarProduct:
+    @pytest.fixture()
+    def seeded(self, storage):
+        app_id = storage.get_metadata_apps().insert(App(0, "SimApp"))
+        events = storage.get_events()
+        rng = np.random.default_rng(1)
+        for i in range(12):
+            events.insert(
+                _set("item", f"i{i}", {"categories": ["even" if i % 2 == 0 else "odd"]}),
+                app_id,
+            )
+        for u in range(30):
+            events.insert(_set("user", f"u{u}", {}), app_id)
+            # users view items of their own parity (plus noise)
+            for _ in range(8):
+                i = int(rng.integers(0, 6)) * 2 + (u % 2)
+                events.insert(_interaction("view", f"u{u}", f"i{i}"), app_id)
+        # like/dislike signals for LikeAlgorithm
+        for u in range(30):
+            events.insert(_interaction("like", f"u{u}", f"i{(u % 2)}"), app_id)
+            events.insert(
+                _interaction("dislike", f"u{u}", f"i{((u + 1) % 2)}"), app_id
+            )
+        return storage
+
+    def ep(self, algos=("als",)):
+        from predictionio_tpu.models import similarproduct as sim
+
+        return EngineParams(
+            datasource=("", sim.DataSourceParams(app_name="SimApp")),
+            algorithms=[
+                (a, sim.ALSAlgorithmParams(rank=6, num_iterations=8, alpha=2.0))
+                for a in algos
+            ],
+        )
+
+    def test_similar_items_same_parity(self, seeded):
+        from predictionio_tpu.models import similarproduct as sim
+
+        engine = sim.engine()
+        run_train(engine, self.ep(), engine_id="sim", storage=seeded)
+        inst = seeded.get_metadata_engine_instances().get_latest_completed(
+            "sim", "0", "default"
+        )
+        _, [algo], [model], serving = prepare_deploy(engine, inst, storage=seeded)
+        q = sim.Query(items=["i0"], num=3)
+        result = serving.serve(q, [algo.predict(model, q)])
+        assert len(result.itemScores) == 3
+        assert "i0" not in [s.item for s in result.itemScores]
+        parities = [int(s.item[1:]) % 2 for s in result.itemScores]
+        assert parities.count(0) >= 2  # mostly even items similar to i0
+
+    def test_category_and_blacklist_filters(self, seeded):
+        from predictionio_tpu.models import similarproduct as sim
+
+        algo = sim.ALSAlgorithm(sim.ALSAlgorithmParams(rank=4, num_iterations=4))
+        td = sim.SimilarProductDataSource(
+            sim.DataSourceParams(app_name="SimApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        q = sim.Query(items=["i0"], num=5, categories=["odd"])
+        result = algo.predict(model, q)
+        assert all(int(s.item[1:]) % 2 == 1 for s in result.itemScores)
+        q2 = sim.Query(items=["i0"], num=5, blackList=["i2", "i4"])
+        items2 = [s.item for s in algo.predict(model, q2).itemScores]
+        assert "i2" not in items2 and "i4" not in items2
+        q3 = sim.Query(items=["i0"], num=5, whiteList=["i2", "i4"])
+        items3 = [s.item for s in algo.predict(model, q3).itemScores]
+        assert set(items3) <= {"i2", "i4"}
+
+    def test_multi_algorithm_sum_serving(self, seeded):
+        from predictionio_tpu.models import similarproduct as sim
+
+        engine = sim.engine()
+        ep = self.ep(algos=("als", "likealgo"))
+        models = engine.train(CTX, ep)
+        algos = engine.make_algorithms(ep)
+        serving = engine.make_serving(ep)
+        q = sim.Query(items=["i0"], num=4)
+        result = serving.serve(q, [a.predict(m, q) for a, m in zip(algos, models)])
+        assert len(result.itemScores) <= 4
+        scores = [s.score for s in result.itemScores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_query_items(self, seeded):
+        from predictionio_tpu.models import similarproduct as sim
+
+        algo = sim.ALSAlgorithm(sim.ALSAlgorithmParams(rank=4, num_iterations=2))
+        td = sim.SimilarProductDataSource(
+            sim.DataSourceParams(app_name="SimApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        assert algo.predict(model, sim.Query(items=["zz"])).itemScores == []
+
+
+class TestECommerce:
+    @pytest.fixture()
+    def seeded(self, storage):
+        app_id = storage.get_metadata_apps().insert(App(0, "EcomApp"))
+        events = storage.get_events()
+        rng = np.random.default_rng(2)
+        for i in range(10):
+            events.insert(
+                _set("item", f"i{i}", {"categories": ["cat-a" if i < 5 else "cat-b"]}),
+                app_id,
+            )
+        for u in range(20):
+            events.insert(_set("user", f"u{u}", {}), app_id)
+            for _ in range(6):
+                i = int(rng.integers(0, 5)) + (0 if u % 2 == 0 else 5)
+                events.insert(_interaction("view", f"u{u}", f"i{i}"), app_id)
+        return storage, app_id
+
+    def ep(self, **kw):
+        from predictionio_tpu.models import ecommerce as ecom
+
+        defaults = dict(
+            app_name="EcomApp", rank=6, num_iterations=8, alpha=2.0,
+            unseen_only=False,
+        )
+        defaults.update(kw)
+        return EngineParams(
+            datasource=("", ecom.DataSourceParams(app_name="EcomApp")),
+            algorithms=[("als", ecom.ECommAlgorithmParams(**defaults))],
+        )
+
+    def test_personalized_recommendations(self, seeded):
+        from predictionio_tpu.models import ecommerce as ecom
+
+        storage, _ = seeded
+        engine = ecom.engine()
+        run_train(engine, self.ep(), engine_id="ecom", storage=storage)
+        inst = storage.get_metadata_engine_instances().get_latest_completed(
+            "ecom", "0", "default"
+        )
+        _, [algo], [model], serving = prepare_deploy(engine, inst, storage=storage)
+        result = serving.serve(
+            ecom.Query(user="u0", num=3),
+            [algo.predict(model, ecom.Query(user="u0", num=3))],
+        )
+        assert len(result.itemScores) == 3
+        # even users view items 0-4 (cat-a)
+        assert all(int(s.item[1:]) < 5 for s in result.itemScores)
+
+    def test_unseen_only_filters_seen(self, seeded):
+        from predictionio_tpu.models import ecommerce as ecom
+
+        storage, app_id = seeded
+        td = ecom.ECommerceDataSource(
+            ecom.DataSourceParams(app_name="EcomApp")
+        ).read_training(CTX)
+        algo = ecom.ECommAlgorithm(
+            ecom.ECommAlgorithmParams(
+                app_name="EcomApp", rank=4, num_iterations=4, unseen_only=True
+            )
+        )
+        model = algo.train(CTX, td)
+        seen = {i for u, i in td.view_events if u == "u0"}
+        result = algo.predict(model, ecom.Query(user="u0", num=10))
+        assert seen.isdisjoint({s.item for s in result.itemScores})
+
+    def test_unavailable_items_live_constraint(self, seeded):
+        from predictionio_tpu.models import ecommerce as ecom
+
+        storage, app_id = seeded
+        algo = ecom.ECommAlgorithm(
+            ecom.ECommAlgorithmParams(
+                app_name="EcomApp", rank=4, num_iterations=4, unseen_only=False
+            )
+        )
+        td = ecom.ECommerceDataSource(
+            ecom.DataSourceParams(app_name="EcomApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        before = {s.item for s in algo.predict(model, ecom.Query(user="u0", num=5)).itemScores}
+        ban = sorted(before)[:2]
+        # constraint set LIVE after training — must take effect immediately
+        storage.get_events().insert(
+            Event(
+                event="$set", entity_type="constraint",
+                entity_id="unavailableItems", properties={"items": ban},
+            ),
+            app_id,
+        )
+        after = {s.item for s in algo.predict(model, ecom.Query(user="u0", num=5)).itemScores}
+        assert not set(ban) & after
+
+    def test_weights_groups_boost(self, seeded):
+        from predictionio_tpu.models import ecommerce as ecom
+
+        storage, _ = seeded
+        td = ecom.ECommerceDataSource(
+            ecom.DataSourceParams(app_name="EcomApp")
+        ).read_training(CTX)
+        base_algo = ecom.ECommAlgorithm(
+            ecom.ECommAlgorithmParams(
+                app_name="EcomApp", rank=4, num_iterations=4, unseen_only=False
+            )
+        )
+        model = base_algo.train(CTX, td)
+        base = base_algo.predict(model, ecom.Query(user="u0", num=10))
+        # boost a lower-ranked item that still has a positive score
+        # (weights multiply scores, matching the reference — boosting a
+        # negative score pushes it further down)
+        positive = [s_ for s_ in base.itemScores if s_.score > 0]
+        assert len(positive) >= 2
+        target = positive[-1].item
+        boosted_algo = ecom.ECommAlgorithm(
+            ecom.ECommAlgorithmParams(
+                app_name="EcomApp", rank=4, num_iterations=4, unseen_only=False,
+                weights=[{"items": [target], "weight": 100.0}],
+            )
+        )
+        boosted = boosted_algo.predict(model, ecom.Query(user="u0", num=10))
+        assert boosted.itemScores[0].item == target
+
+    def test_cold_start_user_via_recent_views(self, seeded):
+        from predictionio_tpu.models import ecommerce as ecom
+
+        storage, app_id = seeded
+        algo = ecom.ECommAlgorithm(
+            ecom.ECommAlgorithmParams(
+                app_name="EcomApp", rank=4, num_iterations=4, unseen_only=False
+            )
+        )
+        td = ecom.ECommerceDataSource(
+            ecom.DataSourceParams(app_name="EcomApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        # brand-new user with no factors but live recent views of cat-a
+        for i in range(3):
+            storage.get_events().insert(
+                _interaction("view", "newbie", f"i{i}"), app_id
+            )
+        result = algo.predict(model, ecom.Query(user="newbie", num=3))
+        assert len(result.itemScores) == 3
+        # and a user with nothing at all -> empty
+        assert algo.predict(model, ecom.Query(user="ghost")).itemScores == []
