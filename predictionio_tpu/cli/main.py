@@ -169,6 +169,22 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def _load_server_config(args):
+    """server.conf for key auth / SSL: --server-config flag, else the
+    PIO_SERVER_CONF env var, else conf/server.conf when present
+    (the reference loads server.conf from the classpath unconditionally)."""
+    import os
+
+    from predictionio_tpu.common import load_server_config
+
+    path = (
+        getattr(args, "server_config", None)
+        or os.environ.get("PIO_SERVER_CONF")
+        or "conf/server.conf"
+    )
+    return load_server_config(path=path)
+
+
 def cmd_deploy(args) -> int:
     from predictionio_tpu.data.storage import get_storage
     from predictionio_tpu.server.engine_server import EngineServer
@@ -207,6 +223,7 @@ def cmd_deploy(args) -> int:
             else None
         ),
         access_key=args.accesskey,
+        server_config=_load_server_config(args),
     )
     # foreground, like the reference: backgrounding is the caller's job
     # (shell &, supervisor); a daemon thread would die with this process
@@ -245,7 +262,9 @@ def cmd_adminserver(args) -> int:
 def cmd_dashboard(args) -> int:
     from predictionio_tpu.server.dashboard import Dashboard
 
-    Dashboard(host=args.ip, port=args.port).start(background=False)
+    Dashboard(
+        host=args.ip, port=args.port, server_config=_load_server_config(args)
+    ).start(background=False)
     return 0
 
 
@@ -353,6 +372,7 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--event-server-ip", default="0.0.0.0")
     d.add_argument("--event-server-port", type=int, default=7070)
     d.add_argument("--accesskey")
+    d.add_argument("--server-config", help="server.conf path (key auth / SSL)")
     d.set_defaults(fn=cmd_deploy)
 
     u = sub.add_parser("undeploy")
@@ -374,6 +394,7 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("dashboard")
     db.add_argument("--ip", default="0.0.0.0")
     db.add_argument("--port", type=int, default=9000)
+    db.add_argument("--server-config", help="server.conf path (key auth / SSL)")
     db.set_defaults(fn=cmd_dashboard)
 
     ex = sub.add_parser("export")

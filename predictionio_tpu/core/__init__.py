@@ -33,6 +33,7 @@ from predictionio_tpu.core.base import (
 )
 from predictionio_tpu.core.context import WorkflowContext
 from predictionio_tpu.core.engine import Engine, EngineFactory
+from predictionio_tpu.core.self_cleaning import EventWindow, SelfCleaningDataSource
 
 __all__ = [
     "Params",
@@ -50,4 +51,6 @@ __all__ = [
     "WorkflowContext",
     "Engine",
     "EngineFactory",
+    "EventWindow",
+    "SelfCleaningDataSource",
 ]

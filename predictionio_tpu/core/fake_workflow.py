@@ -1,0 +1,61 @@
+"""FakeWorkflow: run an arbitrary function through the evaluation machinery.
+
+Capability parity with the reference's FakeWorkflow
+(core/.../workflow/FakeWorkflow.scala:33-109): ``FakeRun`` wraps a
+``ctx -> None`` function as an Evaluation so ad-hoc code (REPL / pio-shell
+usage) runs under the same instance-lifecycle bookkeeping as a real
+evaluation; its ``FakeEvalResult`` is marked no-save so no result views are
+persisted (FakeWorkflow.scala:41-46).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from predictionio_tpu.core.context import WorkflowContext
+from predictionio_tpu.core.evaluation import Evaluation
+
+
+class FakeEvalResult:
+    """No-save evaluation result (reference FakeEvalResult, :41-46)."""
+
+    no_save = True
+
+    def to_one_liner(self) -> str:
+        return "FakeWorkflow"
+
+    def to_json(self) -> str:
+        return '"FakeWorkflow"'
+
+    def to_html(self) -> str:
+        return "FakeWorkflow"
+
+
+class FakeRun(Evaluation):
+    """Evaluation whose whole pipeline is one user function
+    (reference FakeRun, :95-109)."""
+
+    def __init__(self, fn: Callable[[WorkflowContext], None]):
+        # deliberately no super().__init__: there is no engine/metric —
+        # the function IS the workflow (reference FakeEngine/FakeRunner).
+        self.fn = fn
+
+    def run(self, ctx, engine_params_list=None, workflow_params=None):
+        self.fn(ctx)
+        return FakeEvalResult()
+
+
+def fake_run(
+    fn: Callable[[WorkflowContext], None],
+    batch: str = "FakeWorkflow",
+    storage=None,
+    ctx: WorkflowContext | None = None,
+) -> str:
+    """Run ``fn`` under evaluation-instance bookkeeping; returns the
+    evaluation instance id."""
+    from predictionio_tpu.core.workflow_eval import run_evaluation
+
+    instance_id, _ = run_evaluation(
+        FakeRun(fn), batch=batch, storage=storage, ctx=ctx
+    )
+    return instance_id

@@ -87,9 +87,12 @@ def run_evaluation(
         result = evaluation.run(ctx, params_list, wp)
         instance.status = EvaluationInstanceStatus.EVALCOMPLETED
         instance.end_time = _now()
-        instance.evaluator_results = result.to_one_liner()
-        instance.evaluator_results_html = result.to_html()
-        instance.evaluator_results_json = result.to_json()
+        # no-save results (FakeWorkflow) complete the instance without
+        # persisting result views (reference CoreWorkflow noSave handling)
+        if not getattr(result, "no_save", False):
+            instance.evaluator_results = result.to_one_liner()
+            instance.evaluator_results_html = result.to_html()
+            instance.evaluator_results_json = result.to_json()
         instances.update(instance)
         logger.info("evaluation instance %s EVALCOMPLETED", instance_id)
         return instance_id, result

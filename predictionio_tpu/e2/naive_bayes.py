@@ -61,6 +61,10 @@ class CategoricalNaiveBayesModel:
         best_label, best_score = None, -math.inf
         for label in sorted(self.priors):
             like = self.likelihoods[label]
+            if len(features) != len(like):
+                raise ValueError(
+                    f"point has {len(features)} features; model expects {len(like)}"
+                )
             score = self.priors[label]
             for pos, value in enumerate(features):
                 score += like[pos].get(value, -math.inf)

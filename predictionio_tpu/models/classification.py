@@ -82,8 +82,10 @@ class ClassificationDataSource(DataSource):
         labels, rows = [], []
         for entity_id, pm in props.items():
             try:
-                labels.append(pm.get_double(self.params.label_name))
-                rows.append([pm.get_double(a) for a in self.params.attribute_names])
+                label = pm.get_double(self.params.label_name)
+                row = [pm.get_double(a) for a in self.params.attribute_names]
+                labels.append(label)
+                rows.append(row)
             except Exception:
                 logger.warning("skipping entity %s with malformed attributes", entity_id)
         return TrainingData(

@@ -18,10 +18,33 @@ logger = logging.getLogger(__name__)
 
 
 class Dashboard:
-    def __init__(self, storage: Storage | None = None, host: str = "0.0.0.0", port: int = 9000):
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        host: str = "0.0.0.0",
+        port: int = 9000,
+        server_config=None,
+    ):
         self.storage = storage or get_storage()
         self.host = host
-        self.app = HTTPApp(self._router(), host=host, port=port)
+        # dashboard pages are server-key-authenticated when enforced
+        # (Dashboard.scala wraps routes in withAccessKeyFromFile)
+        self.server_config = server_config
+        self.app = HTTPApp(
+            self._router(),
+            host=host,
+            port=port,
+            ssl_context=(
+                server_config.ssl_context() if server_config is not None else None
+            ),
+        )
+
+    def _authorized(self, request: Request) -> bool:
+        if self.server_config is None:
+            return True
+        from predictionio_tpu.common import KeyAuthentication
+
+        return KeyAuthentication(self.server_config).authorized(request.query)
 
     def _router(self) -> Router:
         router = Router()
@@ -29,6 +52,8 @@ class Dashboard:
 
         @router.route("GET", "/")
         def index(request: Request) -> Response:
+            if not server._authorized(request):
+                return Response.error("Not authenticated", 401)
             instances = server.storage.get_metadata_evaluation_instances().get_completed()
             rows = "".join(
                 f"<tr><td>{html.escape(i.id)}</td>"
@@ -53,6 +78,8 @@ class Dashboard:
 
         @router.route("GET", "/engine_instances/<iid>/evaluator_results.txt")
         def results_txt(request: Request) -> Response:
+            if not server._authorized(request):
+                return Response.error("Not authenticated", 401)
             i = server._get(request.path_params["iid"])
             if i is None:
                 return Response.error("Not Found", 404)
@@ -62,6 +89,8 @@ class Dashboard:
 
         @router.route("GET", "/engine_instances/<iid>/evaluator_results.html")
         def results_html(request: Request) -> Response:
+            if not server._authorized(request):
+                return Response.error("Not authenticated", 401)
             i = server._get(request.path_params["iid"])
             if i is None:
                 return Response.error("Not Found", 404)
@@ -69,6 +98,8 @@ class Dashboard:
 
         @router.route("GET", "/engine_instances/<iid>/evaluator_results.json")
         def results_json(request: Request) -> Response:
+            if not server._authorized(request):
+                return Response.error("Not authenticated", 401)
             i = server._get(request.path_params["iid"])
             if i is None:
                 return Response.error("Not Found", 404)

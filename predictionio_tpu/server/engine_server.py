@@ -70,10 +70,14 @@ class EngineServer:
         feedback: bool = False,
         event_server_url: str | None = None,
         access_key: str | None = None,
+        server_config=None,
     ):
         self.engine = engine
         self.storage = storage or get_storage()
         self.host = host
+        # server.conf-style config supplies the control key and TLS
+        # (reference common KeyAuthentication + SSLConfiguration)
+        self.server_config = server_config
         self.server_key = server_key
         self.feedback = feedback
         self.event_server_url = event_server_url
@@ -91,7 +95,14 @@ class EngineServer:
         for p in self.plugins:
             p.start(self.plugin_context)
 
-        self.app = HTTPApp(self._router(), host=host, port=port)
+        self.app = HTTPApp(
+            self._router(),
+            host=host,
+            port=port,
+            ssl_context=(
+                server_config.ssl_context() if server_config is not None else None
+            ),
+        )
 
     def _load(self, instance: EngineInstance) -> None:
         engine_params, algorithms, models, serving = prepare_deploy(
@@ -269,7 +280,18 @@ class EngineServer:
 
     def _auth_control(self, request: Request) -> bool:
         """/reload and /stop are guarded by the server key when set
-        (reference common KeyAuthentication)."""
+        (reference common KeyAuthentication). When a ServerConfig is
+        present its enforcement flag decides — an enforced-but-empty key
+        still requires a matching (empty-string) param rather than
+        silently disabling auth."""
+        if self.server_config is not None:
+            from predictionio_tpu.common import KeyAuthentication
+
+            allowed = KeyAuthentication(self.server_config).authorized(request.query)
+            if not allowed:
+                return False
+            if self.server_key is None:
+                return True
         if not self.server_key:
             return True
         return request.query.get("accessKey") == self.server_key

@@ -115,10 +115,19 @@ class Router:
 class HTTPApp:
     """A router bound to a ThreadingHTTPServer with start/stop lifecycle."""
 
-    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0):
+    def __init__(
+        self,
+        router: Router,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        ssl_context=None,
+    ):
         self.router = router
         self.host = host
         self.port = port
+        # server-side TLS (reference SSLConfiguration sslContext wiring
+        # into spray; here an ssl.SSLContext wrapping the listen socket)
+        self.ssl_context = ssl_context
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -180,6 +189,10 @@ class HTTPApp:
             do_GET = do_POST = do_DELETE = do_PUT = _handle
 
         self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        if self.ssl_context is not None:
+            self._server.socket = self.ssl_context.wrap_socket(
+                self._server.socket, server_side=True
+            )
         self.port = self._server.server_address[1]
         if background:
             self._thread = threading.Thread(

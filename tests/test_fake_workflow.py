@@ -1,0 +1,45 @@
+"""FakeWorkflow tests (reference core/src/test coverage of
+workflow/FakeWorkflow.scala: arbitrary fn runs under evaluation
+bookkeeping, no result views persisted, failures mark the instance)."""
+
+import pytest
+
+from predictionio_tpu.core.fake_workflow import FakeEvalResult, FakeRun, fake_run
+from predictionio_tpu.data.storage import EvaluationInstanceStatus
+
+
+class TestFakeWorkflow:
+    def test_runs_function_with_context(self, storage):
+        seen = {}
+
+        def fn(ctx):
+            seen["ctx"] = ctx
+
+        instance_id = fake_run(fn, storage=storage)
+        assert seen["ctx"] is not None
+        inst = storage.get_metadata_evaluation_instances().get(instance_id)
+        assert inst.status == EvaluationInstanceStatus.EVALCOMPLETED
+
+    def test_no_result_views_persisted(self, storage):
+        instance_id = fake_run(lambda ctx: None, storage=storage)
+        inst = storage.get_metadata_evaluation_instances().get(instance_id)
+        assert inst.evaluator_results == ""
+        assert inst.evaluator_results_json == ""
+
+    def test_failure_marks_instance(self, storage):
+        def boom(ctx):
+            raise RuntimeError("injected")
+
+        with pytest.raises(RuntimeError, match="injected"):
+            fake_run(boom, storage=storage)
+        insts = storage.get_metadata_evaluation_instances().get_all()
+        assert any(i.status == EvaluationInstanceStatus.FAILED for i in insts)
+
+    def test_fake_run_is_an_evaluation(self):
+        from predictionio_tpu.core.evaluation import Evaluation
+
+        run = FakeRun(lambda ctx: None)
+        assert isinstance(run, Evaluation)
+        result = run.run(None)
+        assert isinstance(result, FakeEvalResult)
+        assert result.no_save

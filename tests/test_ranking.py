@@ -1,0 +1,103 @@
+"""Ranking-metric tests (Precision@K / MAP@K / NDCG@K — the measures the
+reference's movielens evaluation example selects, examples/experimental/
+scala-local-movielens-evaluation/src/main/scala/Evaluation.scala:73-140)."""
+
+import math
+
+import pytest
+
+from predictionio_tpu.core.ranking import (
+    MAPAtK,
+    NDCGAtK,
+    PrecisionAtK,
+    average_precision_at_k,
+    ndcg_at_k,
+    precision_at_k,
+)
+
+
+class TestPrecisionAtK:
+    def test_perfect(self):
+        assert precision_at_k(["a", "b"], {"a", "b"}, 2) == 1.0
+
+    def test_partial(self):
+        assert precision_at_k(["a", "x", "b", "y"], {"a", "b"}, 4) == 0.5
+
+    def test_denominator_is_k(self):
+        # one relevant item found, k=5 -> 0.2 even if fewer predictions
+        assert precision_at_k(["a"], {"a"}, 5) == pytest.approx(0.2)
+
+    def test_no_actuals_skips(self):
+        assert precision_at_k(["a"], set(), 5) is None
+
+    def test_empty_predictions(self):
+        assert precision_at_k([], {"a"}, 5) == 0.0
+
+    def test_score_pairs_and_itemscores(self):
+        class IS:
+            def __init__(self, item):
+                self.item = item
+
+        assert precision_at_k([("a", 0.9), ("b", 0.1)], {"a"}, 2) == 0.5
+        assert precision_at_k([IS("a"), IS("b")], {"b"}, 2) == 0.5
+
+
+class TestAveragePrecision:
+    def test_perfect(self):
+        assert average_precision_at_k(["a", "b"], {"a", "b"}, 2) == 1.0
+
+    def test_known_value(self):
+        # hits at ranks 1 and 3: (1/1 + 2/3) / min(10, 2) = 5/6
+        got = average_precision_at_k(["a", "x", "b"], {"a", "b"}, 10)
+        assert got == pytest.approx(5 / 6)
+
+    def test_miss(self):
+        assert average_precision_at_k(["x", "y"], {"a"}, 2) == 0.0
+
+    def test_no_actuals_skips(self):
+        assert average_precision_at_k(["a"], [], 2) is None
+
+
+class TestNDCG:
+    def test_perfect(self):
+        assert ndcg_at_k(["a", "b"], {"a", "b"}, 2) == pytest.approx(1.0)
+
+    def test_hit_at_two(self):
+        # DCG = 1/log2(3); IDCG = 1/log2(2) = 1
+        assert ndcg_at_k(["x", "a"], {"a"}, 2) == pytest.approx(1 / math.log2(3))
+
+    def test_no_actuals_skips(self):
+        assert ndcg_at_k(["a"], set(), 2) is None
+
+
+class TestMetricClasses:
+    def _eval_data(self):
+        return [
+            (
+                None,
+                [
+                    ("q1", ["a", "b"], {"a", "b"}),  # P@2 = 1.0
+                    ("q2", ["x", "a"], {"a"}),  # P@2 = 0.5
+                    ("q3", ["x"], set()),  # skipped (no actuals)
+                ],
+            )
+        ]
+
+    def test_precision_metric(self):
+        m = PrecisionAtK(k=2)
+        assert m.calculate(self._eval_data()) == pytest.approx(0.75)
+        assert "k=2" in m.header
+
+    def test_map_metric(self):
+        m = MAPAtK(k=2)
+        # AP(q1)=1.0, AP(q2)=(1/2)/1=0.5 -> mean 0.75
+        assert m.calculate(self._eval_data()) == pytest.approx(0.75)
+
+    def test_ndcg_metric(self):
+        m = NDCGAtK(k=2)
+        expected = (1.0 + 1 / math.log2(3)) / 2
+        assert m.calculate(self._eval_data()) == pytest.approx(expected)
+
+    def test_ordering(self):
+        m = PrecisionAtK(k=2)
+        assert m.compare(0.9, 0.5) > 0

@@ -1,0 +1,133 @@
+"""server.conf / key-auth / SSL config tests (reference common module:
+SSLConfiguration.scala, KeyAuthentication.scala, conf/server.conf)."""
+
+import ssl
+import subprocess
+
+import pytest
+
+from predictionio_tpu.common import (
+    KeyAuthentication,
+    ServerConfig,
+    load_server_config,
+)
+
+HOCON = """
+# comment
+org.apache.predictionio.server {
+  key-auth-enforced = "true"
+  accessKey = "sekrit"
+  ssl-enforced = "false"
+}
+"""
+
+FLAT = """
+org.apache.predictionio.server.key-auth-enforced=true
+org.apache.predictionio.server.accessKey=flatkey
+"""
+
+
+class TestParsing:
+    def test_hocon_block(self):
+        cfg = load_server_config(text=HOCON)
+        assert cfg.key_auth_enforced is True
+        assert cfg.access_key == "sekrit"
+        assert cfg.ssl_enforced is False
+
+    def test_flat_keys(self):
+        cfg = load_server_config(text=FLAT)
+        assert cfg.key_auth_enforced is True
+        assert cfg.access_key == "flatkey"
+
+    def test_missing_file_defaults(self, tmp_path):
+        cfg = load_server_config(path=str(tmp_path / "nope.conf"))
+        assert cfg.key_auth_enforced is False
+        assert cfg.access_key == ""
+        assert cfg.ssl_context() is None
+
+    def test_file_roundtrip(self, tmp_path):
+        p = tmp_path / "server.conf"
+        p.write_text(HOCON)
+        assert load_server_config(path=str(p)).access_key == "sekrit"
+
+
+class TestKeyAuthentication:
+    def test_not_enforced_allows_all(self):
+        auth = KeyAuthentication(ServerConfig())
+        assert auth.authorized({}) is True
+
+    def test_enforced_requires_match(self):
+        auth = KeyAuthentication(
+            ServerConfig(key_auth_enforced=True, access_key="k1")
+        )
+        assert auth.authorized({"accessKey": "k1"}) is True
+        assert auth.authorized({"accessKey": "nope"}) is False
+        assert auth.authorized({}) is False
+
+
+class TestSSL:
+    def test_enforced_without_files_raises(self):
+        with pytest.raises(ValueError):
+            ServerConfig(ssl_enforced=True).ssl_context()
+
+    def test_context_from_self_signed_pem(self, tmp_path):
+        cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+        proc = subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+                "-subj", "/CN=localhost",
+            ],
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            pytest.skip("openssl unavailable")
+        ctx = ServerConfig(
+            ssl_enforced=True, ssl_certfile=cert, ssl_keyfile=key
+        ).ssl_context()
+        assert isinstance(ctx, ssl.SSLContext)
+        assert ctx.minimum_version == ssl.TLSVersion.TLSv1_2
+
+
+class TestDashboardAuth:
+    def test_dashboard_requires_key_when_enforced(self, storage):
+        from predictionio_tpu.server.dashboard import Dashboard
+        from predictionio_tpu.server.http import Request
+
+        dash = Dashboard(
+            storage=storage,
+            server_config=ServerConfig(key_auth_enforced=True, access_key="dk"),
+        )
+        req = Request("GET", "/", {}, {}, b"")
+        assert dash.app.router.dispatch(req).status == 401
+        req_ok = Request("GET", "/", {"accessKey": "dk"}, {}, b"")
+        assert dash.app.router.dispatch(req_ok).status == 200
+
+    def test_results_routes_also_guarded(self, storage):
+        from predictionio_tpu.server.dashboard import Dashboard
+        from predictionio_tpu.server.http import Request
+
+        dash = Dashboard(
+            storage=storage,
+            server_config=ServerConfig(key_auth_enforced=True, access_key="dk"),
+        )
+        for suffix in ("txt", "html", "json"):
+            req = Request(
+                "GET", f"/engine_instances/x/evaluator_results.{suffix}", {}, {}, b""
+            )
+            assert dash.app.router.dispatch(req).status == 401
+
+
+class TestEngineServerControlAuth:
+    def test_enforced_empty_key_still_blocks(self, storage):
+        """key-auth-enforced=true with accessKey unset must not silently
+        disable /stop auth (a request without the param is rejected)."""
+        from predictionio_tpu.server.engine_server import EngineServer
+        from predictionio_tpu.server.http import Request
+
+        server = EngineServer.__new__(EngineServer)
+        server.server_config = ServerConfig(key_auth_enforced=True, access_key="")
+        server.server_key = None
+        assert server._auth_control(Request("POST", "/stop", {}, {}, b"")) is False
+        ok = Request("POST", "/stop", {"accessKey": ""}, {}, b"")
+        assert server._auth_control(ok) is True
