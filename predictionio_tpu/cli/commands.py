@@ -246,26 +246,43 @@ def import_events(
     from predictionio_tpu.data import store
     from predictionio_tpu.data.event import validate
 
+    from predictionio_tpu import native
+
     storage = storage or get_storage()
     app_name = _resolve_app_name(app_name, storage)
     app_id, channel_id = store.app_name_to_id(app_name, channel, storage)
     count = 0
-    batch: list[Event] = []
-    with open(input_path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
+
+    def _flush(data: bytes) -> None:
+        nonlocal count
+        # native span-scanning codec decodes the fixed wire fields without
+        # a per-line DOM parse (json fallback for flagged lines inside)
+        events = native.parse_events_jsonl(data)
+        for start in range(0, len(events), 500):
+            batch = events[start : start + 500]
+            for event in batch:
+                validate(event)
+            storage.get_events().batch_insert(batch, app_id, channel_id)
+            count += len(batch)
+
+    # stream line-aligned chunks so peak memory stays bounded for
+    # multi-GB event files
+    chunk_size = 8 << 20
+    carry = b""
+    with open(input_path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            chunk = carry + chunk
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                carry = chunk
                 continue
-            event = Event.from_dict(json.loads(line))
-            validate(event)
-            batch.append(event)
-            if len(batch) >= 500:
-                storage.get_events().batch_insert(batch, app_id, channel_id)
-                count += len(batch)
-                batch = []
-    if batch:
-        storage.get_events().batch_insert(batch, app_id, channel_id)
-        count += len(batch)
+            carry = chunk[cut + 1 :]
+            _flush(chunk[: cut + 1])
+    if carry.strip():
+        _flush(carry)
     return count
 
 

@@ -1,0 +1,414 @@
+"""ctypes bindings for the native event codec (native/pio_native.cpp).
+
+The reference delegates bulk event IO and id indexing to Spark executors
+(JDBCPEvents reads, FileToEvents/EventsToFile, BiMap.stringInt —
+data/.../storage/BiMap.scala:96-110); here those host-side hot loops run
+in a small C++ library. Public API:
+
+- :func:`scan_events` — columnar field spans for a JSONL event buffer,
+- :func:`index_spans` — dense string-id indexing over spans (BiMap build),
+- :func:`parse_times` / :func:`extract_number` — vectorized field decode,
+- :func:`load_ratings_jsonl` — one-call file -> (user_ids, item_ids,
+  rows, cols, ratings) training-array loader,
+- :func:`parse_events_jsonl` — JSONL -> list[Event] with the native
+  scanner for well-formed lines and the Python json fallback otherwise.
+
+Everything degrades to pure Python when the shared library can't be
+built (``native_available()`` reports which path is active); the library
+auto-compiles from source on first use when a C++ toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import subprocess
+import threading
+from typing import Iterable, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# field slots — keep in sync with PioField in native/pio_native.cpp
+F_EVENT = 0
+F_ENTITY_TYPE = 1
+F_ENTITY_ID = 2
+F_TARGET_ENTITY_TYPE = 3
+F_TARGET_ENTITY_ID = 4
+F_PROPERTIES = 5
+F_EVENT_TIME = 6
+F_PR_ID = 7
+F_EVENT_ID = 8
+F_TAGS = 9
+F_CREATION_TIME = 10
+N_FIELDS = 11
+
+FLAG_FALLBACK = 1
+FLAG_EMPTY = 2
+
+_REPO_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_tried = False
+
+
+def _build(src: str, out: str) -> bool:
+    try:
+        proc = subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-o", out, src],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.info("native codec build unavailable: %s", e)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native codec build failed:\n%s", proc.stderr)
+        return False
+    return True
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        src = os.path.join(_REPO_NATIVE_DIR, "pio_native.cpp")
+        so = os.path.join(_REPO_NATIVE_DIR, "libpio_native.so")
+        try:
+            stale = not os.path.exists(so) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(so)
+            )
+            if stale:
+                if not os.path.exists(src) or not _build(src, so):
+                    return None
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            logger.info("native codec not loaded: %s", e)
+            return None
+
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        lib.pio_scan_events.restype = ctypes.c_long
+        lib.pio_scan_events.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, i64p, i64p, u8p, ctypes.c_long,
+        ]
+        lib.pio_index_spans.restype = ctypes.c_long
+        lib.pio_index_spans.argtypes = [
+            ctypes.c_char_p, i64p, i64p, ctypes.c_long, i32p, i64p,
+        ]
+        lib.pio_parse_times.restype = None
+        lib.pio_parse_times.argtypes = [
+            ctypes.c_char_p, i64p, i64p, ctypes.c_long, f64p,
+        ]
+        lib.pio_extract_number.restype = None
+        lib.pio_extract_number.argtypes = [
+            ctypes.c_char_p, i64p, i64p, ctypes.c_long, ctypes.c_char_p, f64p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class ScannedEvents:
+    """Columnar view of one scanned JSONL buffer: (offset, length) spans
+    per line per field, plus per-line flags."""
+
+    def __init__(self, buf: bytes, offs: np.ndarray, lens: np.ndarray,
+                 flags: np.ndarray):
+        self.buf = buf
+        self.offs = offs  # [n, N_FIELDS] int64, -1 = absent
+        self.lens = lens  # [n, N_FIELDS] int64
+        self.flags = flags  # [n] uint8
+
+    def __len__(self) -> int:
+        return len(self.flags)
+
+    def field_bytes(self, line: int, field: int) -> bytes | None:
+        off = int(self.offs[line, field])
+        if off < 0:
+            return None
+        return self.buf[off : off + int(self.lens[line, field])]
+
+    def field_str(self, line: int, field: int) -> str | None:
+        b = self.field_bytes(line, field)
+        return None if b is None else b.decode("utf-8")
+
+
+def scan_events(buf: bytes) -> ScannedEvents:
+    """Scan a newline-delimited JSON event buffer into field spans.
+    Lines needing the full json parser carry FLAG_FALLBACK."""
+    n_lines = buf.count(b"\n") + (0 if buf.endswith(b"\n") or not buf else 1)
+    n_lines = max(n_lines, 1)
+    offs = np.empty((n_lines, N_FIELDS), dtype=np.int64)
+    lens = np.empty((n_lines, N_FIELDS), dtype=np.int64)
+    flags = np.empty(n_lines, dtype=np.uint8)
+    lib = _load()
+    if lib is not None:
+        n = lib.pio_scan_events(
+            buf, len(buf), offs.reshape(-1), lens.reshape(-1), flags, n_lines
+        )
+        if n >= 0:
+            return ScannedEvents(buf, offs[:n], lens[:n], flags[:n])
+    # pure-Python fallback: flag every non-empty line for the json path
+    lines = buf.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    n = len(lines)
+    offs = np.full((n, N_FIELDS), -1, dtype=np.int64)
+    lens = np.zeros((n, N_FIELDS), dtype=np.int64)
+    flags = np.full(n, FLAG_FALLBACK, dtype=np.uint8)
+    for i, line in enumerate(lines):
+        if not line.strip():
+            flags[i] = FLAG_EMPTY
+    return ScannedEvents(buf, offs, lens, flags)
+
+
+def index_spans(
+    buf: bytes, offs: np.ndarray, lens: np.ndarray
+) -> tuple[np.ndarray, list[str]]:
+    """Dense-index string spans (BiMap.stringInt analog). Returns
+    (idx int32 [n] with -1 for absent spans, unique id strings in dense
+    order)."""
+    n = len(offs)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    idx = np.empty(n, dtype=np.int32)
+    uniq_repr = np.empty(n, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        n_uniq = lib.pio_index_spans(buf, offs, lens, n, idx, uniq_repr)
+        ids = [
+            buf[offs[r] : offs[r] + lens[r]].decode("utf-8")
+            for r in uniq_repr[:n_uniq]
+        ]
+        return idx, ids
+    mapping: dict[bytes, int] = {}
+    ids = []
+    for i in range(n):
+        if offs[i] < 0:
+            idx[i] = -1
+            continue
+        key = buf[offs[i] : offs[i] + lens[i]]
+        j = mapping.get(key)
+        if j is None:
+            j = len(mapping)
+            mapping[key] = j
+            ids.append(key.decode("utf-8"))
+        idx[i] = j
+    return idx, ids
+
+
+def parse_times(buf: bytes, offs: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """ISO-8601 spans -> epoch seconds (NaN when absent/unparseable)."""
+    n = len(offs)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    out = np.empty(n, dtype=np.float64)
+    lib = _load()
+    if lib is not None:
+        lib.pio_parse_times(buf, offs, lens, n, out)
+        return out
+    from predictionio_tpu.data.event import parse_time
+
+    for i in range(n):
+        if offs[i] < 0:
+            out[i] = np.nan
+            continue
+        try:
+            out[i] = parse_time(
+                buf[offs[i] : offs[i] + lens[i]].decode("utf-8")
+            ).timestamp()
+        except Exception:
+            out[i] = np.nan
+    return out
+
+
+def extract_number(
+    buf: bytes, offs: np.ndarray, lens: np.ndarray, key: str
+) -> np.ndarray:
+    """Per-span numeric property extraction: value of ``key`` at the top
+    level of each properties-object span (NaN when missing)."""
+    n = len(offs)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    out = np.empty(n, dtype=np.float64)
+    lib = _load()
+    if lib is not None:
+        lib.pio_extract_number(buf, offs, lens, n, key.encode(), out)
+        return out
+    for i in range(n):
+        out[i] = np.nan
+        if offs[i] < 0:
+            continue
+        try:
+            v = json.loads(buf[offs[i] : offs[i] + lens[i]]).get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[i] = float(v)
+        except Exception:
+            pass
+    return out
+
+
+def parse_events_jsonl(data: bytes) -> list:
+    """JSONL buffer -> list[Event]: native span scan for well-formed
+    lines, json fallback for flagged ones (the import-path codec)."""
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event, parse_time
+
+    scanned = scan_events(data)
+    events = []
+    lines: list[bytes] | None = None  # lazily split, only if fallbacks occur
+    for i in range(len(scanned)):
+        flag = int(scanned.flags[i])
+        if flag & FLAG_EMPTY:
+            continue
+        if flag & FLAG_FALLBACK or scanned.offs[i, F_EVENT] < 0 or (
+            scanned.offs[i, F_ENTITY_TYPE] < 0
+            or scanned.offs[i, F_ENTITY_ID] < 0
+        ):
+            if lines is None:
+                lines = data.split(b"\n")
+            events.append(Event.from_json(lines[i].decode("utf-8")))
+            continue
+        props_raw = scanned.field_bytes(i, F_PROPERTIES)
+        tags_raw = scanned.field_bytes(i, F_TAGS)
+        kwargs = dict(
+            event=scanned.field_str(i, F_EVENT),
+            entity_type=scanned.field_str(i, F_ENTITY_TYPE),
+            entity_id=scanned.field_str(i, F_ENTITY_ID),
+            target_entity_type=scanned.field_str(i, F_TARGET_ENTITY_TYPE),
+            target_entity_id=scanned.field_str(i, F_TARGET_ENTITY_ID),
+            properties=DataMap(json.loads(props_raw) if props_raw else {}),
+            pr_id=scanned.field_str(i, F_PR_ID),
+            tags=tuple(json.loads(tags_raw)) if tags_raw else (),
+        )
+        t = scanned.field_str(i, F_EVENT_TIME)
+        if t is not None:
+            kwargs["event_time"] = parse_time(t)
+        ct = scanned.field_str(i, F_CREATION_TIME)
+        if ct is not None:
+            kwargs["creation_time"] = parse_time(ct)
+        eid = scanned.field_str(i, F_EVENT_ID)
+        if eid is not None:
+            kwargs["event_id"] = eid
+        events.append(Event(**kwargs))
+    return events
+
+
+def load_ratings_jsonl(
+    data: bytes,
+    event_names: Sequence[str] | None = None,
+    rating_key: str = "rating",
+    default_ratings: dict[str, float] | None = None,
+) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray]:
+    """One call from a JSONL event buffer to ALS training arrays:
+    (user_ids, item_ids, rows, cols, ratings) with dense indices — the
+    file -> device-array boundary (reference DataSource.readTraining +
+    BiMap.stringInt, examples/scala-parallel-recommendation/
+    custom-prepartor/src/main/scala/DataSource.scala:35-60).
+
+    ``default_ratings`` maps event names to implicit values (the "buy" ->
+    4.0 rule); explicit ``rating_key`` properties win.
+    """
+    scanned = scan_events(data)
+    n = len(scanned)
+    keep = np.ones(n, dtype=bool)
+    keep &= (scanned.flags == 0) & (scanned.offs[:, F_ENTITY_ID] >= 0) & (
+        scanned.offs[:, F_TARGET_ENTITY_ID] >= 0
+    )
+
+    # event-name filter + implicit defaults need the event spans decoded;
+    # dense-index the (few) distinct event names instead of per-line str
+    ev_idx, ev_names = index_spans(
+        scanned.buf, scanned.offs[:, F_EVENT], scanned.lens[:, F_EVENT]
+    )
+    if event_names is not None:
+        allowed = np.array(
+            [name in set(event_names) for name in ev_names], dtype=bool
+        )
+        if len(allowed):
+            keep &= (ev_idx >= 0) & allowed[np.clip(ev_idx, 0, None)]
+        else:
+            keep &= False
+
+    ratings = extract_number(
+        scanned.buf, scanned.offs[:, F_PROPERTIES], scanned.lens[:, F_PROPERTIES],
+        rating_key,
+    )
+    if default_ratings and len(ev_names):
+        defaults = np.array(
+            [default_ratings.get(name, np.nan) for name in ev_names],
+            dtype=np.float64,
+        )
+        line_default = np.where(
+            ev_idx >= 0, defaults[np.clip(ev_idx, 0, None)], np.nan
+        )
+        ratings = np.where(np.isnan(ratings), line_default, ratings)
+    keep &= ~np.isnan(ratings)
+
+    kept = np.flatnonzero(keep)
+    rows, user_ids = index_spans(
+        scanned.buf,
+        scanned.offs[kept, F_ENTITY_ID],
+        scanned.lens[kept, F_ENTITY_ID],
+    )
+    cols, item_ids = index_spans(
+        scanned.buf,
+        scanned.offs[kept, F_TARGET_ENTITY_ID],
+        scanned.lens[kept, F_TARGET_ENTITY_ID],
+    )
+    rows = list(rows)
+    cols = list(cols)
+    vals = list(ratings[kept])
+
+    # lines the scanner couldn't take (escaped ids etc.) go through the
+    # json parser and merge into the same dense id spaces
+    fallback = np.flatnonzero(scanned.flags == FLAG_FALLBACK)
+    if len(fallback):
+        user_map = {u: i for i, u in enumerate(user_ids)}
+        item_map = {it: i for i, it in enumerate(item_ids)}
+        lines = data.split(b"\n")
+        for i in fallback:
+            try:
+                d = json.loads(lines[i])
+            except Exception:
+                continue
+            if event_names is not None and d.get("event") not in set(event_names):
+                continue
+            u, it = d.get("entityId"), d.get("targetEntityId")
+            if not u or not it:
+                continue
+            v = (d.get("properties") or {}).get(rating_key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                v = (default_ratings or {}).get(d.get("event"))
+            if v is None:
+                continue
+            rows.append(user_map.setdefault(u, len(user_map)))
+            cols.append(item_map.setdefault(it, len(item_map)))
+            vals.append(float(v))
+        user_ids = user_ids + [u for u in user_map if user_map[u] >= len(user_ids)]
+        item_ids = item_ids + [it for it in item_map if item_map[it] >= len(item_ids)]
+
+    return (
+        user_ids,
+        item_ids,
+        np.asarray(rows, dtype=np.int32),
+        np.asarray(cols, dtype=np.int32),
+        np.asarray(vals, dtype=np.float32),
+    )

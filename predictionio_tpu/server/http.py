@@ -188,11 +188,26 @@ class HTTPApp:
 
             do_GET = do_POST = do_DELETE = do_PUT = _handle
 
-        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
         if self.ssl_context is not None:
-            self._server.socket = self.ssl_context.wrap_socket(
-                self._server.socket, server_side=True
-            )
+            ssl_context = self.ssl_context
+
+            class _TLSServer(ThreadingHTTPServer):
+                def get_request(self):
+                    # wrap per-connection WITHOUT handshaking: the
+                    # handshake happens lazily on first read in the worker
+                    # thread, so a silent client (TCP health probe) can't
+                    # stall the accept loop
+                    sock, addr = self.socket.accept()
+                    sock.settimeout(120)
+                    tls = ssl_context.wrap_socket(
+                        sock, server_side=True, do_handshake_on_connect=False
+                    )
+                    return tls, addr
+
+            server_cls = _TLSServer
+        else:
+            server_cls = ThreadingHTTPServer
+        self._server = server_cls((self.host, self.port), _Handler)
         self.port = self._server.server_address[1]
         if background:
             self._thread = threading.Thread(

@@ -1,0 +1,282 @@
+"""Native event codec tests: the C++ scanner/indexer must agree with the
+pure-Python fallback on every surface (the reference's analogous hot
+paths: BiMap.stringInt id indexing data/.../storage/BiMap.scala:96-110,
+FileToEvents import tools/.../imprt/FileToEvents.scala:34-106)."""
+
+import json
+import math
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu import native
+
+EVENTS = [
+    {
+        "event": "rate",
+        "entityType": "user",
+        "entityId": "u1",
+        "targetEntityType": "item",
+        "targetEntityId": "i1",
+        "properties": {"rating": 4.5},
+        "eventTime": "2020-01-01T12:30:15.250Z",
+    },
+    {
+        "event": "buy",
+        "entityType": "user",
+        "entityId": "u2",
+        "targetEntityType": "item",
+        "targetEntityId": "i1",
+        "eventTime": "2020-06-01T00:00:00.000+02:00",
+    },
+    {
+        "event": "$set",
+        "entityType": "user",
+        "entityId": 'u"quoted',  # escaped in JSON -> scanner fallback line
+        "properties": {"a": "x", "b": 2},
+        "eventTime": "2020-03-01T00:00:00.000Z",
+    },
+    {
+        "event": "view",
+        "entityType": "user",
+        "entityId": "u3",
+        "targetEntityType": "item",
+        "targetEntityId": "i2",
+        # nested object with a decoy rating: must NOT be extracted
+        "properties": {"nested": {"rating": 9}, "rating": 2},
+        "eventTime": "2020-04-01T08:00:00.000Z",
+    },
+]
+
+
+def _buf():
+    return "\n".join(json.dumps(d) for d in EVENTS).encode() + b"\n"
+
+
+@pytest.fixture(params=["native", "python"])
+def codec_mode(request, monkeypatch):
+    if request.param == "python":
+        monkeypatch.setattr(native, "_load", lambda: None)
+    elif not native.native_available():
+        pytest.skip("native lib unavailable")
+    return request.param
+
+
+class TestScan:
+    def test_field_spans(self):
+        if not native.native_available():
+            pytest.skip("native lib unavailable")
+        s = native.scan_events(_buf())
+        assert len(s) == 4
+        assert s.field_str(0, native.F_EVENT) == "rate"
+        assert s.field_str(0, native.F_ENTITY_ID) == "u1"
+        assert s.field_str(1, native.F_TARGET_ENTITY_ID) == "i1"
+        assert s.field_bytes(1, native.F_PROPERTIES) is None
+        assert json.loads(s.field_bytes(3, native.F_PROPERTIES)) == EVENTS[3][
+            "properties"
+        ]
+        # escaped entityId line is flagged for the json fallback
+        assert s.flags[2] & native.FLAG_FALLBACK
+        assert not s.flags[0] and not s.flags[1]
+
+    def test_blank_lines_and_garbage(self):
+        if not native.native_available():
+            pytest.skip("native lib unavailable")
+        s = native.scan_events(b'\n{"event":"a","entityType":"t","entityId":"e"}\nnot json\n')
+        assert s.flags[0] & native.FLAG_EMPTY
+        assert s.flags[1] == 0
+        assert s.flags[2] & native.FLAG_FALLBACK
+
+
+class TestParseEvents:
+    def test_roundtrip_all_lines(self, codec_mode):
+        evs = native.parse_events_jsonl(_buf())
+        assert len(evs) == 4
+        assert evs[0].entity_id == "u1"
+        assert evs[0].properties.to_dict() == {"rating": 4.5}
+        assert evs[2].entity_id == 'u"quoted'
+        assert evs[1].event_time == datetime(
+            2020, 6, 1, tzinfo=timezone(timedelta(hours=2))
+        )
+
+    def test_matches_python_json(self, codec_mode):
+        from predictionio_tpu.data.event import Event
+
+        expected = [Event.from_dict(d) for d in EVENTS]
+        got = native.parse_events_jsonl(_buf())
+        for e, g in zip(expected, got):
+            assert e.event == g.event
+            assert e.entity_id == g.entity_id
+            assert e.properties.to_dict() == g.properties.to_dict()
+            assert e.event_time == g.event_time
+
+
+class TestIndexSpans:
+    def test_dense_indexing(self, codec_mode):
+        buf = b"abc def abc xyz"
+        offs = np.array([0, 4, 8, 12], dtype=np.int64)
+        lens = np.array([3, 3, 3, 3], dtype=np.int64)
+        idx, ids = native.index_spans(buf, offs, lens)
+        assert list(idx) == [0, 1, 0, 2]
+        assert ids == ["abc", "def", "xyz"]
+
+    def test_absent_spans(self, codec_mode):
+        buf = b"ab"
+        offs = np.array([0, -1], dtype=np.int64)
+        lens = np.array([2, 0], dtype=np.int64)
+        idx, ids = native.index_spans(buf, offs, lens)
+        assert list(idx) == [0, -1]
+        assert ids == ["ab"]
+
+
+class TestParseTimes:
+    def test_formats(self, codec_mode):
+        cases = [
+            ("2020-01-01T12:30:15.250Z", datetime(2020, 1, 1, 12, 30, 15, 250000, tzinfo=timezone.utc)),
+            ("2020-06-01T00:00:00.000+02:00", datetime(2020, 6, 1, tzinfo=timezone(timedelta(hours=2)))),
+            ("1999-12-31T23:59:59Z", datetime(1999, 12, 31, 23, 59, 59, tzinfo=timezone.utc)),
+        ]
+        buf = " ".join(c[0] for c in cases).encode()
+        offs, lens, pos = [], [], 0
+        for text, _ in cases:
+            offs.append(pos)
+            lens.append(len(text))
+            pos += len(text) + 1
+        out = native.parse_times(
+            buf, np.array(offs, dtype=np.int64), np.array(lens, dtype=np.int64)
+        )
+        for got, (_, dt) in zip(out, cases):
+            assert got == pytest.approx(dt.timestamp(), abs=1e-6)
+
+    def test_invalid_is_nan(self, codec_mode):
+        buf = b"not-a-time"
+        out = native.parse_times(
+            buf, np.array([0], dtype=np.int64), np.array([10], dtype=np.int64)
+        )
+        assert math.isnan(out[0])
+
+
+class TestExtractNumber:
+    def test_top_level_only(self, codec_mode):
+        s = native.scan_events(_buf())
+        if int(s.flags[0]) & native.FLAG_FALLBACK:
+            pytest.skip("scanner in fallback mode")
+        out = native.extract_number(
+            s.buf, s.offs[:, native.F_PROPERTIES], s.lens[:, native.F_PROPERTIES],
+            "rating",
+        )
+        assert out[0] == 4.5
+        assert math.isnan(out[1])  # no properties
+        assert out[3] == 2.0  # top-level, not the nested decoy
+
+
+class TestLoadRatings:
+    def test_arrays_with_defaults_and_filter(self, codec_mode):
+        uids, iids, rows, cols, vals = native.load_ratings_jsonl(
+            _buf(), event_names=["rate", "buy"], default_ratings={"buy": 4.0}
+        )
+        assert uids == ["u1", "u2"]
+        assert iids == ["i1"]
+        assert list(rows) == [0, 1]
+        assert list(cols) == [0, 0]
+        assert list(vals) == [4.5, 4.0]
+
+    def test_fallback_lines_merge(self, codec_mode):
+        quoted = {
+            "event": "rate",
+            "entityType": "user",
+            "entityId": 'u"q',
+            "targetEntityType": "item",
+            "targetEntityId": "i9",
+            "properties": {"rating": 1.0},
+        }
+        data = _buf() + json.dumps(quoted).encode() + b"\n"
+        uids, iids, rows, cols, vals = native.load_ratings_jsonl(
+            data, event_names=["rate"]
+        )
+        assert 'u"q' in uids and "i9" in iids
+        assert vals[list(uids).index('u"q') == np.asarray(rows)][0] == 1.0
+
+    def test_rows_cols_consistent(self, codec_mode):
+        uids, iids, rows, cols, vals = native.load_ratings_jsonl(_buf())
+        assert len(rows) == len(cols) == len(vals)
+        assert rows.max() < len(uids) and cols.max() < len(iids)
+
+
+class TestStrictness:
+    """The native fast path must reject exactly what json+validation
+    rejected before (review regressions)."""
+
+    def test_tags_and_creation_time_preserved(self, codec_mode):
+        line = {
+            "event": "view", "entityType": "user", "entityId": "u1",
+            "tags": ["t1", "t2"],
+            "creationTime": "2019-01-01T00:00:00.000Z",
+            "eventTime": "2019-01-02T00:00:00.000Z",
+        }
+        (e,) = native.parse_events_jsonl((json.dumps(line) + "\n").encode())
+        assert e.tags == ("t1", "t2")
+        assert (e.creation_time.year, e.creation_time.day) == (2019, 1)
+
+    def test_concatenated_records_fail(self, codec_mode):
+        bad = (
+            b'{"event":"a","entityType":"t","entityId":"x"}'
+            b'{"event":"b","entityType":"t","entityId":"y"}\n'
+        )
+        with pytest.raises(json.JSONDecodeError):
+            native.parse_events_jsonl(bad)
+
+    def test_truncated_line_fails(self, codec_mode):
+        with pytest.raises(json.JSONDecodeError):
+            native.parse_events_jsonl(b'{"event":"a","entityType":"t","entityId":"x"')
+
+    def test_numeric_entity_id_rejected(self, codec_mode):
+        from predictionio_tpu.data.event import EventValidationError
+
+        with pytest.raises(EventValidationError):
+            native.parse_events_jsonl(
+                b'{"event":"a","entityType":"t","entityId":123}\n'
+            )
+
+    def test_export_import_roundtrip_preserves_all_fields(self, storage, tmp_path):
+        from predictionio_tpu.cli import commands
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.event import Event
+
+        commands.app_new("RoundApp", storage=storage)
+        app_id, _ = store.app_name_to_id("RoundApp", storage=storage)
+        src = Event(
+            event="view", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            tags=("a", "b"), pr_id="pr9",
+            event_time=datetime(2020, 5, 1, tzinfo=timezone.utc),
+            creation_time=datetime(2020, 5, 2, tzinfo=timezone.utc),
+        )
+        storage.get_events().insert(src, app_id)
+        out = tmp_path / "out.jsonl"
+        commands.export_events("RoundApp", str(out), storage=storage)
+
+        commands.app_new("RoundApp2", storage=storage)
+        commands.import_events("RoundApp2", str(out), storage=storage)
+        (got,) = store.find("RoundApp2", storage=storage)
+        assert got.tags == ("a", "b")
+        assert got.pr_id == "pr9"
+        assert got.event_time == src.event_time
+        assert got.creation_time == src.creation_time
+
+
+class TestImportUsesCodec:
+    def test_import_events_roundtrip(self, storage, tmp_path):
+        from predictionio_tpu.cli import commands
+
+        commands.app_new("NativeApp", storage=storage)
+        p = tmp_path / "events.jsonl"
+        p.write_bytes(_buf())
+        n = commands.import_events("NativeApp", str(p), storage=storage)
+        assert n == 4
+        from predictionio_tpu.data import store
+
+        evs = store.find("NativeApp", storage=storage)
+        assert len(evs) == 4
+        assert {e.entity_id for e in evs} == {"u1", "u2", 'u"quoted', "u3"}

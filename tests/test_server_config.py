@@ -89,6 +89,44 @@ class TestSSL:
         assert ctx.minimum_version == ssl.TLSVersion.TLSv1_2
 
 
+class TestTLSServer:
+    def test_idle_connection_does_not_block_accept_loop(self, storage, tmp_path):
+        """A TCP client that never handshakes (health probe) must not
+        stall other HTTPS requests — the handshake runs per-connection
+        in the worker thread, not in accept()."""
+        import socket
+        import urllib.request
+
+        cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+        proc = subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+                "-subj", "/CN=localhost",
+            ],
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            pytest.skip("openssl unavailable")
+        from predictionio_tpu.server.dashboard import Dashboard
+
+        cfg = ServerConfig(ssl_enforced=True, ssl_certfile=cert, ssl_keyfile=key)
+        dash = Dashboard(storage=storage, host="127.0.0.1", port=0, server_config=cfg)
+        port = dash.start(background=True)
+        try:
+            probe = socket.create_connection(("127.0.0.1", port))  # never speaks
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/", context=ctx, timeout=10
+            ) as r:
+                assert r.status == 200
+            probe.close()
+        finally:
+            dash.stop()
+
+
 class TestDashboardAuth:
     def test_dashboard_requires_key_when_enforced(self, storage):
         from predictionio_tpu.server.dashboard import Dashboard
