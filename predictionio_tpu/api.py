@@ -1,0 +1,159 @@
+"""Programmatic ``Pio`` API: drive the framework without the CLI.
+
+Capability parity with the reference's programmatic console wrappers
+(tools/.../console/Pio.scala:62-151 and the ``Pio.App`` / ``Pio.AccessKey``
+objects): everything the ``pio`` verbs do, callable from Python. The
+reference wrappers fork spark-submit processes and block on them; here the
+drivers run in-process, and ``deploy``/``eventserver``/``dashboard``
+return live server objects (``.stop()`` replaces the process kill).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from predictionio_tpu.cli import commands
+
+
+class Pio:
+    """Facade over the train / deploy / eval drivers and app management."""
+
+    # -- lifecycle drivers -------------------------------------------------
+    @staticmethod
+    def train(
+        engine_factory: str,
+        variant: Mapping[str, Any] | str | None = None,
+        batch: str = "",
+        storage=None,
+        **workflow_kwargs,
+    ) -> str:
+        """Train from a factory dotted-path + variant (dict or engine.json
+        path); returns the engine instance id (Pio.scala train wrapper)."""
+        from predictionio_tpu.core.engine import WorkflowParams, resolve_engine_factory
+        from predictionio_tpu.core.workflow import load_variant, run_train
+
+        engine = resolve_engine_factory(engine_factory)
+        var: Mapping[str, Any] = {}
+        if isinstance(variant, str):
+            var = load_variant(variant)
+        elif variant is not None:
+            var = variant
+        engine_params = engine.params_from_variant(var)
+        wp = WorkflowParams(batch=batch, **workflow_kwargs)
+        return run_train(
+            engine,
+            engine_params,
+            engine_id=var.get("id", "default"),
+            engine_version=var.get("version", "0"),
+            engine_factory=engine_factory,
+            workflow_params=wp,
+            storage=storage,
+        )
+
+    @staticmethod
+    def eval(
+        evaluation: Any,
+        engine_params_generator: Any = None,
+        batch: str = "",
+        storage=None,
+    ):
+        """Run an evaluation sweep; returns (instance id, result)."""
+        from predictionio_tpu.core.workflow_eval import run_evaluation
+
+        return run_evaluation(
+            evaluation,
+            engine_params_generator_class=engine_params_generator,
+            batch=batch,
+            storage=storage,
+        )
+
+    @staticmethod
+    def deploy(
+        engine_factory: str,
+        variant: Mapping[str, Any] | str | None = None,
+        engine_instance_id: str | None = None,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        storage=None,
+        **server_kwargs,
+    ):
+        """Deploy the latest COMPLETED instance (or a given one) on an
+        in-process engine server; returns the started server
+        (Pio.scala deploy + commands/Engine.deploy:203-238)."""
+        from predictionio_tpu.core.engine import resolve_engine_factory
+        from predictionio_tpu.core.workflow import load_variant
+        from predictionio_tpu.data.storage import get_storage
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        engine = resolve_engine_factory(engine_factory)
+        var: Mapping[str, Any] = {}
+        if isinstance(variant, str):
+            var = load_variant(variant)
+        elif variant is not None:
+            var = variant
+        storage = storage or get_storage()
+        instances = storage.get_metadata_engine_instances()
+        if engine_instance_id is not None:
+            instance = instances.get(engine_instance_id)
+        else:
+            instance = instances.get_latest_completed(
+                var.get("id", "default"), var.get("version", "0"), "default"
+            )
+        if instance is None:
+            raise RuntimeError(
+                "no valid engine instance found; run Pio.train first"
+            )
+        server = EngineServer(
+            engine, instance, storage=storage, host=host, port=port, **server_kwargs
+        )
+        server.start(background=True)
+        return server
+
+    @staticmethod
+    def undeploy(server) -> None:
+        server.stop()
+
+    # -- servers -----------------------------------------------------------
+    @staticmethod
+    def eventserver(host: str = "127.0.0.1", port: int = 7070, **kwargs):
+        from predictionio_tpu.server.event_server import EventServer
+
+        server = EventServer(host=host, port=port, **kwargs)
+        server.start(background=True)
+        return server
+
+    @staticmethod
+    def dashboard(host: str = "127.0.0.1", port: int = 9000, **kwargs):
+        from predictionio_tpu.server.dashboard import Dashboard
+
+        server = Dashboard(host=host, port=port, **kwargs)
+        server.start(background=True)
+        return server
+
+    @staticmethod
+    def adminserver(host: str = "127.0.0.1", port: int = 7071, **kwargs):
+        from predictionio_tpu.server.admin_server import AdminServer
+
+        server = AdminServer(host=host, port=port, **kwargs)
+        server.start(background=True)
+        return server
+
+    # -- app / accesskey management (Pio.App / Pio.AccessKey objects) ------
+    class App:
+        new = staticmethod(commands.app_new)
+        list = staticmethod(commands.app_list)
+        show = staticmethod(commands.app_show)
+        delete = staticmethod(commands.app_delete)
+        data_delete = staticmethod(commands.app_data_delete)
+        channel_new = staticmethod(commands.channel_new)
+        channel_delete = staticmethod(commands.channel_delete)
+
+    class AccessKey:
+        new = staticmethod(commands.accesskey_new)
+        list = staticmethod(commands.accesskey_list)
+        delete = staticmethod(commands.accesskey_delete)
+
+    # -- data in/out -------------------------------------------------------
+    export_events = staticmethod(commands.export_events)
+    import_events = staticmethod(commands.import_events)
+    status = staticmethod(commands.status)

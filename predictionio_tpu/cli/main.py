@@ -142,6 +142,7 @@ def cmd_train(args) -> int:
         skip_sanity_check=args.skip_sanity_check,
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
+        profile_dir=args.profile_dir,
     )
     instance_id = run_train(
         engine,
@@ -354,6 +355,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--skip-sanity-check", action="store_true")
     t.add_argument("--stop-after-read", action="store_true")
     t.add_argument("--stop-after-prepare", action="store_true")
+    t.add_argument("--profile-dir", help="write a JAX profiler trace here")
     t.set_defaults(fn=cmd_train)
 
     ev = sub.add_parser("eval")

@@ -46,6 +46,10 @@ class WorkflowParams:
     stop_after_read: bool = False
     stop_after_prepare: bool = False
     runtime_conf: dict[str, Any] = field(default_factory=dict)
+    # when set, the training run is wrapped in a JAX profiler trace written
+    # here (XPlane/TensorBoard format) — the TPU-native answer to the
+    # reference's reliance on the Spark UI for train-time visibility
+    profile_dir: str | None = None
 
 
 class StopAfterReadInterruption(Exception):

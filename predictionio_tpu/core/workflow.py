@@ -89,7 +89,13 @@ def run_train(
 
     try:
         algorithms = engine.make_algorithms(engine_params)
-        models = engine.train(ctx, engine_params, wp, algorithms=algorithms)
+        if wp.profile_dir:
+            import jax.profiler
+
+            with jax.profiler.trace(wp.profile_dir):
+                models = engine.train(ctx, engine_params, wp, algorithms=algorithms)
+        else:
+            models = engine.train(ctx, engine_params, wp, algorithms=algorithms)
         if wp.save_model:
             blob = persistence.serialize_models(algorithms, models, instance_id)
             storage.get_model_data_models().insert(Model(instance_id, blob))

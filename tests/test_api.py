@@ -1,0 +1,85 @@
+"""Programmatic Pio API tests (reference tools/.../console/Pio.scala:62-151
+wrappers: train/deploy/query without the CLI) plus the train-time JAX
+profiler hook."""
+
+import json
+import os
+import urllib.request
+
+from predictionio_tpu.api import Pio
+
+FACTORY = "predictionio_tpu.models.recommendation.engine"
+
+
+def _seed(storage, app="ApiApp"):
+    Pio.App.new(app, storage=storage)
+    from predictionio_tpu.data import store
+    from predictionio_tpu.data.event import Event
+
+    app_id, _ = store.app_name_to_id(app, storage=storage)
+    events = [
+        Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"u{u}",
+            target_entity_type="item",
+            target_entity_id=f"i{(u + i) % 6}",
+            properties={"rating": float((u * i) % 5 + 1)},
+        )
+        for u in range(8)
+        for i in range(5)
+    ]
+    storage.get_events().batch_insert(events, app_id)
+    return {
+        "id": "api",
+        "datasource": {"params": {"app_name": app}},
+        "algorithms": [{"name": "als", "params": {"rank": 4, "num_iterations": 2}}],
+    }
+
+
+class TestPioFacade:
+    def test_train_deploy_query_undeploy(self, storage):
+        variant = _seed(storage)
+        instance_id = Pio.train(FACTORY, variant, storage=storage)
+        assert instance_id
+
+        server = Pio.deploy(FACTORY, variant, host="127.0.0.1", port=0, storage=storage)
+        try:
+            port = server.app.port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json",
+                data=json.dumps({"user": "u1", "num": 3}).encode(),
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.loads(resp.read())
+            assert len(body["itemScores"]) == 3
+        finally:
+            Pio.undeploy(server)
+
+    def test_deploy_without_train_raises(self, storage):
+        variant = _seed(storage, app="ApiApp2")
+        try:
+            Pio.deploy(FACTORY, variant, storage=storage)
+            raise AssertionError("expected RuntimeError")
+        except RuntimeError as e:
+            assert "no valid engine instance" in str(e)
+
+    def test_app_management(self, storage):
+        Pio.App.new("FacadeApp", storage=storage)
+        assert any(a["name"] == "FacadeApp" for a in Pio.App.list(storage=storage))
+        keys = Pio.AccessKey.list("FacadeApp", storage=storage)
+        assert len(keys) == 1  # app new creates a default key
+        Pio.App.delete("FacadeApp", storage=storage)
+
+
+class TestProfilerHook:
+    def test_train_writes_profile_trace(self, storage, tmp_path):
+        variant = _seed(storage, app="ProfApp")
+        profile_dir = str(tmp_path / "prof")
+        Pio.train(FACTORY, variant, storage=storage, profile_dir=profile_dir)
+        traced = [
+            os.path.join(root, f)
+            for root, _, files in os.walk(profile_dir)
+            for f in files
+        ]
+        assert traced, "profiler trace directory is empty"
