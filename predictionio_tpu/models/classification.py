@@ -9,10 +9,11 @@ Capability parity with the reference template
 - NaiveBayesAlgorithm trains MLlib multinomial NB with lambda smoothing
   (NaiveBayesAlgorithm.scala:33-37) — here the jit multinomial NB in
   ``predictionio_tpu.ops.naive_bayes``,
-- the add-algorithm variant registers a second algorithm under a named
-  key ("naive"/"randomforest"); here the second algorithm is a
-  CategoricalNaiveBayes over discretized attributes, exercising the same
-  multi-algorithm engine mechanics.
+- the add-algorithm variant registers additional algorithms under named
+  keys ("naive"/"randomforest", RandomForestAlgorithm.scala): here a
+  TPU-native random forest (``predictionio_tpu.ops.random_forest``) and
+  a CategoricalNaiveBayes over discretized attributes, exercising the
+  same multi-algorithm engine mechanics.
 
 Query: ``{"features": [d, d, d]}`` -> ``{"label": d}``.
 """
@@ -38,6 +39,7 @@ from predictionio_tpu.core import (
 from predictionio_tpu.data import store
 from predictionio_tpu.e2 import naive_bayes as categorical_nb
 from predictionio_tpu.ops import naive_bayes as nb_ops
+from predictionio_tpu.ops import random_forest as rf_ops
 
 logger = logging.getLogger(__name__)
 
@@ -114,6 +116,18 @@ class ClassificationDataSource(DataSource):
         return split_data(3, points, make_training, make_qa)
 
 
+def _batch_predict(predict_fn, queries):
+    """Shared dense-feature batch scorer: one device call for all queries."""
+    feats = np.asarray([q.features for _, q in queries], dtype=np.float32)
+    if len(feats) == 0:
+        return []
+    labels = predict_fn(feats)
+    return [
+        (ix, PredictedResult(label=float(l)))
+        for (ix, _), l in zip(queries, np.atleast_1d(labels))
+    ]
+
+
 @dataclass
 class NaiveBayesParams(Params):
     lambda_: float = 1.0
@@ -131,14 +145,41 @@ class NaiveBayesAlgorithm(Algorithm):
         return PredictedResult(label=float(label))
 
     def batch_predict(self, model, queries):
-        feats = np.asarray([q.features for _, q in queries], dtype=np.float32)
-        if len(feats) == 0:
-            return []
-        labels = nb_ops.predict(model, feats)
-        return [
-            (ix, PredictedResult(label=float(l)))
-            for (ix, _), l in zip(queries, np.atleast_1d(labels))
-        ]
+        return _batch_predict(lambda feats: nb_ops.predict(model, feats), queries)
+
+
+@dataclass
+class RandomForestParams(Params):
+    """Reference RandomForestAlgorithmParams (add-algorithm
+    RandomForestAlgorithm.scala): numTrees/maxDepth/maxBins; the
+    impurity is fixed to Gini on device."""
+
+    num_trees: int = 16
+    max_depth: int = 5
+    max_bins: int = 32
+    seed: int = 0
+
+
+class RandomForestAlgorithm(Algorithm):
+    params_class = RandomForestParams
+    query_class = Query
+
+    def train(self, ctx: WorkflowContext, td: TrainingData) -> rf_ops.RandomForestModel:
+        return rf_ops.train(
+            td.labels,
+            td.features,
+            num_trees=self.params.num_trees,
+            max_depth=self.params.max_depth,
+            n_bins=self.params.max_bins,
+            seed=self.params.seed,
+        )
+
+    def predict(self, model: rf_ops.RandomForestModel, query: Query) -> PredictedResult:
+        label = rf_ops.predict(model, np.asarray(query.features, dtype=np.float32))
+        return PredictedResult(label=float(label))
+
+    def batch_predict(self, model, queries):
+        return _batch_predict(lambda feats: rf_ops.predict(model, feats), queries)
 
 
 @dataclass
@@ -189,6 +230,7 @@ def engine() -> Engine:
         preparator_classes=IdentityPreparator,
         algorithm_classes={
             "naive": NaiveBayesAlgorithm,
+            "randomforest": RandomForestAlgorithm,
             "categorical": CategoricalNBAlgorithm,
         },
         serving_classes=FirstServing,

@@ -1,0 +1,71 @@
+"""Random forest op tests (ops/random_forest.py) — the TPU-native
+replacement for MLlib RandomForest used by the classification
+add-algorithm template (reference RandomForestAlgorithm.scala)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import random_forest as rf
+
+
+@pytest.fixture(scope="module")
+def xor_data():
+    rng = np.random.default_rng(7)
+    n = 1500
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = ((X[:, 0] * X[:, 1] > 0) ^ (X[:, 2] > 0.5)).astype(np.float32)
+    return X, y
+
+
+class TestRandomForest:
+    def test_learns_nonlinear_rule(self, xor_data):
+        X, y = xor_data
+        m = rf.train(y[:1200], X[:1200], num_trees=24, max_depth=6, seed=1)
+        acc = (rf.predict(m, X[1200:]) == y[1200:]).mean()
+        assert acc > 0.85
+
+    def test_single_query_scalar(self, xor_data):
+        X, y = xor_data
+        m = rf.train(y, X, num_trees=4, max_depth=3)
+        out = rf.predict(m, X[0])
+        assert np.ndim(out) == 0
+        assert out in (0.0, 1.0)
+
+    def test_deterministic_given_seed(self, xor_data):
+        X, y = xor_data
+        m1 = rf.train(y, X, num_trees=4, max_depth=4, seed=3)
+        m2 = rf.train(y, X, num_trees=4, max_depth=4, seed=3)
+        np.testing.assert_array_equal(m1.split_feature, m2.split_feature)
+        np.testing.assert_array_equal(m1.split_bin, m2.split_bin)
+        np.testing.assert_allclose(m1.leaf_probs, m2.leaf_probs, rtol=1e-6)
+
+    def test_probs_normalized(self, xor_data):
+        X, y = xor_data
+        m = rf.train(y, X, num_trees=8, max_depth=4)
+        probs = rf.predict_proba(m, X[:50])
+        assert probs.shape == (50, 2)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+    def test_nonbinary_labels(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(600, 2)).astype(np.float32)
+        y = np.where(X[:, 0] > 0.5, 7.0, np.where(X[:, 1] > 0, 3.0, 1.0))
+        m = rf.train(y, X, num_trees=16, max_depth=5)
+        acc = (rf.predict(m, X) == y).mean()
+        assert set(np.unique(rf.predict(m, X))) <= {1.0, 3.0, 7.0}
+        assert acc > 0.9
+
+    def test_model_pickle_roundtrip(self, xor_data):
+        X, y = xor_data
+        m = rf.train(y, X, num_trees=4, max_depth=3)
+        m2 = pickle.loads(pickle.dumps(m))
+        np.testing.assert_array_equal(rf.predict(m, X[:20]), rf.predict(m2, X[:20]))
+
+    def test_tiny_dataset(self):
+        X = np.array([[0.0], [1.0], [2.0], [3.0]], dtype=np.float32)
+        y = np.array([0.0, 0.0, 1.0, 1.0])
+        m = rf.train(y, X, num_trees=4, max_depth=2, n_bins=4)
+        assert rf.predict(m, np.array([0.1], np.float32)) == 0.0
+        assert rf.predict(m, np.array([2.9], np.float32)) == 1.0

@@ -90,6 +90,26 @@ class TestClassification:
         pred = algo.predict(models[0], cls.Query(features=[8.0, 1.0, 0.0]))
         assert pred.label in (0.0, 1.0)
 
+    def test_random_forest_algorithm(self, seeded):
+        from predictionio_tpu.models import classification as cls
+
+        ep = EngineParams(
+            datasource=("", cls.DataSourceParams(app_name="ClsApp")),
+            algorithms=[
+                ("randomforest", cls.RandomForestParams(num_trees=8, max_depth=4))
+            ],
+        )
+        engine = cls.engine()
+        models = engine.train(CTX, ep)
+        algo = engine.make_algorithms(ep)[0]
+        assert algo.predict(models[0], cls.Query(features=[8.0, 1.0, 0.0])).label == 0.0
+        assert algo.predict(models[0], cls.Query(features=[0.0, 1.0, 8.0])).label == 1.0
+        batch = algo.batch_predict(
+            models[0],
+            [(0, cls.Query(features=[8.0, 1.0, 0.0])), (1, cls.Query(features=[0.0, 1.0, 8.0]))],
+        )
+        assert [p.label for _, p in batch] == [0.0, 1.0]
+
     def test_eval_accuracy_metric(self, seeded):
         from predictionio_tpu.core.evaluation import MetricEvaluator
         from predictionio_tpu.core.metrics import AverageMetric
