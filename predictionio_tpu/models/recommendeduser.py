@@ -1,0 +1,204 @@
+"""Recommended-user engine template: similar users via implicit ALS.
+
+Capability parity with the reference template variant
+``examples/scala-parallel-similarproduct/recommended-user``: the
+similar-product pipeline retargeted at users — DataSource reads ``$set``
+user entities and user→user ``follow`` events, ALS trains implicitly on
+the follow matrix, and a query for one or more users returns the users
+most cosine-similar to the *followed-user* factor vectors, with
+white/black-list filters.
+
+Query: ``{"users": [...], "num": N, "whiteList": [...]?,
+"blackList": [...]?}`` -> ``{"userScores": [{"user": ..., "score": ...}]}``.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops import als as als_ops
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Query:
+    users: list[str] = field(default_factory=list)
+    num: int = 4
+    whiteList: list[str] | None = None
+    blackList: list[str] | None = None
+
+
+@dataclass
+class UserScore:
+    user: str
+    score: float
+
+
+@dataclass
+class PredictedResult:
+    userScores: list[UserScore] = field(default_factory=list)
+
+
+@dataclass
+class DataSourceParams(Params):
+    app_name: str = ""
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    users: list[str] = field(default_factory=list)
+    follow_events: list[tuple[str, str]] = field(default_factory=list)
+
+    def sanity_check(self) -> None:
+        if not self.follow_events:
+            raise ValueError("TrainingData has no follow events")
+
+
+class RecommendedUserDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        app = self.params.app_name
+        users = list(store.aggregate_properties(app, entity_type="user"))
+        follows = [
+            (e.entity_id, e.target_entity_id)
+            for e in store.find(
+                app, entity_type="user", event_names=["follow"],
+                target_entity_type="user",
+            )
+        ]
+        return TrainingData(users=users, follow_events=follows)
+
+
+@dataclass
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+
+
+@dataclass
+class RecommendedUserModel:
+    followed_index: BiMap  # followed-user id <-> column index
+    followed_factors: np.ndarray  # [F, D] row-normalized at device load
+
+    def __post_init__(self):
+        self._device = None
+
+    def device_factors(self):
+        if self._device is None:
+            import jax.numpy as jnp
+
+            norms = np.linalg.norm(self.followed_factors, axis=1, keepdims=True)
+            self._device = jnp.asarray(
+                self.followed_factors / np.maximum(norms, 1e-12)
+            )
+        return self._device
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_device"] = None
+        return state
+
+
+class ALSAlgorithm(Algorithm):
+    """Implicit ALS on follow counts; cosine user-user scoring over the
+    followed-side factors (reference recommended-user ALSAlgorithm.scala)."""
+
+    params_class = ALSAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx: WorkflowContext, td: TrainingData) -> RecommendedUserModel:
+        counts: dict[tuple[str, str], float] = defaultdict(float)
+        for follower, followed in td.follow_events:
+            counts[(follower, followed)] += 1.0
+        if not counts:
+            raise ValueError("cannot train on zero follow events")
+        follower_index = BiMap.string_int(f for f, _ in counts)
+        followed_index = BiMap.string_int(
+            list(td.users) + [t for _, t in counts]
+        )
+        rows = follower_index.to_index_array([f for f, _ in counts])
+        cols = followed_index.to_index_array([t for _, t in counts])
+        vals = np.asarray(list(counts.values()), dtype=np.float32)
+        data = als_ops.build_ratings_data(
+            rows, cols, vals, len(follower_index), len(followed_index)
+        )
+        params = als_ops.ALSParams(
+            rank=self.params.rank,
+            iterations=self.params.num_iterations,
+            reg=self.params.lambda_,
+            implicit=True,
+            alpha=self.params.alpha,
+            seed=self.params.seed,
+        )
+        _, V = als_ops.als_train(data, params)
+        return RecommendedUserModel(
+            followed_index=followed_index, followed_factors=np.asarray(V)
+        )
+
+    def predict(self, model: RecommendedUserModel, query: Query) -> PredictedResult:
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.topk import top_k_items
+
+        index = model.followed_index
+        known = [index[u] for u in query.users if u in index]
+        if not known:
+            logger.info("no query users with factors; returning empty result")
+            return PredictedResult(userScores=[])
+        V = model.device_factors()
+        query_vec = V[jnp.asarray(np.asarray(known, dtype=np.int32))].sum(axis=0)
+
+        n = len(index)
+        mask = np.zeros(n, dtype=bool)
+        mask[known] = True  # never recommend the query users themselves
+        if query.whiteList is not None:
+            allowed = {index[u] for u in query.whiteList if u in index}
+            mask |= ~np.isin(np.arange(n), list(allowed))
+        if query.blackList:
+            for uid in query.blackList:
+                if uid in index:
+                    mask[index[uid]] = True
+
+        scores, ids = top_k_items(
+            query_vec, V, k=int(query.num), exclude_mask=jnp.asarray(mask)
+        )
+        inv = index.inverse
+        return PredictedResult(
+            userScores=[
+                UserScore(user=inv[int(i)], score=float(s))
+                for s, i in zip(np.asarray(scores), np.asarray(ids))
+                if s > -1e29
+            ]
+        )
+
+
+def engine() -> Engine:
+    """Reference RecommendedUserEngine factory (recommended-user
+    Engine.scala: Map("als" -> ALSAlgorithm))."""
+    return Engine(
+        datasource_classes=RecommendedUserDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_classes=FirstServing,
+    )

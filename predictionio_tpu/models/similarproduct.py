@@ -10,6 +10,10 @@ Capability parity with the reference template
   items' factor vectors (ALSAlgorithm.scala:147,193,244),
 - LikeAlgorithm (the "multi" variant's second algorithm) trains on
   like=1 / dislike=-1 signals (LikeAlgorithm.scala),
+- CosineAlgorithm covers the experimental DIMSUM variant
+  (examples/experimental/scala-parallel-similarproduct-dimsum):
+  exact top-N item-item cosine from raw view counts — the MXU matmul
+  replaces ``RowMatrix.columnSimilarities`` sampling,
 - Serving sums per-item scores across algorithms and re-ranks (the
   multi variant's Serving.scala).
 
@@ -144,27 +148,27 @@ class SimilarProductModel:
         return state
 
 
-def _exclude_mask(model: SimilarProductModel, query: Query) -> np.ndarray | None:
+def _exclude_mask(
+    item_index: BiMap, categories: dict[str, list[str]], query: Query
+) -> np.ndarray:
     """Build the candidate-exclusion mask from query items, category,
     white/black lists (reference ALSAlgorithm.scala:193-244 filters)."""
-    n = len(model.item_index)
+    n = len(item_index)
     mask = np.zeros(n, dtype=bool)
     for iid in query.items:  # never recommend the query items themselves
-        if iid in model.item_index:
-            mask[model.item_index[iid]] = True
+        if iid in item_index:
+            mask[item_index[iid]] = True
     if query.whiteList is not None:
-        allowed = {
-            model.item_index[i] for i in query.whiteList if i in model.item_index
-        }
+        allowed = {item_index[i] for i in query.whiteList if i in item_index}
         mask |= ~np.isin(np.arange(n), list(allowed))
     if query.blackList:
         for iid in query.blackList:
-            if iid in model.item_index:
-                mask[model.item_index[iid]] = True
+            if iid in item_index:
+                mask[item_index[iid]] = True
     if query.categories is not None:
         wanted = set(query.categories)
-        for iid, ix in model.item_index.items():
-            if not wanted.intersection(model.categories.get(iid, ())):
+        for iid, ix in item_index.items():
+            if not wanted.intersection(categories.get(iid, ())):
                 mask[ix] = True
     return mask
 
@@ -180,7 +184,7 @@ def _score_similar(model: SimilarProductModel, query: Query) -> PredictedResult:
         return PredictedResult(itemScores=[])
     V = model.device_factors()  # row-normalized: dot == cosine
     query_vec = V[jnp.asarray(np.asarray(known, dtype=np.int32))].sum(axis=0)
-    mask = _exclude_mask(model, query)
+    mask = _exclude_mask(model.item_index, model.categories, query)
     scores, ids = top_k_items(
         query_vec, V, k=int(query.num), exclude_mask=jnp.asarray(mask)
     )
@@ -194,6 +198,27 @@ def _score_similar(model: SimilarProductModel, query: Query) -> PredictedResult:
     )
 
 
+def _view_counts(td: TrainingData) -> list[tuple[str, str, float]]:
+    """Aggregate view events into (user, item, count) triples."""
+    counts: dict[tuple[str, str], float] = defaultdict(float)
+    for u, i in td.view_events:
+        counts[(u, i)] += 1.0
+    return [(u, i, c) for (u, i), c in counts.items()]
+
+
+def _index_ratings(ratings, td: TrainingData):
+    """(user_index, item_index, rows, cols, vals) from rating triples;
+    items known only from ``$set`` entities still get index slots."""
+    if not ratings:
+        raise ValueError("cannot train on zero events")
+    user_index = BiMap.string_int(u for u, _, _ in ratings)
+    item_index = BiMap.string_int(list(td.items) + [i for _, i, _ in ratings])
+    rows = user_index.to_index_array([u for u, _, _ in ratings])
+    cols = item_index.to_index_array([i for _, i, _ in ratings])
+    vals = np.asarray([c for _, _, c in ratings], dtype=np.float32)
+    return user_index, item_index, rows, cols, vals
+
+
 class ALSAlgorithm(Algorithm):
     """Implicit ALS on view counts; cosine item-item scoring."""
 
@@ -201,20 +226,12 @@ class ALSAlgorithm(Algorithm):
     query_class = Query
 
     def _ratings(self, td: TrainingData) -> list[tuple[str, str, float]]:
-        counts: dict[tuple[str, str], float] = defaultdict(float)
-        for u, i in td.view_events:
-            counts[(u, i)] += 1.0
-        return [(u, i, c) for (u, i), c in counts.items()]
+        return _view_counts(td)
 
     def train(self, ctx: WorkflowContext, td: TrainingData) -> SimilarProductModel:
-        ratings = self._ratings(td)
-        if not ratings:
-            raise ValueError("cannot train on zero events")
-        user_index = BiMap.string_int(u for u, _, _ in ratings)
-        item_index = BiMap.string_int(list(td.items) + [i for _, i, _ in ratings])
-        rows = user_index.to_index_array([u for u, _, _ in ratings])
-        cols = item_index.to_index_array([i for _, i, _ in ratings])
-        vals = np.asarray([c for _, _, c in ratings], dtype=np.float32)
+        user_index, item_index, rows, cols, vals = _index_ratings(
+            self._ratings(td), td
+        )
         data = als_ops.build_ratings_data(
             rows, cols, vals, len(user_index), len(item_index)
         )
@@ -248,6 +265,63 @@ class LikeAlgorithm(ALSAlgorithm):
         return [(u, i, v) for (u, i), v in latest.items()]
 
 
+@dataclass
+class CosineAlgorithmParams(Params):
+    top_n: int = 20  # neighbors kept per item (dimsum threshold analog)
+
+
+@dataclass
+class CosineModel:
+    item_index: BiMap
+    sim_scores: np.ndarray  # [I, N] cosine of the N nearest items
+    sim_ids: np.ndarray  # [I, N] their item indices
+    categories: dict[str, list[str]]
+
+
+class CosineAlgorithm(Algorithm):
+    """Precomputed exact item-item cosine neighbors from view counts
+    (DIMSUM-variant parity; see ops/cosine_sim.py)."""
+
+    params_class = CosineAlgorithmParams
+    query_class = Query
+
+    def train(self, ctx: WorkflowContext, td: TrainingData) -> CosineModel:
+        from predictionio_tpu.ops.cosine_sim import item_similarity_topn
+
+        user_index, item_index, rows, cols, vals = _index_ratings(
+            _view_counts(td), td
+        )
+        scores, ids = item_similarity_topn(
+            rows, cols, vals, len(user_index), len(item_index),
+            top_n=self.params.top_n,
+        )
+        return CosineModel(
+            item_index=item_index,
+            sim_scores=scores,
+            sim_ids=ids,
+            categories=dict(td.items),
+        )
+
+    def predict(self, model: CosineModel, query: Query) -> PredictedResult:
+        known = [model.item_index[i] for i in query.items if i in model.item_index]
+        if not known:
+            return PredictedResult(itemScores=[])
+        combined: dict[int, float] = defaultdict(float)
+        for ix in known:
+            for score, jx in zip(model.sim_scores[ix], model.sim_ids[ix]):
+                if np.isfinite(score):
+                    combined[int(jx)] += float(score)
+        mask = _exclude_mask(model.item_index, model.categories, query)
+        inv = model.item_index.inverse
+        ranked = sorted(
+            ((jx, s) for jx, s in combined.items() if not mask[jx]),
+            key=lambda kv: -kv[1],
+        )[: query.num]
+        return PredictedResult(
+            itemScores=[ItemScore(item=inv[jx], score=s) for jx, s in ranked]
+        )
+
+
 class SumScoreServing(Serving):
     """Combines algorithms by summing per-item scores and re-ranking
     (reference multi/Serving.scala)."""
@@ -269,6 +343,10 @@ def engine() -> Engine:
     return Engine(
         datasource_classes=SimilarProductDataSource,
         preparator_classes=IdentityPreparator,
-        algorithm_classes={"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+        algorithm_classes={
+            "als": ALSAlgorithm,
+            "likealgo": LikeAlgorithm,
+            "cosine": CosineAlgorithm,
+        },
         serving_classes=SumScoreServing,
     )

@@ -177,7 +177,7 @@ def train(
     uniq, class_ix = np.unique(labels, return_inverse=True)
     num_classes = len(uniq)
     num_features = features.shape[1]
-    n_bins = int(min(n_bins, max(2, len(features))))
+    n_bins = int(max(2, min(n_bins, max(2, len(features)))))
     max_depth = int(max_depth)
     if feature_subset is None:
         feature_subset = max(1, int(round(np.sqrt(num_features))))

@@ -218,6 +218,106 @@ class TestSimilarProduct:
         model = algo.train(CTX, td)
         assert algo.predict(model, sim.Query(items=["zz"])).itemScores == []
 
+    def test_cosine_algorithm_dimsum_variant(self, seeded):
+        from predictionio_tpu.models import similarproduct as sim
+
+        algo = sim.CosineAlgorithm(sim.CosineAlgorithmParams(top_n=8))
+        td = sim.SimilarProductDataSource(
+            sim.DataSourceParams(app_name="SimApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        result = algo.predict(model, sim.Query(items=["i0"], num=3))
+        assert len(result.itemScores) == 3
+        assert "i0" not in [s.item for s in result.itemScores]
+        # users view their own parity, so i0's cosine neighbors skew even
+        parities = [int(s.item[1:]) % 2 for s in result.itemScores]
+        assert parities.count(0) >= 2
+        # filters apply on the cosine path too
+        black = [
+            s.item
+            for s in algo.predict(
+                model, sim.Query(items=["i0"], num=5, blackList=["i2"])
+            ).itemScores
+        ]
+        assert "i2" not in black
+        assert algo.predict(model, sim.Query(items=["zz"])).itemScores == []
+
+
+class TestRecommendedUser:
+    @pytest.fixture()
+    def seeded(self, storage):
+        app_id = storage.get_metadata_apps().insert(App(0, "RecUserApp"))
+        events = storage.get_events()
+        rng = np.random.default_rng(4)
+        for u in range(20):
+            events.insert(_set("user", f"u{u}", {}), app_id)
+        # users follow users of their own parity (plus a little noise)
+        for u in range(20):
+            for _ in range(6):
+                t = int(rng.integers(0, 10)) * 2 + (u % 2)
+                if t != u:
+                    events.insert(
+                        Event(
+                            event="follow",
+                            entity_type="user",
+                            entity_id=f"u{u}",
+                            target_entity_type="user",
+                            target_entity_id=f"u{t}",
+                        ),
+                        app_id,
+                    )
+        return storage
+
+    def ep(self):
+        from predictionio_tpu.models import recommendeduser as ru
+
+        return EngineParams(
+            datasource=("", ru.DataSourceParams(app_name="RecUserApp")),
+            algorithms=[
+                ("als", ru.ALSAlgorithmParams(rank=6, num_iterations=8, alpha=2.0))
+            ],
+        )
+
+    def test_similar_users_same_parity(self, seeded):
+        from predictionio_tpu.models import recommendeduser as ru
+
+        engine = ru.engine()
+        run_train(engine, self.ep(), engine_id="recuser", storage=seeded)
+        inst = seeded.get_metadata_engine_instances().get_latest_completed(
+            "recuser", "0", "default"
+        )
+        _, [algo], [model], serving = prepare_deploy(engine, inst, storage=seeded)
+        q = ru.Query(users=["u0"], num=4)
+        result = serving.serve(q, [algo.predict(model, q)])
+        assert len(result.userScores) == 4
+        assert "u0" not in [s.user for s in result.userScores]
+        parities = [int(s.user[1:]) % 2 for s in result.userScores]
+        assert parities.count(0) >= 3
+
+    def test_white_black_lists(self, seeded):
+        from predictionio_tpu.models import recommendeduser as ru
+
+        algo = ru.ALSAlgorithm(ru.ALSAlgorithmParams(rank=4, num_iterations=4))
+        td = ru.RecommendedUserDataSource(
+            ru.DataSourceParams(app_name="RecUserApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        white = [
+            s.user
+            for s in algo.predict(
+                model, ru.Query(users=["u0"], num=5, whiteList=["u2", "u4"])
+            ).userScores
+        ]
+        assert set(white) <= {"u2", "u4"}
+        black = [
+            s.user
+            for s in algo.predict(
+                model, ru.Query(users=["u0"], num=5, blackList=["u2"])
+            ).userScores
+        ]
+        assert "u2" not in black
+        assert algo.predict(model, ru.Query(users=["zz"])).userScores == []
+
 
 class TestECommerce:
     @pytest.fixture()
