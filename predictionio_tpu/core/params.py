@@ -20,6 +20,18 @@ from typing import Any, Mapping, Sequence, Type, TypeVar
 P = TypeVar("P", bound="Params")
 
 
+def _snake(name: str) -> str:
+    """camelCase JSON key -> snake_case dataclass field name."""
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
 @dataclass
 class Params:
     """Base class for component parameters. Subclass as a dataclass."""
@@ -42,7 +54,15 @@ class Params:
         if not dataclasses.is_dataclass(cls):
             raise TypeError(f"{cls.__name__} must be a dataclass")
         names = {f.name for f in dataclasses.fields(cls)}
-        kwargs = {k: v for k, v in d.items() if k in names}
+        kwargs = {}
+        for k, v in d.items():
+            # accept both snake_case and the reference engine.json's
+            # camelCase (Scala field names), plus Python-keyword escapes
+            # ("lambda" -> field "lambda_")
+            for cand in (k, _snake(k), k + "_", _snake(k) + "_"):
+                if cand in names:
+                    kwargs[cand] = v
+                    break
         return cls(**kwargs)
 
     @classmethod

@@ -46,8 +46,15 @@ class AccessKey:
 
 
 def generate_access_key() -> str:
-    """64 random bytes, URL-safe base64 (reference AccessKeys.generateKey)."""
-    return base64.urlsafe_b64encode(secrets.token_bytes(48)).decode("ascii").rstrip("=")
+    """64 random bytes, URL-safe base64 (reference AccessKeys.generateKey).
+
+    Keys never start with ``-`` so they stay safe to pass as positional CLI
+    arguments (argparse would treat a leading dash as a flag).
+    """
+    while True:
+        key = base64.urlsafe_b64encode(secrets.token_bytes(48)).decode("ascii").rstrip("=")
+        if not key.startswith("-"):
+            return key
 
 
 CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")

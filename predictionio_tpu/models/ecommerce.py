@@ -262,16 +262,12 @@ class ECommAlgorithm(Algorithm):
     def _mask_and_weights(
         self, model: ECommModel, query: Query
     ) -> tuple[np.ndarray, np.ndarray]:
+        from predictionio_tpu.models.filters import entity_exclusion_mask
+
         n = len(model.item_index)
-        mask = np.zeros(n, dtype=bool)
-        if query.whiteList is not None:
-            allowed = {
-                model.item_index[i] for i in query.whiteList if i in model.item_index
-            }
-            mask |= ~np.isin(np.arange(n), list(allowed))
-        for iid in query.blackList or []:
-            if iid in model.item_index:
-                mask[model.item_index[iid]] = True
+        mask = entity_exclusion_mask(
+            model.item_index, (), query.whiteList, query.blackList
+        )
         if query.categories is not None:
             wanted = set(query.categories)
             for iid, ix in model.item_index.items():

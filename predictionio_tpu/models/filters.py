@@ -1,0 +1,48 @@
+"""Shared serve-time helpers for the cosine-scoring templates.
+
+The self/whiteList/blackList exclusion semantics are common to the
+similar-product, recommended-user, and e-commerce templates (reference
+examples/scala-parallel-similarproduct/multi/src/main/scala/
+ALSAlgorithm.scala:193-244 and the recommended-user variant): query
+entities are never recommended back, a whitelist restricts candidates to
+its members, a blacklist removes its members.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+
+
+def normalized_device_factors(factors: np.ndarray):
+    """Row-normalize factors and place on device (dot == cosine after
+    this). The cosine-scoring models cache the result per process."""
+    import jax.numpy as jnp
+
+    norms = np.linalg.norm(factors, axis=1, keepdims=True)
+    return jnp.asarray(factors / np.maximum(norms, 1e-12))
+
+
+def entity_exclusion_mask(
+    index: BiMap,
+    self_entities: Iterable[str],
+    white_list: Sequence[str] | None,
+    black_list: Sequence[str] | None,
+) -> np.ndarray:
+    """[len(index)] bool mask; True = candidate may never be returned."""
+    n = len(index)
+    mask = np.zeros(n, dtype=bool)
+    for ent in self_entities:
+        if ent in index:
+            mask[index[ent]] = True
+    if white_list is not None:
+        allowed = {index[e] for e in white_list if e in index}
+        mask |= ~np.isin(np.arange(n), list(allowed))
+    if black_list:
+        for ent in black_list:
+            if ent in index:
+                mask[index[ent]] = True
+    return mask

@@ -154,6 +154,11 @@ class ALSAlgorithmParams(Params):
     seed: int = 3
     compute_dtype: str = "float32"
     use_pallas: bool = False
+    # serve with item factors sharded over the device mesh (ring top-k) —
+    # the TPU answer to the reference's PAlgorithm "model bigger than one
+    # host" case, which issues a Spark job per query instead
+    # (examples/.../ALSAlgorithm.scala:88)
+    sharded_serving: bool = False
 
 
 @dataclass
@@ -167,6 +172,7 @@ class ALSModel:
 
     def __post_init__(self):
         self._device = None
+        self._ring = None
 
     def device_factors(self):
         """(U_dev, V_dev) cached on current default device."""
@@ -179,9 +185,20 @@ class ALSModel:
             )
         return self._device
 
+    def ring_catalog(self):
+        """Item factors staged sharded over the full mesh, cached — the
+        deployed-server resident layout for catalogs bigger than one chip."""
+        if self._ring is None:
+            from predictionio_tpu.parallel.mesh import make_mesh
+            from predictionio_tpu.parallel.ring_topk import RingCatalog
+
+            self._ring = RingCatalog(self.item_factors, make_mesh())
+        return self._ring
+
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_device"] = None
+        state["_ring"] = None
         return state
 
 
@@ -230,14 +247,21 @@ class ALSAlgorithm(Algorithm):
             # unseen user: no personalization signal (reference behavior:
             # empty result rather than failure)
             return PredictedResult(itemScores=[])
-        U, V = model.device_factors()
         uix = model.user_index[query.user]
-        scores, ids = top_k_items(U[uix], V, k=int(query.num))
+        if self.params.sharded_serving:
+            scores, ids = model.ring_catalog().top_k(
+                model.user_factors[uix : uix + 1], int(query.num)
+            )
+            scores, ids = scores[0], ids[0]
+        else:
+            U, V = model.device_factors()
+            scores, ids = top_k_items(U[uix], V, k=int(query.num))
         inv = model.item_index.inverse
         return PredictedResult(
             itemScores=[
                 ItemScore(item=inv[int(i)], score=float(s))
                 for s, i in zip(np.asarray(scores), np.asarray(ids))
+                if int(i) >= 0
             ]
         )
 
@@ -248,7 +272,6 @@ class ALSAlgorithm(Algorithm):
         users (the P2L batchPredict analog, done as a single MXU matmul)."""
         from predictionio_tpu.ops.topk import top_k_items_batch
 
-        U, V = model.device_factors()
         known = [(ix, q) for ix, q in queries if q.user in model.user_index]
         out: list[tuple[int, PredictedResult]] = [
             (ix, PredictedResult(itemScores=[]))
@@ -260,7 +283,13 @@ class ALSAlgorithm(Algorithm):
                 [model.user_index[q.user] for _, q in known], dtype=np.int32
             )
             k = max(int(q.num) for _, q in known)
-            scores, ids = top_k_items_batch(U[uixs], V, k=k)
+            if self.params.sharded_serving:
+                scores, ids = model.ring_catalog().top_k(
+                    model.user_factors[uixs], k
+                )
+            else:
+                U, V = model.device_factors()
+                scores, ids = top_k_items_batch(U[uixs], V, k=k)
             scores, ids = np.asarray(scores), np.asarray(ids)
             inv = model.item_index.inverse
             for row, (ix, q) in enumerate(known):
@@ -273,6 +302,7 @@ class ALSAlgorithm(Algorithm):
                                 for s, i in zip(
                                     scores[row, : q.num], ids[row, : q.num]
                                 )
+                                if int(i) >= 0
                             ]
                         ),
                     )

@@ -106,12 +106,9 @@ class RecommendedUserModel:
 
     def device_factors(self):
         if self._device is None:
-            import jax.numpy as jnp
+            from predictionio_tpu.models.filters import normalized_device_factors
 
-            norms = np.linalg.norm(self.followed_factors, axis=1, keepdims=True)
-            self._device = jnp.asarray(
-                self.followed_factors / np.maximum(norms, 1e-12)
-            )
+            self._device = normalized_device_factors(self.followed_factors)
         return self._device
 
     def __getstate__(self):
@@ -169,17 +166,11 @@ class ALSAlgorithm(Algorithm):
         V = model.device_factors()
         query_vec = V[jnp.asarray(np.asarray(known, dtype=np.int32))].sum(axis=0)
 
-        n = len(index)
-        mask = np.zeros(n, dtype=bool)
-        mask[known] = True  # never recommend the query users themselves
-        if query.whiteList is not None:
-            allowed = {index[u] for u in query.whiteList if u in index}
-            mask |= ~np.isin(np.arange(n), list(allowed))
-        if query.blackList:
-            for uid in query.blackList:
-                if uid in index:
-                    mask[index[uid]] = True
+        from predictionio_tpu.models.filters import entity_exclusion_mask
 
+        mask = entity_exclusion_mask(
+            index, query.users, query.whiteList, query.blackList
+        )
         scores, ids = top_k_items(
             query_vec, V, k=int(query.num), exclude_mask=jnp.asarray(mask)
         )

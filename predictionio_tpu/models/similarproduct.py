@@ -135,11 +135,9 @@ class SimilarProductModel:
 
     def device_factors(self):
         if self._device is None:
-            import jax.numpy as jnp
+            from predictionio_tpu.models.filters import normalized_device_factors
 
-            norms = np.linalg.norm(self.item_factors, axis=1, keepdims=True)
-            normalized = self.item_factors / np.maximum(norms, 1e-12)
-            self._device = jnp.asarray(normalized)
+            self._device = normalized_device_factors(self.item_factors)
         return self._device
 
     def __getstate__(self):
@@ -153,18 +151,11 @@ def _exclude_mask(
 ) -> np.ndarray:
     """Build the candidate-exclusion mask from query items, category,
     white/black lists (reference ALSAlgorithm.scala:193-244 filters)."""
-    n = len(item_index)
-    mask = np.zeros(n, dtype=bool)
-    for iid in query.items:  # never recommend the query items themselves
-        if iid in item_index:
-            mask[item_index[iid]] = True
-    if query.whiteList is not None:
-        allowed = {item_index[i] for i in query.whiteList if i in item_index}
-        mask |= ~np.isin(np.arange(n), list(allowed))
-    if query.blackList:
-        for iid in query.blackList:
-            if iid in item_index:
-                mask[item_index[iid]] = True
+    from predictionio_tpu.models.filters import entity_exclusion_mask
+
+    mask = entity_exclusion_mask(
+        item_index, query.items, query.whiteList, query.blackList
+    )
     if query.categories is not None:
         wanted = set(query.categories)
         for iid, ix in item_index.items():
@@ -316,7 +307,7 @@ class CosineAlgorithm(Algorithm):
         ranked = sorted(
             ((jx, s) for jx, s in combined.items() if not mask[jx]),
             key=lambda kv: -kv[1],
-        )[: query.num]
+        )[: int(query.num)]
         return PredictedResult(
             itemScores=[ItemScore(item=inv[jx], score=s) for jx, s in ranked]
         )

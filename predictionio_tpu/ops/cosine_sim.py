@@ -1,14 +1,20 @@
-"""Item-item cosine similarity from raw interactions.
+"""Item-item cosine similarity from raw interactions, kept sparse.
 
 Replaces the reference's experimental DIMSUM template
 (examples/experimental/scala-parallel-similarproduct-dimsum), which uses
 ``RowMatrix.columnSimilarities(threshold)`` — a *sampling approximation*
 of column cosines that exists only because all-pairs similarity is
-shuffle-bound on Spark. On TPU the exact computation is a single
-column-normalized Gram matmul on the MXU, so no sampling is needed:
-``S = Â^T Â`` with ``Â`` column-normalized, computed in row blocks of S
-via ``lax.map`` so peak memory is O(block · I) instead of O(I²), then
-``top_k`` per row to keep the N nearest neighbors.
+shuffle-bound on Spark. On TPU the exact computation is column-normalized
+Gram matmuls on the MXU.
+
+The interaction matrix is never densified in full. Triples are deduped
+and bucketed into fixed-size user chunks host-side; on device each chunk
+is scattered into a [chunk_users, I] tile, and for one item block b the
+Gram rows ``G_b = A[:, b]^T A`` accumulate over chunk tiles via
+``lax.scan`` (tile_b^T @ tile). Peak device memory is
+O(chunk·I + block·I) regardless of user count; tiles are rebuilt once per
+item block (flash-attention-style recompute — FLOPs for memory). Top-N
+per row then keeps the N nearest neighbors.
 """
 
 from __future__ import annotations
@@ -20,40 +26,86 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@functools.partial(jax.jit, static_argnames=("top_n", "block"))
-def _topn_similarity(dense, top_n: int, block: int):
-    """dense: [U, I] interaction matrix. Returns (scores [I, top_n],
-    ids [I, top_n]) of the most cosine-similar *other* items per item."""
-    num_items = dense.shape[1]
-    norms = jnp.linalg.norm(dense, axis=0)
-    a_norm = dense / jnp.maximum(norms, 1e-12)[None, :]  # [U, I]
-
-    n_blocks = (num_items + block - 1) // block
-    pad = n_blocks * block - num_items
-    a_pad = jnp.pad(a_norm, ((0, 0), (0, pad)))  # padded cols have zero norm
-    blocks = a_pad.T.reshape(n_blocks, block, -1)  # [n_blocks, block, U]
-
-    col_ids = jnp.arange(num_items)
-
-    def one_block(args):
-        rows, row_ids = args  # [block, U], [block]
-        sim = rows @ a_norm  # MXU: [block, I]
-        # mask self-similarity; items with no interactions have no
-        # neighbors and are never neighbors themselves
-        row_norms = jnp.take(norms, jnp.minimum(row_ids, num_items - 1))
-        sim = jnp.where(col_ids[None, :] == row_ids[:, None], -jnp.inf, sim)
-        sim = jnp.where(norms[None, :] > 0, sim, -jnp.inf)
-        sim = jnp.where(row_norms[:, None] > 0, sim, -jnp.inf)
-        return jax.lax.top_k(sim, top_n)
-
-    row_id_blocks = (
-        jnp.arange(n_blocks * block).reshape(n_blocks, block)
-    )
-    scores, ids = jax.lax.map(one_block, (blocks, row_id_blocks))
+def _dedupe(rows, cols, vals, num_users, num_items):
+    """Combine duplicate (user, item) entries by summation (matrix build
+    semantics of np.add.at in the previous dense path)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    vals = np.asarray(vals, dtype=np.float32)
+    flat = rows * num_items + cols
+    order = np.argsort(flat, kind="stable")
+    flat, vals = flat[order], vals[order]
+    boundaries = np.concatenate([[True], flat[1:] != flat[:-1]])
+    starts = np.nonzero(boundaries)[0]
+    summed = np.add.reduceat(vals, starts) if len(vals) else vals
+    uflat = flat[starts] if len(vals) else flat
     return (
-        scores.reshape(-1, top_n)[:num_items],
-        ids.reshape(-1, top_n)[:num_items],
+        (uflat // num_items).astype(np.int32),
+        (uflat % num_items).astype(np.int32),
+        summed.astype(np.float32),
     )
+
+
+def _chunk_triples(rows, cols, vals, num_users, chunk: int):
+    """Bucket user-sorted triples into [n_chunks, max_nnz] padded arrays.
+    Padding scatters to a dummy tile row (local id == chunk)."""
+    n_chunks = max(1, (num_users + chunk - 1) // chunk)
+    chunk_of = rows // chunk
+    counts = np.bincount(chunk_of, minlength=n_chunks)
+    max_nnz = max(1, int(counts.max()) if len(counts) else 1)
+    r = np.full((n_chunks, max_nnz), chunk, dtype=np.int32)  # dummy row
+    c = np.zeros((n_chunks, max_nnz), dtype=np.int32)
+    v = np.zeros((n_chunks, max_nnz), dtype=np.float32)
+    # triples are already user-sorted from _dedupe
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    for b in range(n_chunks):
+        lo, hi = offsets[b], offsets[b + 1]
+        n = hi - lo
+        r[b, :n] = rows[lo:hi] - b * chunk
+        c[b, :n] = cols[lo:hi]
+        v[b, :n] = vals[lo:hi]
+    return r, c, v
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_items", "chunk", "block", "top_n")
+)
+def _block_topn(
+    chunk_r,  # [n_chunks, max_nnz] local user ids (chunk == padding)
+    chunk_c,  # [n_chunks, max_nnz] item ids
+    chunk_v,  # [n_chunks, max_nnz] values
+    norms,  # [I] column norms
+    start,  # scalar: first item id of this output block
+    num_items: int,
+    chunk: int,
+    block: int,
+    top_n: int,
+):
+    """(scores [block, top_n], ids [block, top_n]) for one item block."""
+
+    def step(G, trip):
+        r, c, v = trip
+        tile = jnp.zeros((chunk + 1, num_items), jnp.float32)
+        tile = tile.at[r, c].add(v)[:chunk]  # dummy row dropped
+        tile_b = jax.lax.dynamic_slice(tile, (0, start), (chunk, block))
+        return G + tile_b.T @ tile, None  # MXU: [block, I]
+
+    G, _ = jax.lax.scan(
+        step,
+        jnp.zeros((block, num_items), jnp.float32),
+        (chunk_r, chunk_c, chunk_v),
+    )
+    row_ids = start + jnp.arange(block)
+    row_norms = jnp.take(norms, jnp.minimum(row_ids, num_items - 1))
+    sim = G / jnp.maximum(row_norms[:, None] * norms[None, :], 1e-12)
+    col_ids = jnp.arange(num_items)
+    # self-similarity masked; items with no interactions have no
+    # neighbors and are never neighbors themselves; rows past the end
+    # of the catalog (last-block padding) are garbage the caller trims
+    sim = jnp.where(col_ids[None, :] == row_ids[:, None], -jnp.inf, sim)
+    sim = jnp.where(norms[None, :] > 0, sim, -jnp.inf)
+    sim = jnp.where(row_norms[:, None] > 0, sim, -jnp.inf)
+    return jax.lax.top_k(sim, top_n)
 
 
 def item_similarity_topn(
@@ -64,14 +116,50 @@ def item_similarity_topn(
     num_items: int,
     top_n: int = 20,
     block: int = 256,
+    user_chunk: int = 1024,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Exact top-N cosine neighbors per item from (user, item, value)
     interaction triples. Returns (scores [I, N], ids [I, N]); entries with
     score == -inf are padding (items with < N valid neighbors)."""
-    dense = np.zeros((num_users, num_items), dtype=np.float32)
-    np.add.at(dense, (np.asarray(rows), np.asarray(cols)), np.asarray(vals))
+    if num_items == 0:
+        return (
+            np.zeros((0, top_n), np.float32),
+            np.zeros((0, top_n), np.int32),
+        )
+    rows, cols, vals = _dedupe(rows, cols, vals, num_users, num_items)
+    norms = np.zeros(num_items, dtype=np.float32)
+    np.add.at(norms, cols, vals * vals)
+    norms = np.sqrt(norms)
+
+    chunk = int(min(user_chunk, max(8, num_users)))
+    block = int(max(1, min(block, num_items)))
     top_n = int(min(top_n, max(1, num_items - 1)))
-    scores, ids = _topn_similarity(
-        jnp.asarray(dense), top_n, int(min(block, max(8, num_items)))
+    chunk_r, chunk_c, chunk_v = _chunk_triples(rows, cols, vals, num_users, chunk)
+    chunk_r, chunk_c, chunk_v, norms_d = (
+        jnp.asarray(chunk_r),
+        jnp.asarray(chunk_c),
+        jnp.asarray(chunk_v),
+        jnp.asarray(norms),
     )
-    return np.asarray(scores), np.asarray(ids)
+
+    out_s, out_i = [], []
+    for start in range(0, num_items, block):
+        # clamp so the final block stays in range (its overlap rows are
+        # recomputed and trimmed below); one compile for all blocks
+        s, i = _block_topn(
+            chunk_r,
+            chunk_c,
+            chunk_v,
+            norms_d,
+            min(start, max(0, num_items - block)),
+            num_items=num_items,
+            chunk=chunk,
+            block=block,
+            top_n=top_n,
+        )
+        lo = start - min(start, max(0, num_items - block))
+        out_s.append(np.asarray(s)[lo:])
+        out_i.append(np.asarray(i)[lo:])
+    scores = np.concatenate(out_s)[:num_items]
+    ids = np.concatenate(out_i)[:num_items]
+    return scores, ids

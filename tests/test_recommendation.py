@@ -117,6 +117,30 @@ class TestTrainPredict:
         model = algo.train(CTX, td)
         assert algo.predict(model, rec.Query(user="stranger")).itemScores == []
 
+    def test_sharded_serving_matches_dense(self, seeded_app):
+        """Ring-sharded serving (mesh-resident item factors) returns the
+        same recommendations as the single-device dense path."""
+        td = rec.RecommendationDataSource(
+            rec.DataSourceParams(app_name="RecApp")
+        ).read_training(CTX)
+        dense = rec.ALSAlgorithm(rec.ALSAlgorithmParams(rank=4, num_iterations=3))
+        model = dense.train(CTX, td)
+        ring = rec.ALSAlgorithm(
+            rec.ALSAlgorithmParams(rank=4, num_iterations=3, sharded_serving=True)
+        )
+        q = rec.Query(user="u3", num=5)
+        assert [s.item for s in ring.predict(model, q).itemScores] == [
+            s.item for s in dense.predict(model, q).itemScores
+        ]
+        queries = [(0, rec.Query("u0", 3)), (1, rec.Query("u4", 4))]
+        rb, db = dict(ring.batch_predict(model, queries)), dict(
+            dense.batch_predict(model, queries)
+        )
+        for ix in (0, 1):
+            assert [s.item for s in rb[ix].itemScores] == [
+                s.item for s in db[ix].itemScores
+            ]
+
     def test_batch_predict_matches_single(self, seeded_app):
         algo = rec.ALSAlgorithm(rec.ALSAlgorithmParams(rank=4, num_iterations=3))
         td = rec.RecommendationDataSource(
