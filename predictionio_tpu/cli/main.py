@@ -297,6 +297,55 @@ def cmd_import(args) -> int:
     return 0
 
 
+def cmd_run(args) -> int:
+    """Run a user main function with storage configured (reference `pio
+    run` — Console.scala:664-700 launches a main class on Spark with the
+    pio classpath; here: import dotted path, call its main/entry)."""
+    import importlib
+
+    target = args.main_class
+    mod_name, _, attr = target.partition(":")
+    mod = importlib.import_module(mod_name)
+    fn = getattr(mod, attr or "main", None)
+    if fn is None:
+        raise SystemExit(
+            f"error: {mod_name} has no {attr or 'main'}(); use "
+            "module:function to name an entry point"
+        )
+    result = fn(*args.args)
+    # a bool return is success/failure, not an exit code (int(True) == 1)
+    if isinstance(result, bool):
+        return 0 if result else 1
+    return int(result) if isinstance(result, int) else 0
+
+
+def cmd_unregister(args) -> int:
+    # engine registration is implicit for Python factories (import-by-name,
+    # no registry rows to delete) — no-op parity with Console.scala's
+    # unregister verb
+    print("Nothing to unregister: Python engine factories are resolved by import.")
+    return 0
+
+
+def cmd_shell(args) -> int:
+    """Interactive REPL with the storage singleton wired (the pio-shell
+    analog, bin/pio-shell — a Spark shell with pio jars preloaded)."""
+    import code
+
+    from predictionio_tpu.data import store
+    from predictionio_tpu.data.storage import get_storage
+
+    banner = (
+        "predictionio-tpu shell\n"
+        "  storage  -> configured Storage singleton\n"
+        "  store    -> event-store facade (find/aggregate_properties)\n"
+    )
+    code.interact(
+        banner=banner, local={"storage": get_storage(), "store": store}
+    )
+    return 0
+
+
 def cmd_template(args) -> int:
     # deprecated no-op in the reference too (Console.scala template verbs)
     print(
@@ -414,6 +463,14 @@ def build_parser() -> argparse.ArgumentParser:
     tpl = sub.add_parser("template")
     tpl.add_argument("rest", nargs="*")
     tpl.set_defaults(fn=cmd_template)
+
+    r = sub.add_parser("run")
+    r.add_argument("main_class", help="dotted module path, or module:function")
+    r.add_argument("args", nargs="*")
+    r.set_defaults(fn=cmd_run)
+
+    sub.add_parser("unregister").set_defaults(fn=cmd_unregister)
+    sub.add_parser("shell").set_defaults(fn=cmd_shell)
 
     return p
 

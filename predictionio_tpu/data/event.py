@@ -200,9 +200,24 @@ def generate_event_id() -> str:
 
 
 def format_time(dt: datetime) -> str:
-    """ISO-8601 with milliseconds and offset, e.g. 2026-07-29T00:00:00.000Z."""
-    dt = _ensure_aware(dt).astimezone(timezone.utc)
-    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+    """ISO-8601 with milliseconds, e.g. 2026-07-29T00:00:00.000Z.
+
+    The event's original UTC offset is preserved (the reference keeps the
+    submitted DateTime's zone through storage and API round-trips,
+    storage/EventJson4sSupport.scala); UTC renders as ``Z``.
+    """
+    dt = _ensure_aware(dt)
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}"
+    offset = dt.utcoffset()
+    if not offset:
+        return base + "Z"
+    total = int(offset.total_seconds())
+    sign = "+" if total >= 0 else "-"
+    total = abs(total)
+    out = base + f"{sign}{total // 3600:02d}:{(total % 3600) // 60:02d}"
+    if total % 60:  # sub-minute offsets (e.g. LMT zones) must round-trip
+        out += f":{total % 60:02d}"
+    return out
 
 
 def parse_time(s: str | datetime) -> datetime:

@@ -108,16 +108,68 @@ def _localfs_backend() -> _Backend:
     )
 
 
+def _jsonl_backend() -> _Backend:
+    from predictionio_tpu.data.storage import jsonl as jl
+
+    return _Backend(
+        client_factory=lambda cfg: jl.JSONLStorageClient(cfg),
+        daos={"Events": jl.JSONLEvents},
+    )
+
+
+def _hdfs_backend() -> _Backend:
+    from predictionio_tpu.data.storage import objectstore as obj
+
+    return _Backend(
+        client_factory=lambda cfg: obj.DFSStorageClient(cfg),
+        daos={"Models": obj.DFSModels},
+    )
+
+
+def _s3_backend() -> _Backend:
+    from predictionio_tpu.data.storage import objectstore as obj
+
+    return _Backend(
+        client_factory=lambda cfg: obj.S3StorageClient(cfg),
+        daos={"Models": obj.S3Models},
+    )
+
+
 _BACKEND_TYPES: dict[str, Callable[[], _Backend]] = {
     "sqlite": _sqlite_backend,
     "memory": _memory_backend,
     "localfs": _localfs_backend,
+    "jsonl": _jsonl_backend,
+    "hdfs": _hdfs_backend,
+    "s3": _s3_backend,
+}
+
+# which repositories each backend type can serve (capability subsets,
+# reference SURVEY §2.3: jdbc=all, hbase=events, localfs/hdfs/s3=models)
+_TYPE_CAPABILITIES: dict[str, tuple[str, ...]] = {
+    "sqlite": REPOSITORIES,
+    "memory": REPOSITORIES,
+    "localfs": (MODELDATA,),
+    "jsonl": (EVENTDATA,),
+    "hdfs": (MODELDATA,),
+    "s3": (MODELDATA,),
 }
 
 
-def register_backend_type(name: str, factory: Callable[[], _Backend]) -> None:
-    """Extension point for additional backends (the reflective-load analog)."""
+def register_backend_type(
+    name: str,
+    factory: Callable[[], _Backend],
+    capabilities: tuple[str, ...] | None = None,
+) -> None:
+    """Extension point for additional backends (the reflective-load analog).
+
+    ``capabilities`` lists the repositories the backend can serve
+    (default: all three).
+    """
     _BACKEND_TYPES[name] = factory
+    _TYPE_CAPABILITIES[name] = (
+        tuple(capabilities) if capabilities is not None else REPOSITORIES
+    )
 
 
 class Storage:
@@ -178,19 +230,27 @@ class Storage:
             self._source_types[name] = source_type
             self._source_configs[name] = cfg
 
-        # Default bindings prefer capability-appropriate sources: localfs
-        # only supports Models, so METADATA/EVENTDATA default to the first
-        # non-localfs source.
-        non_localfs = [n for n, t in self._source_types.items() if t != "localfs"]
-        general = non_localfs[0] if non_localfs else next(iter(self._source_types))
-        default_repos = {
-            METADATA: general,
-            EVENTDATA: general,
-            MODELDATA: next(
-                (n for n, t in self._source_types.items() if t == "localfs"),
-                general,
-            ),
-        }
+        # Default bindings prefer capability-appropriate sources (each
+        # backend implements a subset of the DAOs, like the reference's
+        # backends — SURVEY §2.3); an explicit *_SOURCE binding always wins.
+        def first_capable(repo: str) -> str:
+            capable = [
+                n
+                for n, t in self._source_types.items()
+                if repo in _TYPE_CAPABILITIES.get(t, ())
+            ]
+            if capable:
+                # most specialized wins: a models-only source (localfs/
+                # hdfs/s3) beats the general SQL source for MODELDATA
+                return min(
+                    capable,
+                    key=lambda n: len(
+                        _TYPE_CAPABILITIES.get(self._source_types[n], REPOSITORIES)
+                    ),
+                )
+            return next(iter(self._source_types))
+
+        default_repos = {repo: first_capable(repo) for repo in REPOSITORIES}
         for repo in REPOSITORIES:
             src = self.env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE")
             if src is None:

@@ -1,0 +1,155 @@
+"""Append-log (JSON-lines) event backend.
+
+The file-backed analog of the reference's HBase event store
+(storage/hbase/src/main/scala/.../HBEventsUtil.scala: table
+``events_<appId>[_<ch>]``, log-structured writes): one ``.jsonl`` file per
+(app, channel), writes append a put/delete record, reads replay the log
+(last write per event id wins — LSM semantics without the compaction
+daemon; ``remove`` drops the file, ``compact`` rewrites it).
+
+Capability subset: Events only — like hbase in the reference
+(SURVEY §2.3), metadata/models live in another source.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from datetime import datetime
+from pathlib import Path
+from typing import Sequence
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.memory import _matches
+
+
+class JSONLStorageClient:
+    def __init__(self, config: dict | None = None):
+        self.config = config or {}
+        self.base_path = Path(
+            self.config.get("path", "~/.pio_tpu/events")
+        ).expanduser()
+        self.base_path.mkdir(parents=True, exist_ok=True)
+        self.lock = threading.RLock()
+
+
+class JSONLEvents(base.Events):
+    def __init__(self, client: JSONLStorageClient):
+        self._c = client
+
+    def _file(self, app_id: int, channel_id: int | None) -> Path:
+        name = f"events_{app_id}" + (
+            f"_{channel_id}" if channel_id is not None else ""
+        )
+        return self._c.base_path / f"{name}.jsonl"
+
+    def _replay(self, app_id: int, channel_id: int | None) -> dict[str, Event]:
+        """Fold the log: last record per event id wins."""
+        path = self._file(app_id, channel_id)
+        table: dict[str, Event] = {}
+        if not path.exists():
+            return table
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if "$delete" in rec:
+                    table.pop(rec["$delete"], None)
+                else:
+                    e = Event.from_dict(rec)
+                    table[e.event_id] = e
+        return table
+
+    def _append(self, app_id: int, channel_id: int | None, record: dict) -> None:
+        path = self._file(app_id, channel_id)
+        with self._c.lock:
+            with open(path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        with self._c.lock:
+            self._file(app_id, channel_id).touch()
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        with self._c.lock:
+            path = self._file(app_id, channel_id)
+            if path.exists():
+                path.unlink()
+                return True
+            return False
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        event_id = event.event_id or uuid.uuid4().hex
+        e = event.with_event_id(event_id)
+        self._append(app_id, channel_id, e.to_dict(for_api=True))
+        return event_id
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        with self._c.lock:
+            return self._replay(app_id, channel_id).get(event_id)
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        with self._c.lock:
+            if event_id not in self._replay(app_id, channel_id):
+                return False
+            self._append(app_id, channel_id, {"$delete": event_id})
+            return True
+
+    def compact(self, app_id: int, channel_id: int | None = None) -> int:
+        """Rewrite the log to its live records; returns the live count."""
+        with self._c.lock:
+            table = self._replay(app_id, channel_id)
+            path = self._file(app_id, channel_id)
+            tmp = path.with_suffix(".jsonl.tmp")
+            with open(tmp, "w") as f:
+                for e in table.values():
+                    f.write(json.dumps(e.to_dict(for_api=True)) + "\n")
+            tmp.replace(path)
+            return len(table)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed_order: bool = False,
+    ) -> list[Event]:
+        with self._c.lock:
+            events = list(self._replay(app_id, channel_id).values())
+        out = [
+            e
+            for e in events
+            if _matches(
+                e,
+                start_time,
+                until_time,
+                entity_type,
+                entity_id,
+                event_names,
+                target_entity_type,
+                target_entity_id,
+            )
+        ]
+        out.sort(key=lambda e: e.event_time, reverse=reversed_order)
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return out

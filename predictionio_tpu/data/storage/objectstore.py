@@ -1,0 +1,117 @@
+"""Remote model stores: S3 and mounted-DFS backends (Models only).
+
+Parity with the reference's models-only backends (SURVEY §2.3):
+
+- ``S3Models`` — reference storage/s3/.../S3Models.scala:36 (AWS SDK,
+  optional bucket/prefix/endpoint). Gated on ``boto3`` being importable
+  (it is not baked into every image); tests and air-gapped deployments
+  can inject any duck-typed client via ``config["client"]``.
+- ``DFSModels`` — reference storage/hdfs/.../HDFSModels.scala:31 (Hadoop
+  FileSystem read/write). There is no JVM Hadoop client here; the
+  TPU-native equivalent is a POSIX-mounted distributed filesystem (HDFS
+  fuse mount, GCS fuse, NFS) addressed by ``path``.
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.localfs import LocalFSModels, LocalFSStorageClient
+
+
+class DFSStorageClient(LocalFSStorageClient):
+    """Models on a mounted distributed filesystem (hdfs-backend analog)."""
+
+    def __init__(self, config: dict | None = None):
+        config = dict(config or {})
+        if "path" not in config:
+            raise ValueError(
+                "hdfs storage source needs PATH: the mount point of the "
+                "distributed filesystem (e.g. an hdfs-fuse or gcsfuse dir)"
+            )
+        super().__init__(config)
+
+
+class DFSModels(LocalFSModels):
+    pass
+
+
+class S3StorageClient:
+    def __init__(self, config: dict | None = None):
+        self.config = dict(config or {})
+        self.bucket = self.config.get("bucket_name") or self.config.get("bucket")
+        if not self.bucket:
+            raise ValueError("s3 storage source needs BUCKET_NAME")
+        self.prefix = self.config.get("base_path", "")
+        client = self.config.get("client")
+        if client is None:
+            try:
+                import boto3  # type: ignore[import-not-found]
+            except ImportError as err:
+                raise RuntimeError(
+                    "s3 storage backend needs boto3 (not installed); "
+                    "install it or inject a client via the CLIENT config key"
+                ) from err
+            kwargs = {}
+            if self.config.get("endpoint"):
+                kwargs["endpoint_url"] = self.config["endpoint"]
+            if self.config.get("region"):
+                kwargs["region_name"] = self.config["region"]
+            client = boto3.client("s3", **kwargs)
+        self.client = client
+
+
+class S3Models(base.Models):
+    def __init__(self, client: S3StorageClient):
+        self._c = client
+
+    def _key(self, model_id: str) -> str:
+        prefix = f"{self._c.prefix.rstrip('/')}/" if self._c.prefix else ""
+        return f"{prefix}pio_model_{model_id}.bin"
+
+    def insert(self, model: base.Model) -> None:
+        self._c.client.put_object(
+            Bucket=self._c.bucket, Key=self._key(model.id), Body=model.models
+        )
+
+    @staticmethod
+    def _is_missing(err: Exception) -> bool:
+        """True only for not-found errors; auth/network failures propagate."""
+        if isinstance(err, KeyError):
+            return True  # duck-typed test clients
+        code = (
+            getattr(err, "response", None) or {}
+        ).get("Error", {}).get("Code", "")
+        return code in ("NoSuchKey", "404", "NotFound")
+
+    def get(self, model_id: str) -> base.Model | None:
+        try:
+            resp = self._c.client.get_object(
+                Bucket=self._c.bucket, Key=self._key(model_id)
+            )
+        except Exception as err:
+            if self._is_missing(err):
+                return None
+            raise
+        body = resp["Body"]
+        data = body.read() if hasattr(body, "read") else body
+        return base.Model(model_id, data)
+
+    def _exists(self, model_id: str) -> bool:
+        head = getattr(self._c.client, "head_object", None)
+        try:
+            if head is not None:
+                head(Bucket=self._c.bucket, Key=self._key(model_id))
+                return True
+            return self.get(model_id) is not None
+        except Exception as err:
+            if self._is_missing(err):
+                return False
+            raise
+
+    def delete(self, model_id: str) -> bool:
+        if not self._exists(model_id):
+            return False
+        self._c.client.delete_object(
+            Bucket=self._c.bucket, Key=self._key(model_id)
+        )
+        return True

@@ -174,6 +174,32 @@ class TestCLILifecycle:
             if server.poll() is None:
                 server.kill()
 
+    def test_run_and_unregister_verbs(self, cli_env, tmp_path):
+        # `pio run` imports a dotted path and calls main()/named function
+        # with storage env configured (reference Console.scala run verb)
+        script_dir = tmp_path / "usercode"
+        script_dir.mkdir()
+        (script_dir / "myjob.py").write_text(
+            "def main(*args):\n"
+            "    from predictionio_tpu.data.storage import get_storage\n"
+            "    get_storage()  # env-configured singleton is reachable\n"
+            "    print('JOB-OK', args)\n"
+            "    return 0\n"
+            "def other(x):\n"
+            "    print('OTHER', x)\n"
+        )
+        env = dict(cli_env)
+        env["PYTHONPATH"] = f"{REPO}{os.pathsep}{script_dir}"
+        out = pio(["run", "myjob", "a1", "a2"], env).stdout
+        assert "JOB-OK ('a1', 'a2')" in out
+        out = pio(["run", "myjob:other", "x"], env).stdout
+        assert "OTHER x" in out
+        proc = pio(["run", "myjob:missing"], env, check=False)
+        assert proc.returncode != 0
+
+        out = pio(["unregister"], cli_env).stdout
+        assert "Nothing to unregister" in out
+
     def test_app_and_accesskey_verbs(self, cli_env):
         pio(["app", "new", "VerbApp"], cli_env)
         out = pio(["app", "list"], cli_env).stdout

@@ -45,13 +45,22 @@ def storages(tmp_path):
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
     }
-    return [make_test_storage(), Storage(env=sqlite_env)]
+    jsonl_env = {
+        "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio2.db"),
+        "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+        "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "eventlog"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+    }
+    return [make_test_storage(), Storage(env=sqlite_env), Storage(env=jsonl_env)]
 
 
-@pytest.fixture(params=["memory", "sqlite+localfs"])
+@pytest.fixture(params=["memory", "sqlite+localfs", "sqlite+jsonl"])
 def any_storage(request, tmp_path):
-    mem, sql = storages(tmp_path)
-    s = mem if request.param == "memory" else sql
+    mem, sql, jl = storages(tmp_path)
+    s = {"memory": mem, "sqlite+localfs": sql, "sqlite+jsonl": jl}[request.param]
     yield s
     s.close()
 

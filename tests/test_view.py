@@ -1,0 +1,103 @@
+"""Deprecated batch-view parity tests (reference data/.../view/*.scala)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.data.view import (
+    DataView,
+    EventSeq,
+    LBatchView,
+    PBatchView,
+    ViewPredicates,
+)
+
+T0 = datetime(2016, 1, 1, tzinfo=timezone.utc)
+
+
+@pytest.fixture()
+def app_with_events(storage):
+    app_id = storage.get_metadata_apps().insert(App(0, "ViewApp"))
+    events = storage.get_events()
+    events.init(app_id)
+    for i, e in enumerate(
+        [
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  properties={"a": 1, "b": 2}),
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  properties={"a": 3}),
+            Event(event="$unset", entity_type="user", entity_id="u1",
+                  properties={"b": None}),
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties={"price": 9}),
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": 4.0}),
+        ]
+    ):
+        events.insert(
+            Event(**{**e.__dict__, "event_time": T0 + timedelta(minutes=i)}),
+            app_id,
+        )
+    return app_id
+
+
+class TestLBatchView:
+    def test_emits_deprecation_warning(self, app_with_events, storage):
+        with pytest.warns(DeprecationWarning):
+            LBatchView(app_with_events, storage=storage)
+
+    def test_aggregate_properties_replays_ops(self, app_with_events, storage):
+        with pytest.warns(DeprecationWarning):
+            view = LBatchView(app_with_events, storage=storage)
+        props = view.aggregate_properties(entity_type="user")
+        assert set(props) == {"u1"}
+        assert dict(props["u1"]) == {"a": 3}  # b unset, a overwritten
+
+    def test_time_window(self, app_with_events, storage):
+        with pytest.warns(DeprecationWarning):
+            view = LBatchView(
+                app_with_events,
+                until_time=T0 + timedelta(minutes=1, seconds=30),
+                storage=storage,
+            )
+        assert len(view.events) == 2
+
+    def test_pbatchview_is_alias(self, app_with_events, storage):
+        with pytest.warns(DeprecationWarning):
+            view = PBatchView(app_with_events, storage=storage)
+        assert dict(view.aggregate_properties("item")["i1"]) == {"price": 9}
+
+
+class TestEventSeq:
+    def test_filter_and_fold(self, app_with_events, storage):
+        with pytest.warns(DeprecationWarning):
+            view = LBatchView(app_with_events, storage=storage)
+            rates = view.events.filter(event_name="rate")
+            assert len(rates) == 1
+            counts = view.events.filter(entity_type="user").aggregate_by_entity_ordered(
+                0, lambda acc, e: acc + 1
+            )
+        assert counts == {"u1": 4}
+
+    def test_predicates(self):
+        e = Event(event="rate", entity_type="user", entity_id="u1")
+        with pytest.warns(DeprecationWarning):
+            assert ViewPredicates.event_name("rate")(e)
+            assert not ViewPredicates.entity_type("item")(e)
+            assert ViewPredicates.start_time(None)(e)
+
+
+class TestDataView:
+    def test_typed_projection_drops_none(self, app_with_events, storage):
+        with pytest.warns(DeprecationWarning):
+            view = LBatchView(app_with_events, storage=storage)
+            rows = DataView.create(
+                view.events,
+                lambda e: (e.entity_id, e.properties["rating"])
+                if e.event == "rate"
+                else None,
+            )
+        assert rows == [("u1", 4.0)]
