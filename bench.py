@@ -83,14 +83,18 @@ def main() -> None:
     )
 
     # --- TPU (or whatever the default jax device is) ---
-    # warmup: compile all bucket kernels with a 1-iteration run
+    # warmup: compile the fused training program (shared across iteration
+    # counts), then time repeated full runs and report the median
     warm = als.ALSParams(**{**params.__dict__, "iterations": 1})
     als.als_train(data, warm)[0].block_until_ready()
-    t0 = time.perf_counter()
-    U, V = als.als_train(data, params)
-    U.block_until_ready()
-    V.block_until_ready()
-    tpu_s = time.perf_counter() - t0
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        U, V = als.als_train(data, params)
+        U.block_until_ready()
+        V.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    tpu_s = sorted(times)[len(times) // 2]
     tpu_rmse = als.rmse(U, V, rows, cols, vals)
 
     # --- CPU baseline (same algorithm, numpy) ---

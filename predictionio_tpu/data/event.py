@@ -78,12 +78,16 @@ class Event:
 
     # -- JSON wire format (matches reference API serializer field names) --
     def to_dict(self, for_api: bool = True) -> dict[str, Any]:
+        # API output uses millisecond precision (reference
+        # DateTimeJson4sSupport); storage (for_api=False) keeps full
+        # microseconds so timestamps round-trip exactly
+        precision = "ms" if for_api else "us"
         d: dict[str, Any] = {
             "event": self.event,
             "entityType": self.entity_type,
             "entityId": self.entity_id,
             "properties": self.properties.to_dict(),
-            "eventTime": format_time(self.event_time),
+            "eventTime": format_time(self.event_time, precision),
         }
         if self.event_id is not None:
             d["eventId"] = self.event_id
@@ -96,7 +100,7 @@ class Event:
         if self.pr_id is not None:
             d["prId"] = self.pr_id
         if not for_api:
-            d["creationTime"] = format_time(self.creation_time)
+            d["creationTime"] = format_time(self.creation_time, precision)
         return d
 
     def to_json(self) -> str:
@@ -199,15 +203,21 @@ def generate_event_id() -> str:
     return uuid.uuid4().hex
 
 
-def format_time(dt: datetime) -> str:
-    """ISO-8601 with milliseconds, e.g. 2026-07-29T00:00:00.000Z.
+def format_time(dt: datetime, precision: str = "ms") -> str:
+    """ISO-8601, e.g. 2026-07-29T00:00:00.000Z.
 
-    The event's original UTC offset is preserved (the reference keeps the
+    ``precision``: "ms" (API parity with the reference's Joda millisecond
+    formatter) or "us" (exact round-trip for storage backends). The
+    event's original UTC offset is preserved (the reference keeps the
     submitted DateTime's zone through storage and API round-trips,
     storage/EventJson4sSupport.scala); UTC renders as ``Z``.
     """
     dt = _ensure_aware(dt)
-    base = dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}"
+    if precision == "us":
+        frac = f"{dt.microsecond:06d}"
+    else:
+        frac = f"{dt.microsecond // 1000:03d}"
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S.") + frac
     offset = dt.utcoffset()
     if not offset:
         return base + "Z"

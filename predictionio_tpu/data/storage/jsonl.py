@@ -23,7 +23,7 @@ from typing import Sequence
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.data.storage.memory import _matches
+from predictionio_tpu.data.storage.memory import query_events
 
 
 class JSONLStorageClient:
@@ -89,7 +89,9 @@ class JSONLEvents(base.Events):
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
         event_id = event.event_id or uuid.uuid4().hex
         e = event.with_event_id(event_id)
-        self._append(app_id, channel_id, e.to_dict(for_api=True))
+        # for_api=False: keep creationTime and microsecond timestamps so
+        # the replayed event is byte-identical to the inserted one
+        self._append(app_id, channel_id, e.to_dict(for_api=False))
         return event_id
 
     def get(
@@ -115,7 +117,7 @@ class JSONLEvents(base.Events):
             tmp = path.with_suffix(".jsonl.tmp")
             with open(tmp, "w") as f:
                 for e in table.values():
-                    f.write(json.dumps(e.to_dict(for_api=True)) + "\n")
+                    f.write(json.dumps(e.to_dict(for_api=False)) + "\n")
             tmp.replace(path)
             return len(table)
 
@@ -135,21 +137,15 @@ class JSONLEvents(base.Events):
     ) -> list[Event]:
         with self._c.lock:
             events = list(self._replay(app_id, channel_id).values())
-        out = [
-            e
-            for e in events
-            if _matches(
-                e,
-                start_time,
-                until_time,
-                entity_type,
-                entity_id,
-                event_names,
-                target_entity_type,
-                target_entity_id,
-            )
-        ]
-        out.sort(key=lambda e: e.event_time, reverse=reversed_order)
-        if limit is not None and limit >= 0:
-            out = out[:limit]
-        return out
+        return query_events(
+            events,
+            start_time,
+            until_time,
+            entity_type,
+            entity_id,
+            event_names,
+            target_entity_type,
+            target_entity_id,
+            limit,
+            reversed_order,
+        )

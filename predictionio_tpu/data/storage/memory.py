@@ -315,24 +315,52 @@ class MemoryEvents(base.Events):
     ) -> list[Event]:
         with self._c.lock:
             events = list(self._c.events.get((app_id, channel_id), {}).values())
-        out = [
-            e
-            for e in events
-            if _matches(
-                e,
-                start_time,
-                until_time,
-                entity_type,
-                entity_id,
-                event_names,
-                target_entity_type,
-                target_entity_id,
-            )
-        ]
-        out.sort(key=lambda e: e.event_time, reverse=reversed_order)
-        if limit is not None and limit >= 0:
-            out = out[:limit]
-        return out
+        return query_events(
+            events,
+            start_time,
+            until_time,
+            entity_type,
+            entity_id,
+            event_names,
+            target_entity_type,
+            target_entity_id,
+            limit,
+            reversed_order,
+        )
+
+
+def query_events(
+    events: list[Event],
+    start_time=None,
+    until_time=None,
+    entity_type=None,
+    entity_id=None,
+    event_names=None,
+    target_entity_type=...,
+    target_entity_id=...,
+    limit=None,
+    reversed_order=False,
+) -> list[Event]:
+    """Shared filter/sort/limit for in-memory event lists (used by the
+    memory and jsonl backends; semantics of LEvents.futureFind)."""
+    out = [
+        e
+        for e in events
+        if _matches(
+            e,
+            start_time,
+            until_time,
+            entity_type,
+            entity_id,
+            event_names,
+            target_entity_type,
+            target_entity_id,
+        )
+    ]
+    out.sort(key=lambda e: e.event_time, reverse=reversed_order)
+    if limit is not None and limit >= 0:
+        out = out[:limit]
+    return out
 
 
 def _matches(

@@ -29,6 +29,7 @@ replicated/all-gathered over ICI each half-iteration.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
 from dataclasses import dataclass, field
@@ -295,7 +296,7 @@ def compute_gram(factors, compute_dtype: str = "float32"):
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(frozen=True)  # hashable: used as a static jit argument
 class ALSParams:
     rank: int = 10
     iterations: int = 10
@@ -346,12 +347,101 @@ def _half_step(factors_self, factors_other, buckets, params: ALSParams, gram):
     return factors_self
 
 
+def _solve_bucket_inline(factors_other, gram, bucket_arrays, params: ALSParams):
+    """One bucket's solve, for use inside a larger jitted computation
+    (same math as the standalone solve_bucket_* entry points)."""
+    col_ids, ratings, mask = bucket_arrays
+    D = factors_other.shape[1]
+    dt = jnp.dtype(params.compute_dtype)
+    vg = factors_other[col_ids].astype(dt)
+    if params.implicit:
+        conf_minus_1 = (params.alpha * ratings * mask).astype(dt)
+        rhs_w = ((1.0 + params.alpha * ratings) * mask).astype(dt)
+        A, b = _gramian_rhs(vg, conf_minus_1, rhs_w, use_pallas=params.use_pallas)
+        weighted = params.implicit_weighted_reg
+    else:
+        w = mask.astype(dt)
+        r = (ratings * mask).astype(dt)
+        A, b = _gramian_rhs(vg, w, r, use_pallas=params.use_pallas)
+        weighted = params.weighted_reg
+    n = mask.sum(axis=1)
+    lam = params.reg * (n if weighted else jnp.ones_like(n))
+    lam = jnp.where(n > 0, lam, 1.0)
+    A = A + lam[:, None, None] * jnp.eye(D, dtype=jnp.float32)
+    if params.implicit:
+        A = A + gram[None, :, :]
+    return _psd_solve(A, b)
+
+
+@functools.partial(jax.jit, static_argnames=("params",), donate_argnums=(0, 1))
+def _train_fused(U, V, row_arrays, col_arrays, params: ALSParams, iterations):
+    """The whole training run as ONE device program: lax.fori_loop over
+    iterations (dynamic trip count — one compile serves any iteration
+    count), bucket loop unrolled inside (static shapes per bucket).
+
+    Removes per-bucket dispatch + host round-trips of the step-by-step
+    path: factors stay resident, XLA fuses the scatter of one bucket's
+    solutions with the next bucket's gather, and buffers are donated so
+    U/V update in place across the loop.
+    """
+
+    def half(target, other, bucket_arrays_list):
+        gram = (
+            compute_gram(other, params.compute_dtype) if params.implicit else None
+        )
+        for row_ids, col_ids, ratings, mask in bucket_arrays_list:
+            x = _solve_bucket_inline(other, gram, (col_ids, ratings, mask), params)
+            target = target.at[row_ids].set(x)
+        return target
+
+    def step(_, carry):
+        U, V = carry
+        U = half(U, V, row_arrays)
+        V = half(V, U, col_arrays)
+        return (U, V)
+
+    return jax.lax.fori_loop(0, iterations, step, (U, V))
+
+
+def _device_bucket_arrays(buckets: Sequence[PaddedBucket]):
+    """Upload bucket arrays once; returned as a tuple usable as a jit arg."""
+    return tuple(
+        (
+            jnp.asarray(b.row_ids),
+            jnp.asarray(b.col_ids),
+            jnp.asarray(b.ratings),
+            jnp.asarray(b.mask),
+        )
+        for b in buckets
+    )
+
+
 def als_train(data: RatingsData, params: ALSParams):
     """Run ALS; returns (user_factors, item_factors) as jax arrays.
 
-    Host loop over iterations; each half-iteration is a handful of jitted
-    bucket solves (one compilation per bucket width).
+    The full iteration loop runs as a single fused device program (one
+    compile per unique set of bucket shapes; see _train_fused).
     """
+    key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
+    U = init_factors(data.num_rows, params.rank, key_u)
+    V = init_factors(data.num_cols, params.rank, key_v)
+    # iterations rides as a dynamic loop bound; normalize it out of the
+    # static params key so runs differing only in iteration count share
+    # one compiled program
+    static_params = dataclasses.replace(params, iterations=0)
+    return _train_fused(
+        U,
+        V,
+        _device_bucket_arrays(data.row_buckets),
+        _device_bucket_arrays(data.col_buckets),
+        static_params,
+        params.iterations,
+    )
+
+
+def als_train_stepwise(data: RatingsData, params: ALSParams):
+    """Step-by-step variant (one jitted call per bucket solve): same math
+    as als_train, useful for debugging / profiling individual solves."""
     key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
     U = init_factors(data.num_rows, params.rank, key_u)
     V = init_factors(data.num_cols, params.rank, key_v)

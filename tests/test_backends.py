@@ -61,6 +61,23 @@ class TestJSONLEvents:
         assert len(log.read_text().splitlines()) == 4 < lines_before
         assert len(events.find(3)) == 4
 
+    def test_creation_time_and_microseconds_roundtrip(self, tmp_path):
+        """Replayed events are identical to the inserted ones: creation
+        time survives and exact-timestamp cursor queries still match."""
+        events = JSONLEvents(JSONLStorageClient({"path": str(tmp_path)}))
+        e = Event(
+            event="rate", entity_type="user", entity_id="u1",
+            event_time=T0 + timedelta(microseconds=123_456),
+        )
+        eid = events.insert(e, 1)
+        got = events.get(eid, 1)
+        assert got.creation_time == e.creation_time
+        assert got.event_time == e.event_time
+        # cursoring from the exact event_time finds the event
+        assert len(events.find(1, start_time=e.event_time)) == 1
+        events.compact(1)
+        assert events.get(eid, 1).creation_time == e.creation_time
+
     def test_channel_files_isolated(self, tmp_path):
         events = JSONLEvents(JSONLStorageClient({"path": str(tmp_path)}))
         events.insert(_event(1), 1, channel_id=None)
