@@ -476,6 +476,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from predictionio_tpu.utils import apply_platform_env
+
+    apply_platform_env()  # honor JAX_PLATFORMS even under plugin boot hooks
     parser = build_parser()
     raw = sys.argv[1:] if argv is None else list(argv)
     if raw[:1] == ["help"]:

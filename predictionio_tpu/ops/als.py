@@ -51,12 +51,22 @@ DEFAULT_BUCKETS = (8, 32, 128, 512, 2048)
 
 @dataclass
 class PaddedBucket:
-    """One degree bucket of padded per-row neighbor lists (static shapes)."""
+    """One degree bucket of padded per-row neighbor lists (static shapes).
 
-    row_ids: np.ndarray  # [B] int32 — which row (user/item) each entry solves
+    When ``seg_row`` is None each table row solves one matrix row
+    (``B == len(row_ids)``). Otherwise the bucket is **segmented**: rows
+    whose degree exceeds the bucket width are split across several table
+    rows, ``seg_row[i]`` maps table row i to its index in ``row_ids``,
+    and the solver scatter-adds per-segment Gramians before solving — so
+    arbitrarily hot rows (a blockbuster item with 10^5 ratings) train
+    exactly with bounded memory instead of being truncated.
+    """
+
+    row_ids: np.ndarray  # [R] int32 — which row (user/item) each entry solves
     col_ids: np.ndarray  # [B, K] int32 — rated column indices, 0-padded
     ratings: np.ndarray  # [B, K] float32 — rating values, 0-padded
     mask: np.ndarray  # [B, K] float32 — 1 for real entries, 0 for padding
+    seg_row: np.ndarray | None = None  # [B] int32 into row_ids, or None
 
     @property
     def width(self) -> int:
@@ -81,62 +91,90 @@ def build_padded_buckets(
     cols: np.ndarray,
     vals: np.ndarray,
     bucket_widths: Sequence[int] = DEFAULT_BUCKETS,
+    segment: bool = True,
 ) -> list[PaddedBucket]:
-    """Group rows by degree into padded buckets.
+    """Group rows by degree into padded buckets (fully vectorized).
 
-    Rows whose degree exceeds the largest width keep their ``width``
-    highest-weight entries (truncation is logged). Returns buckets with
-    rows sorted by id for determinism.
+    Rows whose degree exceeds the largest width are **segmented** across
+    multiple table rows of the largest bucket (exact training; the solver
+    scatter-adds segment Gramians). With ``segment=False`` they instead
+    keep their ``width`` highest-|rating| entries (truncation — required
+    by the mesh-sharded trainer, whose scatter cannot combine segments
+    across devices). Buckets are ordered by width, rows by id.
     """
     if len(rows) == 0:
         return []
     order = np.argsort(rows, kind="stable")
     rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
     uniq, starts, counts = np.unique(rows_s, return_index=True, return_counts=True)
+    # within-row rank of every entry (vectorized: entry index - row start)
+    rank = np.arange(len(rows_s)) - np.repeat(starts, counts)
+    inv = np.repeat(np.arange(len(uniq)), counts)  # entry -> uniq row index
 
     max_width = int(max(bucket_widths))
-    n_trunc = int((counts > max_width).sum())
-    if n_trunc:
+    n_over = int((counts > max_width).sum())
+    if n_over and not segment:
         logger.warning(
             "ALS bucketing: %d rows exceed max degree %d; keeping the "
-            "%d highest-|rating| entries for those rows",
-            n_trunc,
+            "%d highest-|rating| entries for those rows (segment=False)",
+            n_over,
             max_width,
             max_width,
         )
+        # per-row descending-|rating| order, vectorized: sort by
+        # (row, -|val|) then recompute ranks; entries ranked past the
+        # width are dropped
+        order2 = np.lexsort((-np.abs(vals_s), rows_s))
+        rows_s, cols_s, vals_s = rows_s[order2], cols_s[order2], vals_s[order2]
+        rank = np.arange(len(rows_s)) - np.repeat(starts, counts)
+        inv = np.repeat(np.arange(len(uniq)), counts)
+        keep = rank < max_width
+        rows_s, cols_s, vals_s = rows_s[keep], cols_s[keep], vals_s[keep]
+        rank, inv = rank[keep], inv[keep]
+        counts = np.minimum(counts, max_width)
 
     buckets: list[PaddedBucket] = []
     widths = sorted(set(int(w) for w in bucket_widths))
     for wi, width in enumerate(widths):
         lo = widths[wi - 1] if wi > 0 else 0
-        sel = (counts > lo) & (counts <= width)
-        if wi == len(widths) - 1:
-            sel = counts > lo  # largest bucket absorbs oversized rows
+        last = wi == len(widths) - 1
+        sel = (counts > lo) if last else (counts > lo) & (counts <= width)
         idx = np.nonzero(sel)[0]
         if len(idx) == 0:
             continue
-        B = len(idx)
+        R = len(idx)
+        # per selected row: number of width-sized segments (1 unless hot)
+        nseg = (
+            np.maximum(1, -(-counts[idx] // width)) if last else np.ones(R, np.int64)
+        )
+        seg_base = np.concatenate([[0], np.cumsum(nseg)])
+        B = int(seg_base[-1])
+
+        # entry -> (segment table row, within-segment position)
+        rowpos = np.full(len(uniq), -1, np.int64)
+        rowpos[idx] = np.arange(R)
+        pos = rowpos[inv]
+        m = pos >= 0
+        seg_of_entry = seg_base[pos[m]] + rank[m] // width
+        within = rank[m] % width
+
         col_ids = np.zeros((B, width), dtype=np.int32)
         ratings = np.zeros((B, width), dtype=np.float32)
         mask = np.zeros((B, width), dtype=np.float32)
-        for bi, ri in enumerate(idx):
-            s, c = starts[ri], counts[ri]
-            take = min(int(c), width)
-            if c > width:
-                seg_vals = vals_s[s : s + c]
-                keep = np.argsort(-np.abs(seg_vals), kind="stable")[:width]
-                col_ids[bi, :take] = cols_s[s : s + c][keep]
-                ratings[bi, :take] = seg_vals[keep]
-            else:
-                col_ids[bi, :take] = cols_s[s : s + take]
-                ratings[bi, :take] = vals_s[s : s + take]
-            mask[bi, :take] = 1.0
+        col_ids[seg_of_entry, within] = cols_s[m]
+        ratings[seg_of_entry, within] = vals_s[m]
+        mask[seg_of_entry, within] = 1.0
+
+        seg_row = None
+        if last and B > R:
+            seg_row = np.repeat(np.arange(R, dtype=np.int32), nseg)
         buckets.append(
             PaddedBucket(
                 row_ids=uniq[idx].astype(np.int32),
                 col_ids=col_ids,
                 ratings=ratings,
                 mask=mask,
+                seg_row=seg_row,
             )
         )
     return buckets
@@ -149,6 +187,7 @@ def build_ratings_data(
     num_rows: int | None = None,
     num_cols: int | None = None,
     bucket_widths: Sequence[int] = DEFAULT_BUCKETS,
+    segment: bool = True,
 ) -> RatingsData:
     rows = np.asarray(rows, dtype=np.int32)
     cols = np.asarray(cols, dtype=np.int32)
@@ -161,8 +200,8 @@ def build_ratings_data(
         vals=vals,
         num_rows=num_rows,
         num_cols=num_cols,
-        row_buckets=build_padded_buckets(rows, cols, vals, bucket_widths),
-        col_buckets=build_padded_buckets(cols, rows, vals, bucket_widths),
+        row_buckets=build_padded_buckets(rows, cols, vals, bucket_widths, segment),
+        col_buckets=build_padded_buckets(cols, rows, vals, bucket_widths, segment),
     )
 
 
@@ -316,40 +355,53 @@ def init_factors(num: int, rank: int, key, scale: float | None = None):
     return scale * jax.random.normal(key, (num, rank), dtype="float32")
 
 
+@functools.partial(jax.jit, static_argnames=("params", "num_solved_rows"))
+def _solve_bucket_step(
+    factors_other, gram, col_ids, ratings, mask, seg_row, params, num_solved_rows
+):
+    return _solve_bucket_inline(
+        factors_other,
+        gram,
+        (col_ids, ratings, mask),
+        params,
+        seg_row=seg_row,
+        num_solved_rows=num_solved_rows,
+    )
+
+
 def _half_step(factors_self, factors_other, buckets, params: ALSParams, gram):
     """Update factors_self given factors_other over all degree buckets."""
     for bucket in buckets:
-        if params.implicit:
-            x = solve_bucket_implicit(
-                factors_other,
-                gram,
-                bucket.col_ids,
-                bucket.ratings,
-                bucket.mask,
-                reg=params.reg,
-                alpha=params.alpha,
-                weighted_reg=params.implicit_weighted_reg,
-                compute_dtype=params.compute_dtype,
-                use_pallas=params.use_pallas,
-            )
-        else:
-            x = solve_bucket_explicit(
-                factors_other,
-                bucket.col_ids,
-                bucket.ratings,
-                bucket.mask,
-                reg=params.reg,
-                weighted_reg=params.weighted_reg,
-                compute_dtype=params.compute_dtype,
-                use_pallas=params.use_pallas,
-            )
+        x = _solve_bucket_step(
+            factors_other,
+            gram,
+            bucket.col_ids,
+            bucket.ratings,
+            bucket.mask,
+            bucket.seg_row,
+            params,
+            len(bucket.row_ids),
+        )
         factors_self = factors_self.at[bucket.row_ids].set(x)
     return factors_self
 
 
-def _solve_bucket_inline(factors_other, gram, bucket_arrays, params: ALSParams):
+def _solve_bucket_inline(
+    factors_other,
+    gram,
+    bucket_arrays,
+    params: ALSParams,
+    seg_row=None,
+    num_solved_rows: int | None = None,
+):
     """One bucket's solve, for use inside a larger jitted computation
-    (same math as the standalone solve_bucket_* entry points)."""
+    (same math as the standalone solve_bucket_* entry points).
+
+    ``seg_row`` (segmented bucket): [B] table-row -> solved-row mapping
+    with ``num_solved_rows`` distinct rows; per-segment Gramians/rhs are
+    scatter-added into the solved rows before regularization, so hot rows
+    train on ALL their ratings with bounded memory.
+    """
     col_ids, ratings, mask = bucket_arrays
     D = factors_other.shape[1]
     dt = jnp.dtype(params.compute_dtype)
@@ -365,6 +417,11 @@ def _solve_bucket_inline(factors_other, gram, bucket_arrays, params: ALSParams):
         A, b = _gramian_rhs(vg, w, r, use_pallas=params.use_pallas)
         weighted = params.weighted_reg
     n = mask.sum(axis=1)
+    if seg_row is not None:
+        R = num_solved_rows
+        A = jnp.zeros((R, D, D), A.dtype).at[seg_row].add(A)
+        b = jnp.zeros((R, D), b.dtype).at[seg_row].add(b)
+        n = jnp.zeros((R,), n.dtype).at[seg_row].add(n)
     lam = params.reg * (n if weighted else jnp.ones_like(n))
     lam = jnp.where(n > 0, lam, 1.0)
     A = A + lam[:, None, None] * jnp.eye(D, dtype=jnp.float32)
@@ -389,8 +446,15 @@ def _train_fused(U, V, row_arrays, col_arrays, params: ALSParams, iterations):
         gram = (
             compute_gram(other, params.compute_dtype) if params.implicit else None
         )
-        for row_ids, col_ids, ratings, mask in bucket_arrays_list:
-            x = _solve_bucket_inline(other, gram, (col_ids, ratings, mask), params)
+        for row_ids, col_ids, ratings, mask, seg_row in bucket_arrays_list:
+            x = _solve_bucket_inline(
+                other,
+                gram,
+                (col_ids, ratings, mask),
+                params,
+                seg_row=seg_row,
+                num_solved_rows=row_ids.shape[0],
+            )
             target = target.at[row_ids].set(x)
         return target
 
@@ -411,6 +475,7 @@ def _device_bucket_arrays(buckets: Sequence[PaddedBucket]):
             jnp.asarray(b.col_ids),
             jnp.asarray(b.ratings),
             jnp.asarray(b.mask),
+            jnp.asarray(b.seg_row) if b.seg_row is not None else None,
         )
         for b in buckets
     )

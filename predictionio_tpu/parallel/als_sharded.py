@@ -65,6 +65,12 @@ def upload_buckets(
     sharding = NamedSharding(mesh, P(axis))
     out = []
     for bucket in buckets:
+        if bucket.seg_row is not None:
+            raise ValueError(
+                "mesh-sharded ALS cannot consume segmented buckets (segments "
+                "of one row may land on different shards); build the ratings "
+                "data with segment=False"
+            )
         B, K = bucket.col_ids.shape
         pad = (-B) % shards
         row_ids = np.concatenate(
@@ -245,6 +251,22 @@ def sharded_als_train(
     """Full ALS with mesh-resident factors. Returns (U, V) trimmed to the
     true row counts (still device arrays; shard layout preserved until the
     caller re-shards or fetches)."""
+    if any(
+        b.seg_row is not None for b in (*data.row_buckets, *data.col_buckets)
+    ):
+        # segments of one row cannot span devices; rebuild this trainer's
+        # layout with truncation from the retained COO triples
+        data = als_ops.build_ratings_data(
+            data.rows,
+            data.cols,
+            data.vals,
+            data.num_rows,
+            data.num_cols,
+            bucket_widths=tuple(
+                sorted({b.width for b in (*data.row_buckets, *data.col_buckets)})
+            ),
+            segment=False,
+        )
     state = init_sharded_factors(data, params, mesh, axis)
     row_dbs = upload_buckets(data.row_buckets, mesh, axis, state.U.shape[0] - 1)
     col_dbs = upload_buckets(data.col_buckets, mesh, axis, state.V.shape[0] - 1)

@@ -1,0 +1,3 @@
+"""Shared utilities (platform selection, small helpers)."""
+
+from predictionio_tpu.utils.platform import apply_platform_env  # noqa: F401

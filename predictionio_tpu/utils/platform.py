@@ -1,0 +1,34 @@
+"""JAX platform selection that survives plugin boot hooks.
+
+Some TPU environments install a site hook that registers their PJRT
+plugin at interpreter boot and re-pins ``jax_platforms`` to the
+accelerator, overriding the ``JAX_PLATFORMS`` environment variable. That
+breaks the documented workflow of forcing CPU for tests/CI
+(``JAX_PLATFORMS=cpu``), and a dead accelerator tunnel then hangs every
+process at backend init. Calling :func:`apply_platform_env` before the
+first device use re-asserts the user's env choice in-process (the same
+override tests/conftest.py applies).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    """Re-apply ``JAX_PLATFORMS`` from the environment to jax's config.
+
+    No-op when the variable is unset or jax is not importable. Safe to
+    call multiple times; cheap before jax has initialized a backend.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        return
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception:  # config name differences across jax versions
+        pass

@@ -48,13 +48,47 @@ class TestBucketing:
         all_rows = np.concatenate([b.row_ids for b in buckets])
         assert len(all_rows) == len(np.unique(all_rows)) == len(np.unique(rows))
 
-    def test_oversized_rows_truncate_to_largest_width(self):
+    def test_oversized_rows_truncate_with_segment_false(self):
         rows = np.zeros(10, dtype=np.int32)
         cols = np.arange(10, dtype=np.int32)
         vals = np.arange(10, dtype=np.float32)  # 0..9, keep the largest 4
+        [bucket] = als.build_padded_buckets(
+            rows, cols, vals, bucket_widths=(2, 4), segment=False
+        )
+        assert bucket.width == 4
+        assert bucket.seg_row is None
+        assert set(bucket.col_ids[0].tolist()) == {9, 8, 7, 6}
+
+    def test_oversized_rows_segment_exactly(self):
+        """Hot rows split into segments covering ALL entries (no loss)."""
+        rows = np.zeros(10, dtype=np.int32)
+        cols = np.arange(10, dtype=np.int32)
+        vals = np.arange(10, dtype=np.float32)
         [bucket] = als.build_padded_buckets(rows, cols, vals, bucket_widths=(2, 4))
         assert bucket.width == 4
-        assert set(bucket.col_ids[0].tolist()) == {9, 8, 7, 6}
+        assert list(bucket.row_ids) == [0]
+        assert bucket.seg_row is not None
+        assert list(bucket.seg_row) == [0, 0, 0]  # ceil(10/4) segments
+        assert int(bucket.mask.sum()) == 10  # every rating kept
+        got = set()
+        for seg in range(bucket.col_ids.shape[0]):
+            n = int(bucket.mask[seg].sum())
+            got |= set(bucket.col_ids[seg, :n].tolist())
+        assert got == set(range(10))
+
+    def test_segmented_mixed_rows_cover_all_entries(self):
+        rng = np.random.default_rng(5)
+        # row 0: degree 20 (segmented); rows 1-6: small degrees
+        rows = np.concatenate(
+            [np.zeros(20, np.int32), rng.integers(1, 7, 30).astype(np.int32)]
+        )
+        cols = np.arange(50, dtype=np.int32) % 13
+        vals = (1 + rng.random(50)).astype(np.float32)
+        buckets = als.build_padded_buckets(rows, cols, vals, bucket_widths=(4, 8))
+        total = sum(int(b.mask.sum()) for b in buckets)
+        assert total == 50
+        solved = np.concatenate([b.row_ids for b in buckets])
+        assert sorted(solved.tolist()) == sorted(np.unique(rows).tolist())
 
     def test_empty(self):
         assert als.build_padded_buckets(
@@ -129,6 +163,80 @@ class TestSolveExactness:
         )
         assert np.allclose(np.asarray(x), 0.0)
         assert not np.isnan(np.asarray(x)).any()
+
+
+class TestSegmentedTraining:
+    def test_segmented_train_matches_wide_bucket_train(self):
+        """Training with hot rows segmented at width 8 must equal training
+        with a bucket wide enough to hold them unsplit (same math)."""
+        rng = np.random.default_rng(3)
+        # one hot user (degree 30) + background
+        rows = np.concatenate(
+            [np.zeros(30, np.int32), rng.integers(1, 20, 60).astype(np.int32)]
+        )
+        cols = np.concatenate(
+            [np.arange(30, dtype=np.int32) % 25, rng.integers(0, 25, 60).astype(np.int32)]
+        )
+        vals = (1 + 4 * rng.random(90)).astype(np.float32)
+        params = als.ALSParams(rank=4, iterations=3, reg=0.1)
+        d_seg = als.build_ratings_data(rows, cols, vals, 20, 25, bucket_widths=(8,))
+        d_wide = als.build_ratings_data(rows, cols, vals, 20, 25, bucket_widths=(8, 64))
+        assert any(b.seg_row is not None for b in d_seg.row_buckets)
+        assert all(b.seg_row is None for b in d_wide.row_buckets)
+        U1, V1 = als.als_train(d_seg, params)
+        U2, V2 = als.als_train(d_wide, params)
+        np.testing.assert_allclose(np.asarray(U1), np.asarray(U2), rtol=5e-4, atol=5e-5)
+        np.testing.assert_allclose(np.asarray(V1), np.asarray(V2), rtol=5e-4, atol=5e-5)
+
+    def test_segmented_implicit_matches_wide(self):
+        rng = np.random.default_rng(4)
+        rows = np.concatenate(
+            [np.zeros(24, np.int32), rng.integers(1, 12, 40).astype(np.int32)]
+        )
+        cols = np.concatenate(
+            [np.arange(24, dtype=np.int32) % 15, rng.integers(0, 15, 40).astype(np.int32)]
+        )
+        vals = (1 + rng.random(64)).astype(np.float32)
+        params = als.ALSParams(rank=4, iterations=2, reg=0.1, implicit=True, alpha=2.0)
+        d_seg = als.build_ratings_data(rows, cols, vals, 12, 15, bucket_widths=(8,))
+        d_wide = als.build_ratings_data(rows, cols, vals, 12, 15, bucket_widths=(8, 32))
+        U1, V1 = als.als_train(d_seg, params)
+        U2, V2 = als.als_train(d_wide, params)
+        np.testing.assert_allclose(np.asarray(U1), np.asarray(U2), rtol=5e-4, atol=5e-5)
+
+    def test_sharded_rejects_segmented_buckets(self):
+        from predictionio_tpu.parallel.als_sharded import upload_buckets
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        rows = np.zeros(10, np.int32)
+        cols = np.arange(10, dtype=np.int32)
+        vals = np.ones(10, np.float32)
+        [bucket] = als.build_padded_buckets(rows, cols, vals, bucket_widths=(4,))
+        assert bucket.seg_row is not None
+        mesh = make_mesh([("data", 2)])
+        with pytest.raises(ValueError, match="segment=False"):
+            upload_buckets([bucket], mesh, "data", 0)
+
+    def test_sharded_train_auto_rebuilds_segmented_data(self):
+        """Passing default (segment=True) data to the sharded trainer
+        transparently rebuilds a truncated layout and trains."""
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        rng = np.random.default_rng(6)
+        rows = np.concatenate(
+            [np.zeros(20, np.int32), rng.integers(1, 10, 30).astype(np.int32)]
+        )
+        cols = np.concatenate(
+            [np.arange(20, dtype=np.int32) % 12, rng.integers(0, 12, 30).astype(np.int32)]
+        )
+        vals = (1 + 4 * rng.random(50)).astype(np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 10, 12, bucket_widths=(8,))
+        assert any(b.seg_row is not None for b in data.row_buckets)
+        mesh = make_mesh([("data", 2)])
+        U, V = sharded_als_train(data, als.ALSParams(rank=4, iterations=2, reg=0.1), mesh)
+        assert U.shape == (10, 4) and V.shape == (12, 4)
+        assert not np.isnan(np.asarray(U)).any()
 
 
 class TestTraining:
