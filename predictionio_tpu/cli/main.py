@@ -130,6 +130,28 @@ def cmd_accesskey(args) -> int:
         return 1
 
 
+def _parse_mesh(spec: str | None) -> list[tuple[str, int]] | None:
+    """'data=4,model=2' -> [("data", 4), ("model", 2)]."""
+    if not spec:
+        return None
+    axes = []
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        try:
+            n = int(size)
+        except ValueError:
+            n = 0
+        if not name or n == 0 or n < -1:
+            raise SystemExit(
+                f"bad --mesh axis {part!r}; expected name=size with a "
+                "positive integer size (or -1 once for the remainder)"
+            )
+        axes.append((name.strip(), n))
+    if sum(1 for _, n in axes if n == -1) > 1:
+        raise SystemExit("--mesh: at most one axis may be -1")
+    return axes
+
+
 def cmd_train(args) -> int:
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.core.workflow import run_train
@@ -143,6 +165,7 @@ def cmd_train(args) -> int:
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
         profile_dir=args.profile_dir,
+        mesh_axes=_parse_mesh(getattr(args, "mesh", None)),
     )
     instance_id = run_train(
         engine,
@@ -405,6 +428,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--stop-after-read", action="store_true")
     t.add_argument("--stop-after-prepare", action="store_true")
     t.add_argument("--profile-dir", help="write a JAX profiler trace here")
+    t.add_argument(
+        "--mesh",
+        help="device-mesh axes for the training run, e.g. 'data=8' or "
+        "'data=4,model=2' (-1 once absorbs remaining devices)",
+    )
     t.set_defaults(fn=cmd_train)
 
     ev = sub.add_parser("eval")

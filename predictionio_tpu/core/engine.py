@@ -50,6 +50,11 @@ class WorkflowParams:
     # here (XPlane/TensorBoard format) — the TPU-native answer to the
     # reference's reliance on the Spark UI for train-time visibility
     profile_dir: str | None = None
+    # device-mesh axes for the run's WorkflowContext, e.g.
+    # [("data", 8)]; None = 1-D ("data", all devices). The TPU analog of
+    # the reference's spark-submit --master cluster sizing
+    # (tools/.../Runner.scala:193-205)
+    mesh_axes: list[tuple[str, int]] | None = None
 
 
 class StopAfterReadInterruption(Exception):

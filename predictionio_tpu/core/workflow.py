@@ -58,7 +58,10 @@ def run_train(
     storage = storage or get_storage()
     wp = workflow_params or WorkflowParams()
     ctx = ctx or WorkflowContext(
-        mode="Training", batch=wp.batch, runtime_conf=wp.runtime_conf
+        mode="Training",
+        batch=wp.batch,
+        runtime_conf=wp.runtime_conf,
+        mesh_axes=wp.mesh_axes,
     )
 
     instances = storage.get_metadata_engine_instances()

@@ -132,6 +132,7 @@ class ECommAlgorithmParams(Params):
     alpha: float = 1.0
     seed: int = 3
     weights: list[dict] = field(default_factory=list)  # [{items, weight}]
+    sharded_train: bool = False  # train over the WorkflowContext mesh
 
 
 @dataclass
@@ -180,7 +181,9 @@ class ECommAlgorithm(Algorithm):
         data = als_ops.build_ratings_data(
             rows, cols, vals, len(user_index), len(item_index)
         )
-        U, V = als_ops.als_train(
+        from predictionio_tpu.parallel.als_sharded import train_for_context
+
+        U, V = train_for_context(
             data,
             als_ops.ALSParams(
                 rank=self.params.rank,
@@ -190,6 +193,8 @@ class ECommAlgorithm(Algorithm):
                 alpha=self.params.alpha,
                 seed=self.params.seed,
             ),
+            ctx,
+            sharded=self.params.sharded_train,
         )
         return ECommModel(
             user_index=user_index,

@@ -159,6 +159,13 @@ class ALSAlgorithmParams(Params):
     # host" case, which issues a Spark job per query instead
     # (examples/.../ALSAlgorithm.scala:88)
     sharded_serving: bool = False
+    # train over the WorkflowContext device mesh (factors sharded row-wise,
+    # all_gather over ICI each half-iteration) — the production multi-chip
+    # train path replacing MLlib ALS's Spark-cluster execution
+    sharded_train: bool = False
+    # degree-bucket widths for the padded ALS layout (ops/als.py); rows
+    # hotter than the largest width segment exactly across table rows
+    bucket_widths: tuple[int, ...] = als_ops.DEFAULT_BUCKETS
 
 
 @dataclass
@@ -215,7 +222,12 @@ class ALSAlgorithm(Algorithm):
         cols = item_index.to_index_array(td.items)
         vals = np.asarray(td.ratings, dtype=np.float32)
         data = als_ops.build_ratings_data(
-            rows, cols, vals, len(user_index), len(item_index)
+            rows,
+            cols,
+            vals,
+            len(user_index),
+            len(item_index),
+            bucket_widths=tuple(self.params.bucket_widths),
         )
         params = als_ops.ALSParams(
             rank=self.params.rank,
@@ -225,7 +237,11 @@ class ALSAlgorithm(Algorithm):
             compute_dtype=self.params.compute_dtype,
             use_pallas=self.params.use_pallas,
         )
-        U, V = als_ops.als_train(data, params)
+        from predictionio_tpu.parallel.als_sharded import train_for_context
+
+        U, V = train_for_context(
+            data, params, ctx, sharded=self.params.sharded_train
+        )
         logger.info(
             "ALS trained: %d users x %d items, rank %d, train RMSE %.4f",
             len(user_index),

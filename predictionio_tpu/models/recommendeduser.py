@@ -94,6 +94,7 @@ class ALSAlgorithmParams(Params):
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    sharded_train: bool = False  # train over the WorkflowContext mesh
 
 
 @dataclass
@@ -148,7 +149,9 @@ class ALSAlgorithm(Algorithm):
             alpha=self.params.alpha,
             seed=self.params.seed,
         )
-        _, V = als_ops.als_train(data, params)
+        from predictionio_tpu.parallel.als_sharded import train_for_context
+
+        _, V = train_for_context(data, params, ctx, sharded=self.params.sharded_train)
         return RecommendedUserModel(
             followed_index=followed_index, followed_factors=np.asarray(V)
         )

@@ -1,4 +1,4 @@
-"""Mesh-sharded ALS: the multi-chip training step.
+"""Mesh-sharded ALS: the multi-chip training path.
 
 MLlib ALS distributes by blocking users x items across executors and
 shuffling factor blocks each half-iteration (external Spark dep; SURVEY
@@ -7,27 +7,36 @@ shuffling factor blocks each half-iteration (external Spark dep; SURVEY
 - both factor matrices live **sharded row-wise** over the mesh's ``data``
   axis (P("data") on dim 0),
 - each half-iteration ``all_gather``s the *opposite* factor matrix over
-  ICI (it is the smaller working set), solves the local shard's normal
-  equations with the same batched bucket solves as single-chip, and leaves
-  the updated factors sharded in place,
+  ICI inside a ``shard_map`` (it is the smaller working set), solves the
+  local shard's normal equations with the same batched bucket math as
+  single-chip, and scatters the solutions back into the sharded factors,
 - the implicit-feedback Gramian Y^T Y is computed shard-locally and
   ``psum``-reduced — a [D, D] allreduce instead of MLlib's shuffle.
 
-Bucket arrays are padded and uploaded to the mesh **once** before the
-iteration loop (they are training-constant); padding rows solve an
-identity system and scatter into a dummy factor row. Factor rows beyond
-the true count are zero-initialized so they contribute nothing to the
-psum'd Gramian.
+Two properties the round-1 design lacked, now guaranteed:
+
+**Exact hot rows.** Degree-bucketed layouts segment rows hotter than the
+widest bucket across several table rows (ops/als.py PaddedBucket). The
+shard layout here places **all segments of one solved row on the same
+shard** (greedy longest-processing-time assignment balances segment
+counts across shards), so the per-segment Gramians are scatter-added
+shard-locally before the solve — multi-chip training is bit-for-bit the
+same math as single-chip, with no truncation of blockbuster rows.
+
+**One device program.** The whole training run is a single jitted
+``lax.fori_loop`` (dynamic trip count) with donated factor buffers; each
+half-iteration is one ``shard_map`` region per bucket set. No per-bucket
+Python dispatch, no host round-trips of the factors.
 """
 
 from __future__ import annotations
 
 import functools
+import heapq
 from dataclasses import dataclass
 from typing import Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -35,140 +44,122 @@ from predictionio_tpu.ops import als as als_ops
 
 
 # ---------------------------------------------------------------------------
-# Host-side: pad buckets for even sharding, upload once
+# Host-side: shard-aware bucket layout
 # ---------------------------------------------------------------------------
 
 
 @dataclass
-class DeviceBucket:
-    """A PaddedBucket padded to the shard count and resident on the mesh.
+class ShardedBucket:
+    """One degree bucket laid out as per-shard sub-tables (flattened).
 
-    Padding rows have mask == 0 and scatter into ``dummy_row`` (an extra
-    factor row appended for this purpose).
+    ``col_ids/ratings/mask/seg_row`` are ``[S*B, ...]`` where shard ``s``
+    owns rows ``[s*B, (s+1)*B)`` — exactly what ``P(axis)`` on dim 0
+    yields. ``seg_row`` holds **shard-local** solved-row indices in
+    ``[0, R)``; all segments of one solved row live on one shard.
+    ``row_ids`` is ``[S*R]`` global factor-row ids (``dummy_row`` for
+    padding slots), used for the global scatter of solutions.
     """
 
-    row_ids: jax.Array  # [B'] int32 (replicated; used for host-side scatter)
-    col_ids: jax.Array  # [B', K] sharded P(axis)
-    ratings: jax.Array  # [B', K] sharded P(axis)
-    mask: jax.Array  # [B', K] sharded P(axis)
+    row_ids: np.ndarray  # [S*R] int32 global row ids (dummy-padded)
+    col_ids: np.ndarray  # [S*B, K] int32
+    ratings: np.ndarray  # [S*B, K] float32
+    mask: np.ndarray  # [S*B, K] float32
+    seg_row: np.ndarray  # [S*B] int32, shard-local in [0, R)
+    shards: int
+    rows_per_shard: int  # R
+    table_rows_per_shard: int  # B
 
 
-def upload_buckets(
-    buckets: Sequence[als_ops.PaddedBucket],
-    mesh: Mesh,
-    axis: str,
-    dummy_row: int,
-) -> list[DeviceBucket]:
-    """Pad each bucket so B is divisible by the mesh axis size and place
-    the arrays sharded on the mesh. Done once per training run."""
-    shards = mesh.shape[axis]
-    sharding = NamedSharding(mesh, P(axis))
-    out = []
-    for bucket in buckets:
-        if bucket.seg_row is not None:
-            raise ValueError(
-                "mesh-sharded ALS cannot consume segmented buckets (segments "
-                "of one row may land on different shards); build the ratings "
-                "data with segment=False"
-            )
-        B, K = bucket.col_ids.shape
-        pad = (-B) % shards
-        row_ids = np.concatenate(
-            [bucket.row_ids, np.full(pad, dummy_row, dtype=np.int32)]
+def shard_bucket(
+    bucket: als_ops.PaddedBucket, shards: int, dummy_row: int
+) -> ShardedBucket:
+    """Lay one PaddedBucket out over ``shards`` with row-segment
+    colocation and balanced per-shard table sizes."""
+    K = bucket.width
+    R0 = len(bucket.row_ids)
+    if bucket.seg_row is None:
+        nseg = np.ones(R0, np.int64)
+        seg_starts = np.arange(R0, dtype=np.int64)
+    else:
+        seg_of = bucket.seg_row.astype(np.int64)
+        nseg = np.bincount(seg_of, minlength=R0)
+        # segments of row j are contiguous table rows by construction
+        # (ops/als.py build_padded_buckets seg_base layout)
+        seg_starts = np.concatenate([[0], np.cumsum(nseg)[:-1]])
+
+    if (nseg == 1).all():
+        # fast path: one segment per row -> round-robin is perfectly even
+        assign = np.arange(R0, dtype=np.int64) % shards
+    else:
+        # greedy LPT on segment counts so hot rows don't pile on one shard
+        assign = np.empty(R0, np.int64)
+        heap = [(0, 0, s) for s in range(shards)]
+        heapq.heapify(heap)
+        for j in np.argsort(-nseg, kind="stable"):
+            load, cnt, s = heapq.heappop(heap)
+            assign[j] = s
+            heapq.heappush(heap, (load + int(nseg[j]), cnt + 1, s))
+
+    per_shard_rows = np.bincount(assign, minlength=shards)
+    per_shard_load = np.bincount(assign, weights=nseg, minlength=shards).astype(
+        np.int64
+    )
+    R = max(1, int(per_shard_rows.max()))
+    B = max(1, int(per_shard_load.max()))
+
+    row_ids = np.full((shards, R), dummy_row, np.int32)
+    col_ids = np.zeros((shards, B, K), np.int32)
+    ratings = np.zeros((shards, B, K), np.float32)
+    mask = np.zeros((shards, B, K), np.float32)
+    seg_row = np.zeros((shards, B), np.int32)
+    for s in range(shards):
+        js = np.nonzero(assign == s)[0]  # ascending original order
+        if len(js) == 0:
+            continue
+        ns = nseg[js]
+        total = int(ns.sum())
+        # source table rows: each row's contiguous segment run
+        base = np.cumsum(ns) - ns
+        within = np.arange(total) - np.repeat(base, ns)
+        src = np.repeat(seg_starts[js], ns) + within
+        row_ids[s, : len(js)] = bucket.row_ids[js]
+        col_ids[s, :total] = bucket.col_ids[src]
+        ratings[s, :total] = bucket.ratings[src]
+        mask[s, :total] = bucket.mask[src]
+        seg_row[s, :total] = np.repeat(np.arange(len(js), dtype=np.int32), ns)
+    return ShardedBucket(
+        row_ids=row_ids.reshape(-1),
+        col_ids=col_ids.reshape(shards * B, K),
+        ratings=ratings.reshape(shards * B, K),
+        mask=mask.reshape(shards * B, K),
+        seg_row=seg_row.reshape(-1),
+        shards=shards,
+        rows_per_shard=R,
+        table_rows_per_shard=B,
+    )
+
+
+def upload_sharded_buckets(
+    sharded: Sequence[ShardedBucket], mesh: Mesh, axis: str
+) -> tuple:
+    """Place the layout on the mesh once per training run: tables sharded
+    ``P(axis)``, scatter row-ids replicated."""
+    table = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return tuple(
+        (
+            jax.device_put(sb.row_ids, repl),
+            jax.device_put(sb.col_ids, table),
+            jax.device_put(sb.ratings, table),
+            jax.device_put(sb.mask, table),
+            jax.device_put(sb.seg_row, table),
         )
-        col_ids = np.concatenate([bucket.col_ids, np.zeros((pad, K), np.int32)])
-        ratings = np.concatenate([bucket.ratings, np.zeros((pad, K), np.float32)])
-        mask = np.concatenate([bucket.mask, np.zeros((pad, K), np.float32)])
-        out.append(
-            DeviceBucket(
-                row_ids=jnp.asarray(row_ids),
-                col_ids=jax.device_put(col_ids, sharding),
-                ratings=jax.device_put(ratings, sharding),
-                mask=jax.device_put(mask, sharding),
-            )
-        )
-    return out
+        for sb in sharded
+    )
 
 
 # ---------------------------------------------------------------------------
-# Device-side: shard_map'ed half-step
-# ---------------------------------------------------------------------------
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "mesh",
-        "axis",
-        "implicit",
-        "alpha",
-        "weighted_reg",
-        "implicit_weighted_reg",
-        "compute_dtype",
-        "use_pallas",
-    ),
-)
-def sharded_solve_bucket(
-    factors_other,  # [R+pad, D] sharded P(axis) on dim 0
-    col_ids,  # [B', K] sharded P(axis)
-    ratings,
-    mask,
-    reg: float,
-    *,
-    mesh: Mesh,
-    axis: str = "data",
-    implicit: bool = False,
-    alpha: float = 1.0,
-    weighted_reg: bool = True,
-    implicit_weighted_reg: bool = False,
-    compute_dtype: str = "float32",
-    use_pallas: bool = False,
-):
-    """Solve one bucket with factors_other sharded row-wise.
-
-    Inside each shard: all_gather(factors_other) over ICI -> local batched
-    solve. For implicit feedback the global Gramian is psum-reduced from
-    shard-local partial Gramians first.
-    """
-
-    def local(f_other_shard, col_ids_l, ratings_l, mask_l):
-        f_other = jax.lax.all_gather(f_other_shard, axis, tiled=True)
-        if implicit:
-            part = als_ops.compute_gram(f_other_shard, compute_dtype)
-            gram = jax.lax.psum(part, axis)
-            return als_ops.solve_bucket_implicit(
-                f_other,
-                gram,
-                col_ids_l,
-                ratings_l,
-                mask_l,
-                reg=reg,
-                alpha=alpha,
-                weighted_reg=implicit_weighted_reg,
-                compute_dtype=compute_dtype,
-                use_pallas=use_pallas,
-            )
-        return als_ops.solve_bucket_explicit(
-            f_other,
-            col_ids_l,
-            ratings_l,
-            mask_l,
-            reg=reg,
-            weighted_reg=weighted_reg,
-            compute_dtype=compute_dtype,
-            use_pallas=use_pallas,
-        )
-
-    return jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=P(axis),
-    )(factors_other, col_ids, ratings, mask)
-
-
-# ---------------------------------------------------------------------------
-# Full sharded training
+# Device-side: fused training program
 # ---------------------------------------------------------------------------
 
 
@@ -189,18 +180,26 @@ def _padded_len(n: int, shards: int) -> int:
 
 
 def init_sharded_factors(
-    data: als_ops.RatingsData, params: als_ops.ALSParams, mesh: Mesh, axis: str = "data"
+    data: als_ops.RatingsData,
+    params: als_ops.ALSParams,
+    mesh: Mesh,
+    axis: str = "data",
 ) -> ShardedALSState:
     shards = mesh.shape[axis]
     key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
     u_len = _padded_len(data.num_rows, shards)
     v_len = _padded_len(data.num_cols, shards)
-    U = als_ops.init_factors(u_len, params.rank, key_u)
-    V = als_ops.init_factors(v_len, params.rank, key_v)
-    # zero the dummy/pad rows: they are never solved but WOULD otherwise
-    # pollute the psum'd implicit Gramian with their random init
-    U = U.at[data.num_rows:].set(0.0)
-    V = V.at[data.num_cols:].set(0.0)
+    # draw the TRUE-size init (identical to single-chip als_train for the
+    # same seed — the parity tests rely on trajectory equality), then pad
+    # with zeros; pad rows contribute nothing to the psum'd Gramian
+    U = np.zeros((u_len, params.rank), np.float32)
+    V = np.zeros((v_len, params.rank), np.float32)
+    U[: data.num_rows] = np.asarray(
+        als_ops.init_factors(data.num_rows, params.rank, key_u)
+    )
+    V[: data.num_cols] = np.asarray(
+        als_ops.init_factors(data.num_cols, params.rank, key_v)
+    )
     sharding = NamedSharding(mesh, P(axis))
     return ShardedALSState(
         mesh=mesh,
@@ -212,34 +211,71 @@ def init_sharded_factors(
     )
 
 
-def sharded_half_step(
-    state: ShardedALSState,
-    factors_self,
-    factors_other,
-    device_buckets: Sequence[DeviceBucket],
-    params: als_ops.ALSParams,
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "mesh", "axis"),
+    donate_argnums=(0, 1),
+)
+def _train_fused_sharded(
+    U, V, row_arrays, col_arrays, iterations, params: als_ops.ALSParams, mesh, axis
 ):
-    """Update factors_self (sharded) from factors_other (sharded), over
-    pre-uploaded buckets."""
-    for db in device_buckets:
-        x = sharded_solve_bucket(
-            factors_other,
-            db.col_ids,
-            db.ratings,
-            db.mask,
-            params.reg,
-            mesh=state.mesh,
-            axis=state.axis,
-            implicit=params.implicit,
-            alpha=params.alpha,
-            weighted_reg=params.weighted_reg,
-            implicit_weighted_reg=params.implicit_weighted_reg,
-            compute_dtype=params.compute_dtype,
-            use_pallas=params.use_pallas,
-        )
-        # scatter updated rows; padding rows hit the dummy row harmlessly
-        factors_self = factors_self.at[db.row_ids].set(x)
-    return factors_self
+    """The whole sharded training run as ONE device program.
+
+    ``lax.fori_loop`` over iterations (dynamic trip count — one compile
+    serves any iteration count); each half-step is a single ``shard_map``
+    region solving every bucket (one ``all_gather`` of the opposite
+    factors, one ``psum`` for the implicit Gramian), followed by global
+    scatters of the solutions into the sharded factor matrix.
+    """
+    shards = mesh.shape[axis]
+    factor_spec = NamedSharding(mesh, P(axis))
+
+    def half(target, other, buckets):
+        # per-bucket solved-rows-per-shard, static at trace time
+        rows_per = [b[0].shape[0] // shards for b in buckets]
+
+        def shard_fn(other_shard, *flat):
+            other_full = jax.lax.all_gather(other_shard, axis, tiled=True)
+            gram = None
+            if params.implicit:
+                gram = jax.lax.psum(
+                    als_ops.compute_gram(other_shard, params.compute_dtype), axis
+                )
+            outs = []
+            for bi in range(0, len(flat) // 4):
+                col_ids, ratings, mask, seg_row = flat[bi * 4 : bi * 4 + 4]
+                outs.append(
+                    als_ops._solve_bucket_inline(
+                        other_full,
+                        gram,
+                        (col_ids, ratings, mask),
+                        params,
+                        seg_row=seg_row,
+                        num_solved_rows=rows_per[bi],
+                    )
+                )
+            return tuple(outs)
+
+        flat = []
+        for _row_ids, col_ids, ratings, mask, seg_row in buckets:
+            flat += [col_ids, ratings, mask, seg_row]
+        xs = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(axis),) + (P(axis),) * len(flat),
+            out_specs=(P(axis),) * len(buckets),
+        )(other, *flat)
+        for x, (row_ids, *_rest) in zip(xs, buckets):
+            target = target.at[row_ids].set(x)
+        return jax.lax.with_sharding_constraint(target, factor_spec)
+
+    def step(_, carry):
+        U, V = carry
+        U = half(U, V, row_arrays)
+        V = half(V, U, col_arrays)
+        return (U, V)
+
+    return jax.lax.fori_loop(0, iterations, step, (U, V))
 
 
 def sharded_als_train(
@@ -248,29 +284,72 @@ def sharded_als_train(
     mesh: Mesh,
     axis: str = "data",
 ) -> tuple[jax.Array, jax.Array]:
-    """Full ALS with mesh-resident factors. Returns (U, V) trimmed to the
-    true row counts (still device arrays; shard layout preserved until the
-    caller re-shards or fetches)."""
-    if any(
-        b.seg_row is not None for b in (*data.row_buckets, *data.col_buckets)
-    ):
-        # segments of one row cannot span devices; rebuild this trainer's
-        # layout with truncation from the retained COO triples
-        data = als_ops.build_ratings_data(
-            data.rows,
-            data.cols,
-            data.vals,
-            data.num_rows,
-            data.num_cols,
-            bucket_widths=tuple(
-                sorted({b.width for b in (*data.row_buckets, *data.col_buckets)})
-            ),
-            segment=False,
+    """Full multi-chip ALS with mesh-resident factors.
+
+    Exact on arbitrarily hot rows: segmented buckets are consumed as-is
+    (segments colocated per shard — see ``shard_bucket``), so results
+    match single-chip ``als_train`` for the same seed. Returns (U, V)
+    trimmed to the true row counts (still sharded device arrays)."""
+    import dataclasses
+
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"mesh has axes {tuple(mesh.axis_names)} but the sharded ALS "
+            f"trainer shards over {axis!r}; name one mesh axis {axis!r} "
+            f"(e.g. --mesh {axis}=N) or pass axis="
         )
+    shards = mesh.shape[axis]
     state = init_sharded_factors(data, params, mesh, axis)
-    row_dbs = upload_buckets(data.row_buckets, mesh, axis, state.U.shape[0] - 1)
-    col_dbs = upload_buckets(data.col_buckets, mesh, axis, state.V.shape[0] - 1)
-    for _ in range(params.iterations):
-        state.U = sharded_half_step(state, state.U, state.V, row_dbs, params)
-        state.V = sharded_half_step(state, state.V, state.U, col_dbs, params)
-    return state.U[: data.num_rows], state.V[: data.num_cols]
+    row_sb = [
+        shard_bucket(b, shards, state.U.shape[0] - 1) for b in data.row_buckets
+    ]
+    col_sb = [
+        shard_bucket(b, shards, state.V.shape[0] - 1) for b in data.col_buckets
+    ]
+    row_arrays = upload_sharded_buckets(row_sb, mesh, axis)
+    col_arrays = upload_sharded_buckets(col_sb, mesh, axis)
+    # iterations rides as a dynamic loop bound (shared compile across
+    # iteration counts, like the single-chip _train_fused)
+    static_params = dataclasses.replace(params, iterations=0)
+    U, V = _train_fused_sharded(
+        state.U,
+        state.V,
+        row_arrays,
+        col_arrays,
+        params.iterations,
+        static_params,
+        mesh,
+        axis,
+    )
+    return U[: data.num_rows], V[: data.num_cols]
+
+
+def train_for_context(
+    data: als_ops.RatingsData,
+    params: als_ops.ALSParams,
+    ctx=None,
+    sharded: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Framework dispatch point: the engine-param ``shardedTrain`` knob.
+
+    Templates call this from ``Algorithm.train``; with ``sharded`` the
+    run executes on the WorkflowContext's device mesh (the production
+    multi-chip path — the TPU replacement for MLlib ALS's Spark-cluster
+    execution, reference examples/scala-parallel-recommendation/
+    custom-prepartor/src/main/scala/ALSAlgorithm.scala:72), otherwise on
+    the single default device.
+    """
+    if not sharded or ctx is None:
+        return als_ops.als_train(data, params)
+    mesh = ctx.mesh
+    # shard over "data" when present; a 1-D mesh shards over its only axis
+    if "data" in mesh.shape:
+        axis = "data"
+    elif len(mesh.axis_names) == 1:
+        axis = mesh.axis_names[0]
+    else:
+        raise ValueError(
+            f"shardedTrain needs a 'data' axis on the mesh; got axes "
+            f"{tuple(mesh.axis_names)}"
+        )
+    return sharded_als_train(data, params, mesh, axis)

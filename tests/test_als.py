@@ -204,39 +204,65 @@ class TestSegmentedTraining:
         U2, V2 = als.als_train(d_wide, params)
         np.testing.assert_allclose(np.asarray(U1), np.asarray(U2), rtol=5e-4, atol=5e-5)
 
-    def test_sharded_rejects_segmented_buckets(self):
-        from predictionio_tpu.parallel.als_sharded import upload_buckets
-        from predictionio_tpu.parallel.mesh import make_mesh
+    def test_shard_bucket_colocates_row_segments(self):
+        """All segments of one solved row must land on one shard, with
+        shard-local seg_row indices (the exactness precondition)."""
+        from predictionio_tpu.parallel.als_sharded import shard_bucket
 
-        rows = np.zeros(10, np.int32)
-        cols = np.arange(10, dtype=np.int32)
-        vals = np.ones(10, np.float32)
+        rows = np.concatenate(
+            [np.zeros(10, np.int32), np.arange(1, 7, dtype=np.int32)]
+        )
+        cols = np.arange(16, dtype=np.int32) % 12
+        vals = np.ones(16, np.float32)
         [bucket] = als.build_padded_buckets(rows, cols, vals, bucket_widths=(4,))
         assert bucket.seg_row is not None
-        mesh = make_mesh([("data", 2)])
-        with pytest.raises(ValueError, match="segment=False"):
-            upload_buckets([bucket], mesh, "data", 0)
+        sb = shard_bucket(bucket, shards=2, dummy_row=99)
+        S, B, R = sb.shards, sb.table_rows_per_shard, sb.rows_per_shard
+        # every real table row's solved row must be owned by its own shard
+        mask = sb.mask.reshape(S, B, -1)
+        seg = sb.seg_row.reshape(S, B)
+        row_ids = sb.row_ids.reshape(S, R)
+        covered = {}
+        for s in range(S):
+            for t in range(B):
+                n = int(mask[s, t].sum())
+                if n == 0:
+                    continue
+                rid = int(row_ids[s, seg[s, t]])
+                assert rid != 99
+                covered[rid] = covered.get(rid, 0) + n
+        assert covered == {0: 10, 1: 1, 2: 1, 3: 1, 4: 1, 5: 1, 6: 1}
 
-    def test_sharded_train_auto_rebuilds_segmented_data(self):
-        """Passing default (segment=True) data to the sharded trainer
-        transparently rebuilds a truncated layout and trains."""
+    def test_sharded_exact_on_hot_rows(self):
+        """Sharded training with a row of degree >= 10x the max bucket
+        width matches single-chip exactly (no truncation)."""
         from predictionio_tpu.parallel.als_sharded import sharded_als_train
         from predictionio_tpu.parallel.mesh import make_mesh
 
+        mesh = make_mesh([("data", 8)])
         rng = np.random.default_rng(6)
+        hot_deg = 85  # > 10 * max bucket width (8)
         rows = np.concatenate(
-            [np.zeros(20, np.int32), rng.integers(1, 10, 30).astype(np.int32)]
+            [np.zeros(hot_deg, np.int32), rng.integers(1, 30, 120).astype(np.int32)]
         )
         cols = np.concatenate(
-            [np.arange(20, dtype=np.int32) % 12, rng.integers(0, 12, 30).astype(np.int32)]
+            [
+                np.arange(hot_deg, dtype=np.int32) % 40,
+                rng.integers(0, 40, 120).astype(np.int32),
+            ]
         )
-        vals = (1 + 4 * rng.random(50)).astype(np.float32)
-        data = als.build_ratings_data(rows, cols, vals, 10, 12, bucket_widths=(8,))
+        vals = (1 + 4 * rng.random(len(rows))).astype(np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 30, 40, bucket_widths=(4, 8))
         assert any(b.seg_row is not None for b in data.row_buckets)
-        mesh = make_mesh([("data", 2)])
-        U, V = sharded_als_train(data, als.ALSParams(rank=4, iterations=2, reg=0.1), mesh)
-        assert U.shape == (10, 4) and V.shape == (12, 4)
-        assert not np.isnan(np.asarray(U)).any()
+        params = als.ALSParams(rank=4, iterations=3, reg=0.1)
+        U1, V1 = als.als_train(data, params)
+        U8, V8 = sharded_als_train(data, params, mesh)
+        np.testing.assert_allclose(
+            np.asarray(U1), np.asarray(U8), rtol=5e-4, atol=5e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(V1), np.asarray(V8), rtol=5e-4, atol=5e-5
+        )
 
 
 class TestTraining:
@@ -348,47 +374,39 @@ class TestShardedALS:
         assert len(jax.devices()) == 8, "conftest should provide 8 CPU devices"
         return make_mesh([("data", 8)])
 
-    def test_sharded_half_step_matches_single(self, mesh):
-        from predictionio_tpu.parallel import als_sharded
+    def test_sharded_explicit_matches_single_chip(self, mesh):
+        """Same seed, same math: the sharded trainer's trajectory equals
+        single-chip als_train (init is drawn at true size then padded)."""
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
 
         rows, cols, vals = synthetic_ratings(num_u=37, num_i=23, rank=3)
         data = als.build_ratings_data(rows, cols, vals, 37, 23, bucket_widths=(8, 32))
-        rng = np.random.default_rng(7)
-        D = 4
-        V = rng.normal(size=(23, D)).astype(np.float32)
-
-        # single-device reference
-        U_ref = np.zeros((37, D), np.float32)
-        for b in data.row_buckets:
-            x = als.solve_bucket_explicit(
-                jnp.asarray(V), b.col_ids, b.ratings, b.mask, reg=0.05
-            )
-            U_ref[b.row_ids] = np.asarray(x)
-
-        # sharded: V padded with dummy row to shard evenly
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        v_pad = als_sharded._padded_len(23, 8)
-        V_p = np.zeros((v_pad, D), np.float32)
-        V_p[:23] = V
-        sharding = NamedSharding(mesh, P("data"))
-        V_sh = jax.device_put(V_p, sharding)
-        U_sh = jax.device_put(
-            np.zeros((als_sharded._padded_len(37, 8), D), np.float32), sharding
-        )
-        state = als_sharded.ShardedALSState(
-            mesh=mesh, axis="data", U=U_sh, V=V_sh, num_rows=37, num_cols=23
-        )
-        params = als.ALSParams(rank=D, reg=0.05)
-        row_dbs = als_sharded.upload_buckets(
-            data.row_buckets, mesh, "data", state.U.shape[0] - 1
-        )
-        U_new = als_sharded.sharded_half_step(
-            state, state.U, state.V, row_dbs, params
+        params = als.ALSParams(rank=4, iterations=3, reg=0.05)
+        U1, V1 = als.als_train(data, params)
+        U8, V8 = sharded_als_train(data, params, mesh)
+        np.testing.assert_allclose(
+            np.asarray(U1), np.asarray(U8), rtol=5e-4, atol=5e-5
         )
         np.testing.assert_allclose(
-            np.asarray(U_new)[:37], U_ref, rtol=2e-4, atol=2e-5
+            np.asarray(V1), np.asarray(V8), rtol=5e-4, atol=5e-5
         )
+
+    def test_sharded_train_is_one_compile(self, mesh):
+        """The fused program compiles once; varying iteration count rides
+        the dynamic fori_loop bound without retracing."""
+        from predictionio_tpu.parallel import als_sharded
+
+        rows, cols, vals = synthetic_ratings(num_u=32, num_i=20, rank=3, seed=9)
+        data = als.build_ratings_data(rows, cols, vals, 32, 20, bucket_widths=(8, 32))
+        params = als.ALSParams(rank=4, iterations=2, reg=0.05)
+        before = als_sharded._train_fused_sharded._cache_size()
+        als_sharded.sharded_als_train(data, params, mesh)
+        import dataclasses
+
+        als_sharded.sharded_als_train(
+            data, dataclasses.replace(params, iterations=5), mesh
+        )
+        assert als_sharded._train_fused_sharded._cache_size() == before + 1
 
     def test_sharded_train_converges(self, mesh):
         from predictionio_tpu.parallel.als_sharded import sharded_als_train
