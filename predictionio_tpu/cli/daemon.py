@@ -1,0 +1,155 @@
+"""One-shot bring-up / teardown of the pio service fleet.
+
+Parity with the reference's ops scripts (bin/pio-start-all,
+bin/pio-stop-all, bin/pio-daemon): ``pio start-all`` launches the event
+server (and optionally dashboard, admin server, and a deployed engine)
+as detached OS processes with pid files and per-service logs under a run
+directory; ``pio stop-all`` terminates whatever the pid files point at.
+The reference's scripts additionally start HBase/Elasticsearch — external
+JVM services with no analog here; storage backends in this framework are
+in-process (sqlite/jsonl/localfs) or already-running remote services.
+
+Pid files live in ``$PIO_RUN_DIR`` (default ``~/.pio_tpu/run``); each
+service writes ``<name>.pid`` and logs to ``<name>.log``. Stale pid
+files (process already gone) are cleaned up on both verbs.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+# service name -> default port (matching the reference's defaults:
+# event server :7070, dashboard :9000, admin :7071; engine :8000)
+DEFAULT_PORTS = {
+    "eventserver": 7070,
+    "dashboard": 9000,
+    "adminserver": 7071,
+    "engine": 8000,
+}
+
+
+def run_dir() -> Path:
+    d = Path(os.environ.get("PIO_RUN_DIR", "~/.pio_tpu/run")).expanduser()
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _pid_file(name: str) -> Path:
+    return run_dir() / f"{name}.pid"
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def read_pid(name: str) -> int | None:
+    """Pid from the pid file, or None; drops the file if the pid is dead."""
+    pf = _pid_file(name)
+    if not pf.exists():
+        return None
+    try:
+        pid = int(pf.read_text().strip())
+    except ValueError:
+        pf.unlink(missing_ok=True)
+        return None
+    if not _alive(pid):
+        pf.unlink(missing_ok=True)
+        return None
+    return pid
+
+
+def wait_port(host: str, port: int, timeout: float = 30.0) -> bool:
+    """Poll until something accepts TCP connections on (host, port)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=1.0):
+                return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+def start_service(name: str, argv: list[str], host: str, port: int) -> int:
+    """Spawn one pio verb as a detached daemon; returns its pid.
+
+    Raises RuntimeError if a live pid file already exists or the service
+    does not come up on its port.
+    """
+    existing = read_pid(name)
+    if existing is not None:
+        raise RuntimeError(
+            f"{name} already running (pid {existing}); `pio stop-all` first"
+        )
+    log = open(run_dir() / f"{name}.log", "a")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", *argv],
+        stdout=log,
+        stderr=subprocess.STDOUT,
+        stdin=subprocess.DEVNULL,
+        start_new_session=True,  # survives the CLI process and its tty
+    )
+    log.close()
+    up = wait_port(host, port, timeout=30.0)
+    if proc.poll() is not None:
+        # the child died — a reachable port here is some FOREIGN listener
+        # (port already taken), not our service; don't claim success
+        raise RuntimeError(
+            f"{name} exited with rc={proc.returncode} before serving "
+            f"(port {port} may be in use; see {run_dir() / f'{name}.log'})"
+        )
+    if not up:
+        # same escalation as stop_service: a child mid-startup may defer
+        # SIGTERM, finish binding later, and become unstoppable (no pid
+        # file) unless we make sure it is gone now
+        proc.terminate()
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        raise RuntimeError(
+            f"{name} did not open {host}:{port} within 30s "
+            f"(see {run_dir() / f'{name}.log'})"
+        )
+    _pid_file(name).write_text(str(proc.pid))
+    return proc.pid
+
+
+def stop_service(name: str, grace: float = 10.0) -> bool:
+    """SIGTERM the service's recorded pid (SIGKILL after ``grace``).
+
+    Returns True if something was stopped.
+    """
+    pid = read_pid(name)
+    if pid is None:
+        return False
+    os.kill(pid, signal.SIGTERM)
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if not _alive(pid):
+            break
+        time.sleep(0.1)
+    else:
+        os.kill(pid, signal.SIGKILL)
+    _pid_file(name).unlink(missing_ok=True)
+    return True
+
+
+def known_services() -> list[str]:
+    """Service names with live pid files, bring-up order."""
+    order = list(DEFAULT_PORTS)
+    present = [p.stem for p in run_dir().glob("*.pid")]
+    return [n for n in order if n in present] + [
+        n for n in sorted(present) if n not in order
+    ]

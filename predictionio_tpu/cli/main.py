@@ -342,6 +342,76 @@ def cmd_run(args) -> int:
     return int(result) if isinstance(result, int) else 0
 
 
+def cmd_start_all(args) -> int:
+    """Bring up the service fleet as detached daemons (reference
+    bin/pio-start-all; see cli/daemon.py for the process model)."""
+    from predictionio_tpu.cli import daemon
+
+    plan: list[tuple[str, list[str], int]] = [
+        (
+            "eventserver",
+            ["eventserver", "--ip", args.ip, "--port", str(args.event_port)]
+            + (["--stats"] if args.stats else []),
+            args.event_port,
+        )
+    ]
+    if not args.no_dashboard:
+        plan.append(
+            (
+                "dashboard",
+                ["dashboard", "--ip", args.ip, "--port", str(args.dashboard_port)],
+                args.dashboard_port,
+            )
+        )
+    if not args.no_adminserver:
+        plan.append(
+            (
+                "adminserver",
+                ["adminserver", "--ip", args.ip, "--port", str(args.admin_port)],
+                args.admin_port,
+            )
+        )
+    if args.variant or args.engine_factory:
+        # beyond the reference's script: also deploy the latest trained
+        # engine so one verb yields a fully queryable stack
+        deploy = ["deploy", "--ip", args.ip, "--port", str(args.engine_port)]
+        if args.variant:
+            deploy += ["--variant", args.variant]
+        if args.engine_factory:
+            deploy += ["--engine-factory", args.engine_factory]
+        plan.append(("engine", deploy, args.engine_port))
+
+    started: list[str] = []
+    for name, argv, port in plan:
+        host = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+        try:
+            pid = daemon.start_service(name, argv, host, port)
+        except RuntimeError as e:
+            print(f"start-all: {e}", file=sys.stderr)
+            for prev in reversed(started):  # roll back partial bring-up
+                daemon.stop_service(prev)
+            return 1
+        started.append(name)
+        print(f"{name}: up on port {port} (pid {pid})")
+    print(f"Run dir: {daemon.run_dir()}")
+    return 0
+
+
+def cmd_stop_all(args) -> int:
+    """Tear down everything start-all recorded (reference bin/pio-stop-all)."""
+    from predictionio_tpu.cli import daemon
+
+    stopped = 0
+    # reverse bring-up order: engine first, event server last
+    for name in reversed(daemon.known_services()):
+        if daemon.stop_service(name):
+            print(f"{name}: stopped")
+            stopped += 1
+    if not stopped:
+        print("Nothing to stop.")
+    return 0
+
+
 def cmd_unregister(args) -> int:
     # engine registration is implicit for Python factories (import-by-name,
     # no registry rows to delete) — no-op parity with Console.scala's
@@ -496,6 +566,21 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("main_class", help="dotted module path, or module:function")
     r.add_argument("args", nargs="*")
     r.set_defaults(fn=cmd_run)
+
+    sa = sub.add_parser("start-all")
+    sa.add_argument("--ip", default="0.0.0.0")
+    sa.add_argument("--event-port", type=int, default=7070)
+    sa.add_argument("--dashboard-port", type=int, default=9000)
+    sa.add_argument("--admin-port", type=int, default=7071)
+    sa.add_argument("--engine-port", type=int, default=8000)
+    sa.add_argument("--stats", action="store_true")
+    sa.add_argument("--no-dashboard", action="store_true")
+    sa.add_argument("--no-adminserver", action="store_true")
+    sa.add_argument("--variant", help="also deploy this engine variant")
+    sa.add_argument("--engine-factory", help="also deploy this engine factory")
+    sa.set_defaults(fn=cmd_start_all)
+
+    sub.add_parser("stop-all").set_defaults(fn=cmd_stop_all)
 
     sub.add_parser("unregister").set_defaults(fn=cmd_unregister)
     sub.add_parser("shell").set_defaults(fn=cmd_shell)

@@ -55,13 +55,24 @@ class Params:
             raise TypeError(f"{cls.__name__} must be a dataclass")
         names = {f.name for f in dataclasses.fields(cls)}
         kwargs = {}
+        sources: dict[str, str] = {}  # field -> JSON key that set it
         for k, v in d.items():
             # accept both snake_case and the reference engine.json's
             # camelCase (Scala field names), plus Python-keyword escapes
             # ("lambda" -> field "lambda_")
             for cand in (k, _snake(k), k + "_", _snake(k) + "_"):
                 if cand in names:
+                    if cand in sources and kwargs[cand] != v:
+                        # e.g. both "numIterations" and "num_iterations"
+                        # present with different values: refusing beats
+                        # silently letting dict order pick the winner
+                        raise ValueError(
+                            f"{cls.__name__}.from_dict: keys "
+                            f"{sources[cand]!r} and {k!r} both map to "
+                            f"field {cand!r} with different values"
+                        )
                     kwargs[cand] = v
+                    sources[cand] = k
                     break
         return cls(**kwargs)
 

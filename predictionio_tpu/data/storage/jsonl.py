@@ -13,6 +13,7 @@ Capability subset: Events only — like hbase in the reference
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -20,6 +21,11 @@ import uuid
 from datetime import datetime
 from pathlib import Path
 from typing import Sequence
+
+try:  # advisory cross-process locks; Unix-only (this framework targets Linux)
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
+    fcntl = None
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
@@ -46,6 +52,29 @@ class JSONLEvents(base.Events):
         )
         return self._c.base_path / f"{name}.jsonl"
 
+    @contextlib.contextmanager
+    def _locked(self, app_id: int, channel_id: int | None):
+        """Thread lock + cross-process flock on a sidecar ``.lock`` file.
+
+        Two processes sharing one event dir (event server + trainer) must
+        serialize append vs compact: a record appended mid-compact by
+        another process would be dropped by the rewrite. The lock file is
+        separate from the data file because ``compact`` atomically
+        replaces the data file (a lock on the replaced inode would guard
+        nothing).
+        """
+        path = self._file(app_id, channel_id)
+        with self._c.lock:
+            if fcntl is None:
+                yield path
+                return
+            with open(path.with_suffix(".jsonl.lock"), "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                try:
+                    yield path
+                finally:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+
     def _replay(self, app_id: int, channel_id: int | None) -> dict[str, Event]:
         """Fold the log: last record per event id wins."""
         path = self._file(app_id, channel_id)
@@ -66,25 +95,27 @@ class JSONLEvents(base.Events):
         return table
 
     def _append(self, app_id: int, channel_id: int | None, record: dict) -> None:
-        path = self._file(app_id, channel_id)
-        with self._c.lock:
+        with self._locked(app_id, channel_id) as path:
             with open(path, "a") as f:
                 f.write(json.dumps(record) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
 
     def init(self, app_id: int, channel_id: int | None = None) -> bool:
-        with self._c.lock:
-            self._file(app_id, channel_id).touch()
+        with self._locked(app_id, channel_id) as path:
+            path.touch()
         return True
 
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:
-        with self._c.lock:
-            path = self._file(app_id, channel_id)
-            if path.exists():
-                path.unlink()
-                return True
-            return False
+        with self._locked(app_id, channel_id) as path:
+            existed = path.exists()
+            path.unlink(missing_ok=True)
+        # drop the lock sidecar too (after releasing the flock) so a
+        # deleted app/channel leaves nothing behind
+        self._file(app_id, channel_id).with_suffix(".jsonl.lock").unlink(
+            missing_ok=True
+        )
+        return existed
 
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
         event_id = event.event_id or uuid.uuid4().hex
@@ -97,23 +128,32 @@ class JSONLEvents(base.Events):
     def get(
         self, event_id: str, app_id: int, channel_id: int | None = None
     ) -> Event | None:
-        with self._c.lock:
+        with self._locked(app_id, channel_id):
             return self._replay(app_id, channel_id).get(event_id)
 
     def delete(
         self, event_id: str, app_id: int, channel_id: int | None = None
     ) -> bool:
-        with self._c.lock:
+        with self._locked(app_id, channel_id) as path:
             if event_id not in self._replay(app_id, channel_id):
                 return False
-            self._append(app_id, channel_id, {"$delete": event_id})
+            # append inline (not via _append): the flock is not reentrant
+            # across two opens of the lock file in the same process
+            with open(path, "a") as f:
+                f.write(json.dumps({"$delete": event_id}) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
             return True
 
     def compact(self, app_id: int, channel_id: int | None = None) -> int:
-        """Rewrite the log to its live records; returns the live count."""
-        with self._c.lock:
+        """Rewrite the log to its live records; returns the live count.
+
+        Holds the cross-process lock across replay+rewrite+replace so a
+        concurrent writer in another process cannot append a record that
+        the rewrite would drop.
+        """
+        with self._locked(app_id, channel_id) as path:
             table = self._replay(app_id, channel_id)
-            path = self._file(app_id, channel_id)
             tmp = path.with_suffix(".jsonl.tmp")
             with open(tmp, "w") as f:
                 for e in table.values():
@@ -135,7 +175,7 @@ class JSONLEvents(base.Events):
         limit: int | None = None,
         reversed_order: bool = False,
     ) -> list[Event]:
-        with self._c.lock:
+        with self._locked(app_id, channel_id):
             events = list(self._replay(app_id, channel_id).values())
         return query_events(
             events,

@@ -109,9 +109,13 @@ class S3Models(base.Models):
             raise
 
     def delete(self, model_id: str) -> bool:
-        if not self._exists(model_id):
-            return False
+        # S3 DeleteObject is idempotent and does not report whether the key
+        # existed, so existence is probed first — but the delete is issued
+        # unconditionally: skipping it when the probe says "missing" would
+        # leave the object behind if the probe raced a concurrent writer.
+        # The returned bool is therefore advisory under concurrency.
+        existed = self._exists(model_id)
         self._c.client.delete_object(
             Bucket=self._c.bucket, Key=self._key(model_id)
         )
-        return True
+        return existed

@@ -14,6 +14,7 @@ is an alias that exists for import parity.
 
 from __future__ import annotations
 
+import copy
 import warnings
 from datetime import datetime
 from typing import Any, Callable, Iterable, TypeVar
@@ -106,7 +107,9 @@ class EventSeq:
             by_entity.setdefault(e.entity_id, []).append(e)
         out: dict[str, T] = {}
         for eid, events in by_entity.items():
-            acc = init
+            # each entity folds from its own copy: a mutable init (e.g. a
+            # list the op appends to) must not be shared across entities
+            acc = copy.deepcopy(init)
             for e in sorted(events, key=lambda ev: ev.event_time):
                 acc = op(acc, e)
             out[eid] = acc

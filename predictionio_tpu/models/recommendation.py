@@ -153,7 +153,6 @@ class ALSAlgorithmParams(Params):
     lambda_: float = 0.01
     seed: int = 3
     compute_dtype: str = "float32"
-    use_pallas: bool = False
     # serve with item factors sharded over the device mesh (ring top-k) —
     # the TPU answer to the reference's PAlgorithm "model bigger than one
     # host" case, which issues a Spark job per query instead
@@ -235,7 +234,6 @@ class ALSAlgorithm(Algorithm):
             reg=self.params.lambda_,
             seed=self.params.seed,
             compute_dtype=self.params.compute_dtype,
-            use_pallas=self.params.use_pallas,
         )
         from predictionio_tpu.parallel.als_sharded import train_for_context
 

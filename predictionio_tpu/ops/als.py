@@ -211,7 +211,7 @@ def build_ratings_data(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("weighted_reg", "compute_dtype", "use_pallas")
+    jax.jit, static_argnames=("weighted_reg", "compute_dtype")
 )
 def solve_bucket_explicit(
     factors_other,
@@ -221,7 +221,6 @@ def solve_bucket_explicit(
     reg: float,
     weighted_reg: bool = True,
     compute_dtype: str = "float32",
-    use_pallas: bool = False,
 ):
     """Solve one padded bucket's normal equations for explicit feedback.
 
@@ -233,7 +232,7 @@ def solve_bucket_explicit(
     vg = factors_other[col_ids].astype(dt)  # [B, K, D]
     w = mask.astype(dt)
     r = (ratings * mask).astype(dt)
-    A, b = _gramian_rhs(vg, w, r, use_pallas=use_pallas)
+    A, b = _gramian_rhs(vg, w, r)
 
     n = mask.sum(axis=1)
     lam = reg * (n if weighted_reg else jnp.ones_like(n))
@@ -244,7 +243,7 @@ def solve_bucket_explicit(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("weighted_reg", "compute_dtype", "use_pallas")
+    jax.jit, static_argnames=("weighted_reg", "compute_dtype")
 )
 def solve_bucket_implicit(
     factors_other,
@@ -256,7 +255,6 @@ def solve_bucket_implicit(
     alpha: float,
     weighted_reg: bool = False,
     compute_dtype: str = "float32",
-    use_pallas: bool = False,
 ):
     """Implicit-feedback bucket solve (Hu-Koren-Volinsky; MLlib
     trainImplicit semantics): confidence ``c = 1 + alpha*r``,
@@ -268,7 +266,7 @@ def solve_bucket_implicit(
     vg = factors_other[col_ids].astype(dt)  # [B, K, D]
     conf_minus_1 = (alpha * ratings * mask).astype(dt)
     rhs_w = ((1.0 + alpha * ratings) * mask).astype(dt)
-    A_c, b = _gramian_rhs(vg, conf_minus_1, rhs_w, use_pallas=use_pallas)
+    A_c, b = _gramian_rhs(vg, conf_minus_1, rhs_w)
     n = mask.sum(axis=1)
     lam = reg * (n if weighted_reg else jnp.ones_like(n))
     lam = jnp.where(n > 0, lam, 1.0)  # padded rows -> identity system
@@ -276,18 +274,24 @@ def solve_bucket_implicit(
     return _psd_solve(A, b)
 
 
-def _gramian_rhs(vg, w, r, use_pallas: bool = False):
+def _gramian_rhs(vg, w, r):
     """Fused ``A = vg^T diag(w) vg`` and ``b = vg^T r`` per batch row.
 
     vg: [B, K, D]; w, r: [B, K]. Returns (A [B,D,D] f32, b [B,D] f32).
     The batched dot_general is the MXU hot loop; float32 accumulation via
     preferred_element_type regardless of compute dtype.
+
+    Deliberately XLA, not Pallas. A hand-written Pallas kernel for this op
+    (batch-tiled, both matmuls fused over a VMEM-resident Vg tile) was
+    built and measured on a v5e chip in round 3: op-level it was parity
+    with this path (geomean 1.01x over B/K bucket shapes at rank 20/64/
+    128), but end-to-end ALS training was 27x SLOWER (265ms vs 9.8ms,
+    ML-100K rank 20) because the opaque custom call forces the
+    ``factors_other[col_ids]`` gather to materialize [B,K,D] in HBM,
+    breaking XLA's fusion of gather+gramian+solve+scatter inside the
+    fused training program. The kernel was deleted (git history:
+    ops/als_pallas.py); numbers recorded in BASELINE.md and bench.py.
     """
-    if use_pallas:
-        from predictionio_tpu.ops.als_pallas import gramian_rhs_pallas
-
-        return gramian_rhs_pallas(vg, w, r)
-
     # f32 inputs get HIGHEST precision so TPU hardware doesn't silently
     # decompose the matmul to bf16 passes (RMSE-parity requirement);
     # bf16 compute keeps the fast default path.
@@ -346,7 +350,6 @@ class ALSParams:
     implicit_weighted_reg: bool = False  # implicit path default: plain reg*I
     seed: int = 7
     compute_dtype: str = "float32"
-    use_pallas: bool = False
     bucket_widths: tuple[int, ...] = DEFAULT_BUCKETS
 
 
@@ -409,12 +412,12 @@ def _solve_bucket_inline(
     if params.implicit:
         conf_minus_1 = (params.alpha * ratings * mask).astype(dt)
         rhs_w = ((1.0 + params.alpha * ratings) * mask).astype(dt)
-        A, b = _gramian_rhs(vg, conf_minus_1, rhs_w, use_pallas=params.use_pallas)
+        A, b = _gramian_rhs(vg, conf_minus_1, rhs_w)
         weighted = params.implicit_weighted_reg
     else:
         w = mask.astype(dt)
         r = (ratings * mask).astype(dt)
-        A, b = _gramian_rhs(vg, w, r, use_pallas=params.use_pallas)
+        A, b = _gramian_rhs(vg, w, r)
         weighted = params.weighted_reg
     n = mask.sum(axis=1)
     if seg_row is not None:

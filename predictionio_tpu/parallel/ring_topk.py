@@ -142,12 +142,22 @@ class RingCatalog:
         self._keep_all = jax.device_put(base_keep, self._sharding)
 
     def top_k(self, user_vectors, k: int, exclude_mask=None, normalize=False):
-        """Top-k over the staged catalog. See :func:`ring_top_k`."""
+        """Top-k over the staged catalog. See :func:`ring_top_k`.
+
+        ``B`` and ``k`` are compile-time shapes in the device program, and
+        serving traffic varies both per request (``query.num`` drives k).
+        Both are padded up to power-of-two buckets so arbitrary traffic
+        reuses a handful of compiled programs instead of accumulating one
+        per distinct (B, k); results are sliced back before returning.
+        """
         user_vectors = np.asarray(user_vectors, dtype=np.float32)
         B = user_vectors.shape[0]
         k = min(k, self.num_items)
+        k_pad = min(1 << max(0, k - 1).bit_length(), self.num_items)
         n = self.mesh.shape[self.axis]
-        pad_b = (-B) % n
+        # pad B to n * 2^j: divisible by the mesh axis AND bucketed
+        per_dev = max(1, -(-B // n))
+        pad_b = n * (1 << (per_dev - 1).bit_length()) - B
         q = np.concatenate(
             [user_vectors, np.zeros((pad_b, self.dim), np.float32)]
         )
@@ -166,12 +176,12 @@ class RingCatalog:
             self._v,
             self._ids,
             keep,
-            k,
+            k_pad,
             mesh=self.mesh,
             axis=self.axis,
             normalize=normalize,
         )
-        return np.asarray(scores)[:B], np.asarray(out_ids)[:B]
+        return np.asarray(scores)[:B, :k], np.asarray(out_ids)[:B, :k]
 
 
 def ring_top_k(

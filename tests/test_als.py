@@ -1,4 +1,4 @@
-"""ALS op tests: bucketing, solve exactness, convergence, pallas parity,
+"""ALS op tests: bucketing, solve exactness, convergence,
 and the mesh-sharded path on the virtual 8-device CPU mesh."""
 
 from __future__ import annotations
@@ -306,28 +306,6 @@ class TestTraining:
         e32 = als.rmse(*f32, rows, cols, vals)
         e16 = als.rmse(*bf16, rows, cols, vals)
         assert e16 < max(2.5 * e32, 0.15)
-
-
-class TestPallasParity:
-    def test_gramian_rhs_matches_xla(self):
-        from predictionio_tpu.ops.als_pallas import gramian_rhs_pallas
-
-        rng = np.random.default_rng(4)
-        vg = rng.normal(size=(5, 8, 4)).astype(np.float32)
-        w = rng.random((5, 8)).astype(np.float32)
-        r = rng.random((5, 8)).astype(np.float32)
-        A1, b1 = als._gramian_rhs(jnp.asarray(vg), jnp.asarray(w), jnp.asarray(r))
-        A2, b2 = gramian_rhs_pallas(jnp.asarray(vg), jnp.asarray(w), jnp.asarray(r))
-        np.testing.assert_allclose(np.asarray(A1), np.asarray(A2), rtol=1e-5, atol=1e-5)
-        np.testing.assert_allclose(np.asarray(b1), np.asarray(b2), rtol=1e-5, atol=1e-5)
-
-    def test_full_train_with_pallas_kernel(self):
-        rows, cols, vals = synthetic_ratings(num_u=30, num_i=20, rank=3, density=0.5)
-        data = als.build_ratings_data(rows, cols, vals, 30, 20, bucket_widths=(16,))
-        U, V = als.als_train(
-            data, als.ALSParams(rank=4, iterations=4, reg=0.01, use_pallas=True)
-        )
-        assert als.rmse(U, V, rows, cols, vals) < 0.2
 
 
 class TestTopK:

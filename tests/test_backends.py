@@ -170,3 +170,65 @@ class TestCapabilityDefaults:
         )
         with pytest.raises(StorageError, match="does not support"):
             s.get_metadata_apps()
+
+
+class TestAdvisorRegressions:
+    def test_jsonl_append_vs_compact_across_processes(self, tmp_path):
+        """A writer in another OS process must not lose records to a
+        concurrent compact (advisor finding: in-process RLock only)."""
+        import subprocess
+        import sys
+        import textwrap
+
+        client = JSONLStorageClient({"path": str(tmp_path)})
+        events = JSONLEvents(client)
+        events.init(11)
+        n_child = 200
+        child = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                textwrap.dedent(
+                    f"""
+                    from predictionio_tpu.data.storage.jsonl import (
+                        JSONLEvents, JSONLStorageClient)
+                    from predictionio_tpu.data.event import Event
+                    ev = JSONLEvents(JSONLStorageClient({{"path": {str(tmp_path)!r}}}))
+                    for i in range({n_child}):
+                        ev.insert(Event(event="rate", entity_type="user",
+                                        entity_id=f"c{{i}}"), 11)
+                    """
+                ),
+            ],
+        )
+        # compact continuously while the child appends
+        while child.poll() is None:
+            events.compact(11)
+        assert child.returncode == 0
+        events.compact(11)
+        assert len(events.find(11)) == n_child
+
+    def test_s3_delete_issued_even_when_probe_misses(self):
+        """Delete must reach the store even if the existence probe says
+        missing (probe can race a concurrent writer)."""
+
+        class RacyClient(FakeS3Client):
+            def __init__(self):
+                super().__init__()
+                self.deletes = []
+
+            def head_object(self, Bucket, Key):
+                raise KeyError(Key)  # probe always claims missing
+
+            def delete_object(self, Bucket, Key):
+                self.deletes.append(Key)
+                super().delete_object(Bucket, Key)
+
+        fake = RacyClient()
+        models = S3Models(
+            S3StorageClient({"bucket_name": "b", "client": fake})
+        )
+        models.insert(Model("m", b"x"))
+        assert models.delete("m") is False  # advisory bool from the probe
+        assert fake.deletes == ["pio_model_m.bin"]  # but the delete ran
+        assert models.get("m") is None

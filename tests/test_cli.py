@@ -224,3 +224,55 @@ class TestCLILifecycle:
         pio(["app", "data-delete", "VerbApp"], cli_env)
         pio(["app", "delete", "VerbApp"], cli_env)
         assert "VerbApp" not in pio(["app", "list"], cli_env).stdout
+
+
+class TestStartStopAll:
+    def test_start_all_stop_all(self, cli_env, tmp_path):
+        """One-shot fleet bring-up/teardown (reference bin/pio-start-all):
+        event server + dashboard + admin server as detached daemons with
+        pid files, then stop-all terminates them all."""
+        env = dict(cli_env)
+        env["PIO_RUN_DIR"] = str(tmp_path / "run")
+        ev, db, ad = free_port(), free_port(), free_port()
+        out = pio(
+            [
+                "start-all",
+                "--ip", "127.0.0.1",
+                "--event-port", str(ev),
+                "--dashboard-port", str(db),
+                "--admin-port", str(ad),
+            ],
+            env,
+            timeout=120,
+        ).stdout
+        try:
+            for name in ("eventserver", "dashboard", "adminserver"):
+                assert f"{name}: up" in out
+                assert (tmp_path / "run" / f"{name}.pid").exists()
+            # all three answer HTTP
+            for port in (ev, db, ad):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=10
+                ) as resp:
+                    assert resp.status == 200
+            # double start refuses and rolls back nothing extra
+            proc = pio(["start-all", "--ip", "127.0.0.1",
+                        "--event-port", str(ev)], env, check=False)
+            assert proc.returncode == 1
+            assert "already running" in proc.stderr
+        finally:
+            out = pio(["stop-all"], env, timeout=60).stdout
+        for name in ("eventserver", "dashboard", "adminserver"):
+            assert f"{name}: stopped" in out
+            assert not (tmp_path / "run" / f"{name}.pid").exists()
+        # ports are actually released
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", ev), timeout=0.5):
+                    time.sleep(0.3)
+            except OSError:
+                break
+        else:
+            raise AssertionError("event server port still open after stop-all")
+        assert "Nothing to stop" in pio(["stop-all"], env).stdout

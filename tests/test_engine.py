@@ -428,3 +428,24 @@ class TestWorkflowLifecycle:
         inst = storage.get_metadata_engine_instances().get(instance_id)
         with pytest.raises(RuntimeError):
             workflow.prepare_deploy(engine, inst, storage=storage)
+
+
+@dataclass
+class DupParams(Params):
+    num_iterations: int = 0
+
+
+class TestParamsFromDictDuplicates:
+    """Advisor finding: duplicate camelCase/snake_case keys must not let
+    dict order silently pick the winner."""
+
+    def test_conflicting_duplicate_keys_rejected(self):
+        with pytest.raises(ValueError, match="both map to"):
+            DupParams.from_dict({"numIterations": 1, "num_iterations": 2})
+
+    def test_agreeing_duplicate_keys_allowed(self):
+        p = DupParams.from_dict({"numIterations": 3, "num_iterations": 3})
+        assert p.num_iterations == 3
+
+    def test_camelcase_alone_still_maps(self):
+        assert DupParams.from_dict({"numIterations": 4}).num_iterations == 4

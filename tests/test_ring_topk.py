@@ -71,3 +71,24 @@ class TestRingTopK:
         scores, ids = ring_top_k(q, v, 50, mesh)
         assert ids.shape == (2, 6)
         assert sorted(ids[0].tolist()) == list(range(6))
+
+    def test_varied_traffic_reuses_compiled_programs(self, mesh):
+        """query.num drives k and batch size varies per request; padded
+        (B, k) buckets must reuse compilations (advisor finding)."""
+        from predictionio_tpu.parallel.ring_topk import (
+            RingCatalog,
+            _ring_topk_device,
+        )
+
+        rng = np.random.default_rng(5)
+        cat = RingCatalog(rng.standard_normal((64, 8)).astype(np.float32), mesh)
+        before = _ring_topk_device._cache_size()
+        s1, i1 = cat.top_k(rng.standard_normal((3, 8)), k=5)
+        mid = _ring_topk_device._cache_size()
+        s2, i2 = cat.top_k(rng.standard_normal((6, 8)), k=7)
+        after = _ring_topk_device._cache_size()
+        assert s1.shape == (3, 5) and i1.shape == (3, 5)
+        assert s2.shape == (6, 7) and i2.shape == (6, 7)
+        # both requests pad to the same (B', k') bucket -> one compile
+        assert mid == before + 1
+        assert after == mid

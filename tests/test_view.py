@@ -101,3 +101,17 @@ class TestDataView:
                 else None,
             )
         assert rows == [("u1", 4.0)]
+
+
+class TestAdvisorRegressions:
+    def test_mutable_init_not_shared_across_entities(self, app_with_events, storage):
+        """A mutable fold init (e.g. a list the op appends to) must be
+        copied per entity, not shared (advisor finding)."""
+        with pytest.warns(DeprecationWarning):
+            view = LBatchView(app_with_events, storage=storage)
+            out = view.events.aggregate_by_entity_ordered(
+                [], lambda acc, e: (acc.append(e.event), acc)[1]
+            )
+        assert set(out) == {"u1", "i1"}
+        assert out["i1"] == ["$set"]
+        assert out["u1"] == ["$set", "$set", "$unset", "rate"]
