@@ -1,25 +1,38 @@
-"""Benchmark: MovieLens-100K-shaped ALS training on TPU vs CPU baseline.
+"""Benchmark: the full perf story of the TPU ALS framework in one run.
 
-North star (BASELINE.json): MovieLens ALS train wall-clock at RMSE parity
-(rank 20) vs Spark-MLlib ALS. The reference publishes no numbers and this
-box has no Spark and no network, so the measured comparator is the same
-blocked normal-equation ALS implemented in NumPy on the host CPU — the
-single-machine stand-in for the JVM baseline (BASELINE.md).
+North star (BASELINE.json): MovieLens-20M ALS train wall-clock at RMSE
+parity (rank 20) vs Spark-MLlib ALS. The reference publishes no numbers
+and this box has no Spark and no network, so the measured comparator is
+the same blocked normal-equation ALS implemented in NumPy on the host
+CPU — the single-machine stand-in for the JVM baseline (BASELINE.md).
 
-Data: synthetic MovieLens-100K shape (943 users x 1682 items, 100k
-ratings, long-tail degree distribution, 1-5 star values from a low-rank
-ground truth + noise), fixed seed.
-
-Prints ONE JSON line:
+One `python bench.py` run emits ONE JSON line:
   {"metric": "ml100k_als_train_wallclock", "value": <tpu seconds>,
-   "unit": "s", "vs_baseline": <cpu_seconds / tpu_seconds>, ...extras}
+   "unit": "s", "vs_baseline": <cpu_seconds / tpu_seconds>, ...}
+with extras covering the whole story:
+  - "20m":     MovieLens-20M-shaped core train (seconds, RMSE)
+  - "bf16":    same workload at compute_dtype=bfloat16 vs float32
+  - "mfu":     achieved FLOP/s and model-FLOPs-utilization of the 20M run
+  - "serving": POST /queries.json p50/p99 through a real EngineServer —
+               dense top-k, RingCatalog (mesh-sharded), and the
+               e-commerce live-filter path
+  - "e2e":     import -> train through the whole framework (jsonl event
+               log, splice import, columnar scan) with peak RSS
+  - "pallas":  the round-3 kernel decision record (see BASELINE.md)
+
+Section failures degrade to an "error" entry instead of killing the run.
+Env knobs: BENCH_SCALES=100k,20m  BENCH_E2E_EVENTS=20000000
+BENCH_SERVING=1  BENCH_BASELINE=1  BENCH_PEAK_FLOPS=1.97e14
 """
 
 from __future__ import annotations
 
 import json
 import os
+import resource
+import tempfile
 import time
+import urllib.request
 
 import numpy as np
 
@@ -28,8 +41,6 @@ ITERATIONS = 10
 REG = 0.05
 SEED = 42
 
-# BENCH_SCALE=20m benchmarks the MovieLens-20M shape (the BASELINE.json
-# north star); default stays 100k so routine driver runs are quick.
 SCALES = {
     # users, items, ratings, max user degree, max item degree — the
     # degree maxima of the real MovieLens datasets, used to cap the
@@ -38,17 +49,31 @@ SCALES = {
     "1m": (6_040, 3_706, 1_000_000, 2_314, 3_428),
     "20m": (138_493, 26_744, 20_000_000, 9_254, 67_310),
 }
-SCALE = os.environ.get("BENCH_SCALE", "100k")
-NUM_USERS, NUM_ITEMS, NUM_RATINGS, MAX_U_DEG, MAX_I_DEG = SCALES[SCALE]
-# the numpy comparator at 20M takes many minutes; skip unless asked
-RUN_CPU_BASELINE = os.environ.get("BENCH_BASELINE", "1" if SCALE == "100k" else "0") == "1"
+RUN_SCALES = [
+    s for s in os.environ.get("BENCH_SCALES", "100k,20m").split(",") if s
+]
+RUN_CPU_BASELINE = os.environ.get("BENCH_BASELINE", "1") == "1"
+RUN_SERVING = os.environ.get("BENCH_SERVING", "1") == "1"
+E2E_EVENTS = int(os.environ.get("BENCH_E2E_EVENTS", "20000000"))
+# v5e bf16 MXU peak per chip; the f32 path (precision HIGHEST) runs
+# multiple bf16 passes, so bf16 peak is the honest shared denominator
+PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", "1.97e14"))
+
+# Round-3 measured decision record (BASELINE.md "Pallas-vs-XLA"): kept in
+# the bench output so the driver artifact carries the evidence. The
+# kernel itself was deleted; git history has ops/als_pallas.py.
+PALLAS_RECORD = {
+    "decision": "deleted",
+    "op_level_geomean_speedup": 1.014,
+    "e2e_ml100k_train_s": {"xla": 0.0098, "pallas": 0.2656},
+    "why": "pallas_call breaks XLA fusion of gather+gramian+solve+scatter",
+}
 
 
-def make_ml_shaped():
+def make_ml_shaped(scale: str):
+    num_users, num_items, num_ratings, max_u, max_i = SCALES[scale]
     rng = np.random.default_rng(SEED)
-    # long-tail popularity, with per-entity shares capped at the real
-    # MovieLens degree maxima for this scale so synthetic degrees match
-    # the real distribution (hot rows exercise the segmented solve path)
+
     def capped(weights, cap):
         p = weights / weights.sum()
         for _ in range(16):  # cap-and-renormalize to a fixed point
@@ -58,21 +83,21 @@ def make_ml_shaped():
                 break
         return p
 
-    user_p = capped(rng.pareto(1.2, NUM_USERS) + 1, MAX_U_DEG / NUM_RATINGS)
-    item_p = capped(rng.pareto(1.1, NUM_ITEMS) + 1, MAX_I_DEG / NUM_RATINGS)
-    rows = rng.choice(NUM_USERS, NUM_RATINGS, p=user_p).astype(np.int32)
-    cols = rng.choice(NUM_ITEMS, NUM_RATINGS, p=item_p).astype(np.int32)
+    user_p = capped(rng.pareto(1.2, num_users) + 1, max_u / num_ratings)
+    item_p = capped(rng.pareto(1.1, num_items) + 1, max_i / num_ratings)
+    rows = rng.choice(num_users, num_ratings, p=user_p).astype(np.int32)
+    cols = rng.choice(num_items, num_ratings, p=item_p).astype(np.int32)
     gt_rank = 8
-    U = (rng.normal(size=(NUM_USERS, gt_rank)) / np.sqrt(gt_rank)).astype(np.float32)
-    V = (rng.normal(size=(NUM_ITEMS, gt_rank)) / np.sqrt(gt_rank)).astype(np.float32)
-    vals = np.empty(NUM_RATINGS, np.float32)
+    U = (rng.normal(size=(num_users, gt_rank)) / np.sqrt(gt_rank)).astype(np.float32)
+    V = (rng.normal(size=(num_items, gt_rank)) / np.sqrt(gt_rank)).astype(np.float32)
+    vals = np.empty(num_ratings, np.float32)
     chunk = 2_000_000  # bound peak memory of the gather at large scales
-    for lo in range(0, NUM_RATINGS, chunk):
-        hi = min(lo + chunk, NUM_RATINGS)
+    for lo in range(0, num_ratings, chunk):
+        hi = min(lo + chunk, num_ratings)
         raw = (U[rows[lo:hi]] * V[cols[lo:hi]]).sum(1)
         raw += 0.3 * rng.standard_normal(hi - lo).astype(np.float32)
         vals[lo:hi] = np.clip(np.round(3.0 + 1.5 * raw), 1, 5)
-    return rows, cols, vals
+    return rows, cols, vals, num_users, num_items
 
 
 def numpy_als(buckets_row, buckets_col, num_u, num_i, rank, iterations, reg, seed):
@@ -109,66 +134,519 @@ def numpy_als(buckets_row, buckets_col, num_u, num_i, rank, iterations, reg, see
     return U, V
 
 
-def main() -> None:
-    from predictionio_tpu.utils import apply_platform_env
+def als_flops(data, rank: int, iterations: int) -> float:
+    """Statically-known model FLOPs of the fused training program: per
+    bucket per half-step, the Gramian batched matmul (2*B*K*D^2), the rhs
+    (2*B*K*D), and the Cholesky solve (D^3/3 factor + 2*D^2 per row)."""
+    total = 0.0
+    for buckets in (data.row_buckets, data.col_buckets):
+        for b in buckets:
+            B, K = b.col_ids.shape
+            total += 2.0 * B * K * rank * rank  # gramian
+            total += 2.0 * B * K * rank  # rhs
+            n_solved = len(b.row_ids)
+            total += n_solved * (rank**3 / 3.0 + 2.0 * rank**2)  # cholesky
+    return total * iterations
 
-    apply_platform_env()  # honor JAX_PLATFORMS even under plugin boot hooks
-    import jax
 
-    from predictionio_tpu.ops import als
+def time_train(als, data, params, repeats: int):
+    import dataclasses
 
-    rows, cols, vals = make_ml_shaped()
-    data = als.build_ratings_data(rows, cols, vals, NUM_USERS, NUM_ITEMS)
-    params = als.ALSParams(
-        rank=RANK, iterations=ITERATIONS, reg=REG, seed=SEED, compute_dtype="float32"
-    )
-
-    # --- TPU (or whatever the default jax device is) ---
-    # warmup: compile the fused training program (shared across iteration
-    # counts), then time repeated full runs and report the median
-    warm = als.ALSParams(**{**params.__dict__, "iterations": 1})
+    warm = dataclasses.replace(params, iterations=1)
     als.als_train(data, warm)[0].block_until_ready()
-    repeats = 5 if SCALE == "100k" else 3
     times = []
+    U = V = None
     for _ in range(repeats):
         t0 = time.perf_counter()
         U, V = als.als_train(data, params)
         U.block_until_ready()
         V.block_until_ready()
         times.append(time.perf_counter() - t0)
-    tpu_s = sorted(times)[len(times) // 2]
-    tpu_rmse = als.rmse(U, V, rows, cols, vals)
+    return sorted(times)[len(times) // 2], U, V
+
+
+def core_child(scale: str, dtype: str) -> None:
+    """Child mode (--core-child <scale> <dtype>): ONE core training
+    measurement in a fresh process. On remote-tunnel TPU attachments,
+    per-dispatch/transfer latency degrades once a process has done heavy
+    device work (measured: the same 20m f32 run is 1.1 s as the first
+    section and 15.7 s after others), so every core number comes from its
+    own process. Prints one JSON object."""
+    from predictionio_tpu.ops import als
+
+    rows, cols, vals, num_u, num_i = make_ml_shaped(scale)
+    data = als.build_ratings_data(rows, cols, vals, num_u, num_i)
+    params = als.ALSParams(
+        rank=RANK, iterations=ITERATIONS, reg=REG, seed=SEED,
+        compute_dtype=dtype,
+    )
+    repeats = 5 if scale == "100k" else 3
+    tpu_s, U, V = time_train(als, data, params, repeats)
+    print(json.dumps({
+        "train_s": round(tpu_s, 4),
+        "rmse": round(als.rmse(U, V, rows, cols, vals), 4),
+        "model_flops": als_flops(data, RANK, ITERATIONS),
+    }))
+
+
+def _run_core_child(scale: str, dtype: str) -> dict:
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--core-child", scale, dtype],
+        capture_output=True, text=True, timeout=1500, env=dict(os.environ),
+    )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_core(scale: str, extras: dict, result: dict) -> None:
+    """Core fused-training benchmark at one MovieLens scale, f32 (+bf16
+    and MFU at the 20m north-star scale). Each measurement runs in a
+    fresh subprocess (see core_child)."""
+    child = _run_core_child(scale, "float32")
+    tpu_s, rmse, flops = child["train_s"], child["rmse"], child["model_flops"]
+    entry = {"train_s": tpu_s, "rmse": rmse}
+
+    if scale == "100k":
+        result.update(value=tpu_s, rmse=rmse)
+        if RUN_CPU_BASELINE:
+            rows, cols, vals, num_u, num_i = make_ml_shaped(scale)
+            from predictionio_tpu.ops import als
+
+            data = als.build_ratings_data(rows, cols, vals, num_u, num_i)
+            t0 = time.perf_counter()
+            Un, Vn = numpy_als(
+                data.row_buckets, data.col_buckets, num_u, num_i,
+                RANK, ITERATIONS, REG, SEED,
+            )
+            cpu_s = time.perf_counter() - t0
+            pred = (Un[rows] * Vn[cols]).sum(1)
+            result["vs_baseline"] = round(cpu_s / tpu_s, 2)
+            result["baseline_cpu_s"] = round(cpu_s, 4)
+            result["baseline_rmse"] = round(
+                float(np.sqrt(np.mean((pred - vals) ** 2))), 4
+            )
+    if scale == "20m":
+        # bf16 compute vs f32 at the north-star scale (own fresh process)
+        bf = _run_core_child(scale, "bfloat16")
+        entry["bf16_train_s"] = bf["train_s"]
+        entry["bf16_rmse"] = bf["rmse"]
+        extras["bf16"] = {
+            "train_s": bf["train_s"],
+            "rmse": bf["rmse"],
+            "f32_train_s": tpu_s,
+            "f32_rmse": rmse,
+        }
+        extras["mfu"] = {
+            "model_flops": flops,
+            "achieved_flops_per_s": round(flops / tpu_s, 3),
+            "peak_flops_assumed": PEAK_FLOPS,
+            "mfu": round(flops / tpu_s / PEAK_FLOPS, 5),
+            "note": "f32 compute; denominator is v5e bf16 MXU peak; ALS "
+            "at rank 20 is gather/HBM-bound, not MXU-bound",
+            "bf16_achieved_flops_per_s": round(flops / bf["train_s"], 3),
+            "bf16_mfu": round(flops / bf["train_s"] / PEAK_FLOPS, 5),
+        }
+    extras[scale] = entry
+
+
+def _post_json(url: str, payload: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _latency_block(url: str, queries: list[dict], warmup: int = 10) -> dict:
+    for q in queries[:warmup]:
+        _post_json(url, q)
+    lat = []
+    for q in queries:
+        t0 = time.perf_counter()
+        _post_json(url, q)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+    return {
+        "n": len(lat),
+        "p50_ms": round(lat[len(lat) // 2], 3),
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+        "mean_ms": round(sum(lat) / len(lat), 3),
+    }
+
+
+def bench_serving(extras: dict) -> None:
+    """POST /queries.json p50/p99 through a real EngineServer: dense
+    top-k, RingCatalog sharded serving, and the e-commerce live-filter
+    path (reference serving bookkeeping: CreateServer.scala:582-590)."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import App, get_storage
+    from predictionio_tpu.models import ecommerce, recommendation
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    storage = get_storage()
+    apps = storage.get_metadata_apps()
+    events = storage.get_events()
+    rng = np.random.default_rng(SEED)
+
+    # -- recommendation data: 100k-shaped ratings, inserted columnar-fast
+    app_id = apps.insert(App(0, "BenchServe"))
+    events.init(app_id)
+    rows, cols, vals, num_u, num_i = make_ml_shaped("100k")
+    batch = [
+        Event(
+            event="rate", entity_type="user", entity_id=f"u{rows[i]}",
+            target_entity_type="item", target_entity_id=f"i{cols[i]}",
+            properties={"rating": float(vals[i])},
+        )
+        for i in range(0, len(rows), 10)  # 10k events: enough for serving
+    ]
+    events.batch_insert(batch, app_id)
+
+    def train(factory: str, engine, algo_params: dict, engine_id: str):
+        variant = {
+            "id": engine_id,
+            "engineFactory": factory,
+            "datasource": {"params": {"app_name": "BenchServe"}},
+            "algorithms": [{"name": list(engine.algorithm_classes)[0],
+                            "params": algo_params}],
+        }
+        run_train(
+            engine, engine.params_from_variant(variant), engine_id=engine_id,
+            engine_factory=factory, workflow_params=WorkflowParams(batch="bench"),
+            storage=storage,
+        )
+        inst = storage.get_metadata_engine_instances().get_latest_completed(
+            engine_id, "0", "default"
+        )
+        return EngineServer(engine, inst, storage=storage, host="127.0.0.1", port=0)
+
+    users = [f"u{u}" for u in rng.integers(0, num_u, 40)]
+    queries = [{"user": u, "num": int(k)} for u, k in
+               zip(users, rng.choice([3, 4, 10], len(users)))]
+
+    # dense top-k
+    server = train(
+        "predictionio_tpu.models.recommendation.engine",
+        recommendation.engine(),
+        {"rank": RANK, "num_iterations": 5},
+        "bench-dense",
+    )
+    port = server.start(background=True)
+    try:
+        extras.setdefault("serving", {})["dense"] = _latency_block(
+            f"http://127.0.0.1:{port}/queries.json", queries
+        )
+    finally:
+        server.stop()
+
+    # RingCatalog (mesh-resident item factors; 1-chip mesh on this box)
+    server = train(
+        "predictionio_tpu.models.recommendation.engine",
+        recommendation.engine(),
+        {"rank": RANK, "num_iterations": 5, "sharded_serving": True},
+        "bench-ring",
+    )
+    port = server.start(background=True)
+    try:
+        extras["serving"]["ring"] = _latency_block(
+            f"http://127.0.0.1:{port}/queries.json", queries
+        )
+    finally:
+        server.stop()
+
+    # e-commerce live-filter path (per-query event-store reads)
+    app2 = apps.insert(App(0, "BenchEcomm"))
+    events.init(app2)
+    ee = []
+    for i in range(300):
+        ee.append(Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                        properties={"categories": ["c1"]}))
+    for u in range(200):
+        for _ in range(20):
+            ee.append(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.integers(0, 300)}",
+            ))
+    events.batch_insert(ee, app2)
+    eng = ecommerce.engine()
+    variant = {
+        "id": "bench-ecomm",
+        "engineFactory": "predictionio_tpu.models.ecommerce.engine",
+        "datasource": {"params": {"app_name": "BenchEcomm"}},
+        "algorithms": [{"name": list(eng.algorithm_classes)[0],
+                        "params": {"app_name": "BenchEcomm", "rank": 8,
+                                   "num_iterations": 3}}],
+    }
+    run_train(
+        eng, eng.params_from_variant(variant), engine_id="bench-ecomm",
+        engine_factory="predictionio_tpu.models.ecommerce.engine",
+        workflow_params=WorkflowParams(batch="bench"), storage=storage,
+    )
+    inst = storage.get_metadata_engine_instances().get_latest_completed(
+        "bench-ecomm", "0", "default"
+    )
+    server = EngineServer(eng, inst, storage=storage, host="127.0.0.1", port=0)
+    port = server.start(background=True)
+    try:
+        eq = [{"user": f"u{u}", "num": 4} for u in rng.integers(0, 200, 40)]
+        extras["serving"]["ecommerce_live_filter"] = _latency_block(
+            f"http://127.0.0.1:{port}/queries.json", eq
+        )
+    finally:
+        server.stop()
+
+
+def bench_e2e(extras: dict) -> None:
+    """import -> train through the whole framework at event-store scale:
+    splice import into the jsonl log, columnar native scan, fused device
+    train — with peak-RSS accounting (VERDICT r2 item 3)."""
+    from predictionio_tpu.cli import commands
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.storage import App, get_storage
+    from predictionio_tpu.models import recommendation
+
+    storage = get_storage()
+    storage.get_metadata_apps().insert(App(0, "BenchE2E"))
+
+    rss_before_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    n = E2E_EVENTS
+    scale = "20m" if n >= 20_000_000 else ("1m" if n >= 1_000_000 else "100k")
+    rows, cols, vals, num_u, num_i = make_ml_shaped(scale)
+    rows, cols, vals = rows[:n], cols[:n], vals[:n]
+
+    tmpdir = os.environ["BENCH_TMPDIR"]
+    path = os.path.join(tmpdir, "e2e_events.jsonl")
+    t0 = time.perf_counter()
+    with open(path, "w") as f:
+        buf = []
+        for i in range(len(rows)):
+            buf.append(
+                '{"event":"rate","entityType":"user","entityId":"u%d",'
+                '"targetEntityType":"item","targetEntityId":"i%d",'
+                '"properties":{"rating":%.1f},'
+                '"eventTime":"2020-01-01T00:00:00.000Z"}'
+                % (rows[i], cols[i], vals[i])
+            )
+            if len(buf) == 200_000:
+                f.write("\n".join(buf) + "\n")
+                buf = []
+        if buf:
+            f.write("\n".join(buf) + "\n")
+    gen_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    imported = commands.import_events("BenchE2E", path, storage=storage)
+    import_s = time.perf_counter() - t0
+    os.unlink(path)
+
+    engine = recommendation.engine()
+    variant = {
+        "id": "bench-e2e",
+        "engineFactory": "predictionio_tpu.models.recommendation.engine",
+        "datasource": {"params": {"app_name": "BenchE2E"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": RANK, "num_iterations": ITERATIONS}}],
+    }
+    t0 = time.perf_counter()
+    run_train(
+        engine, engine.params_from_variant(variant), engine_id="bench-e2e",
+        engine_factory="predictionio_tpu.models.recommendation.engine",
+        workflow_params=WorkflowParams(batch="bench"), storage=storage,
+    )
+    train_s = time.perf_counter() - t0
+
+    extras["e2e"] = {
+        "events": imported,
+        "gen_s": round(gen_s, 1),
+        "import_s": round(import_s, 1),
+        "import_events_per_s": round(imported / import_s),
+        "train_s": round(train_s, 1),  # columnar scan + bucketing + device
+        # ru_maxrss is a process-wide high-water mark; rss_before_mb shows
+        # how much of it predates this section (core-scale benchmarks)
+        "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024,
+        "rss_before_mb": rss_before_mb,
+        "event_backend": "jsonl",
+    }
+
+
+def sharded_child() -> None:
+    """Child mode (--sharded-child): step-time vs bucket count for the
+    mesh-sharded trainer on the virtual 8-device CPU mesh, plus the
+    all_gather working-set sizes (VERDICT r2 item 5). Prints one JSON
+    object; the parent merges it into extras["sharded"]."""
+    import jax
+
+    from predictionio_tpu.ops import als
+    from predictionio_tpu.parallel.als_sharded import sharded_als_train
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(SEED)
+    num_u, num_i, n = 4000, 1500, 250_000
+    rows = rng.integers(0, num_u, n).astype(np.int32)
+    cols = (rng.pareto(1.1, n) * 50).astype(np.int32) % num_i
+    vals = rng.integers(1, 6, n).astype(np.float32)
+
+    out: dict = {
+        "device_count": jax.device_count(),
+        "note": "virtual 8-device CPU mesh on one physical core: the "
+        "shards8 column validates the collective program's overhead, not "
+        "real ICI scaling; bucket-count variation is the signal",
+    }
+    cases = {
+        "1_bucket": (512,),
+        "2_buckets": (64, 512),
+        "5_buckets": (8, 32, 128, 512, 2048),
+    }
+    devices = np.array(jax.devices())
+    for name, widths in cases.items():
+        data = als.build_ratings_data(
+            rows, cols, vals, num_u, num_i, bucket_widths=widths
+        )
+        entry = {}
+        for shards in (1, 8):
+            mesh = Mesh(devices[:shards].reshape(shards), ("data",))
+            params = als.ALSParams(rank=16, iterations=2, reg=0.05, seed=SEED)
+            U, V = sharded_als_train(data, params, mesh)  # compile+warm
+            U.block_until_ready()
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                U, V = sharded_als_train(data, params, mesh)
+                U.block_until_ready()
+                V.block_until_ready()
+                times.append(time.perf_counter() - t0)
+            entry[f"shards{shards}_s"] = round(sorted(times)[1], 4)
+        entry["speedup_8shard"] = round(
+            entry["shards1_s"] / entry["shards8_s"], 2
+        )
+        out[name] = entry
+    # the documented memory model, quantified for the north-star shape
+    d = RANK
+    out["all_gather_working_set"] = {
+        "ml20m_items_gather_mb": round(SCALES["20m"][1] * d * 4 / 2**20, 2),
+        "ml20m_users_gather_mb": round(SCALES["20m"][0] * d * 4 / 2**20, 2),
+        "ceiling_rows_at_rank20_half_hbm_v5e": int(8 * 2**30 / (20 * 4)),
+        "note": "gathered opposite factors do not shrink with mesh size; "
+        "see parallel/als_sharded.py docstring",
+    }
+    print(json.dumps(out))
+
+
+def main() -> None:
+    import sys
+
+    if "--sharded-child" in sys.argv:
+        from predictionio_tpu.utils import apply_platform_env
+
+        apply_platform_env()
+        sharded_child()
+        return
+    if "--core-child" in sys.argv:
+        from predictionio_tpu.utils import apply_platform_env
+
+        apply_platform_env()
+        i = sys.argv.index("--core-child")
+        core_child(sys.argv[i + 1], sys.argv[i + 2])
+        return
+    from predictionio_tpu.utils import apply_platform_env
+
+    apply_platform_env()  # honor JAX_PLATFORMS even under plugin boot hooks
+
+    # all storage for serving/e2e lives in one throwaway dir; configure
+    # BEFORE the first get_storage() call binds the singleton
+    tmpdir = tempfile.mkdtemp(prefix="pio_bench_")
+    os.environ["BENCH_TMPDIR"] = tmpdir
+    os.environ["PIO_FS_BASEDIR"] = os.path.join(tmpdir, "store")
+    os.environ["PIO_STORAGE_SOURCES_DB_TYPE"] = "sqlite"
+    os.environ["PIO_STORAGE_SOURCES_DB_PATH"] = os.path.join(tmpdir, "pio.db")
+    os.environ["PIO_STORAGE_SOURCES_LOG_TYPE"] = "jsonl"
+    os.environ["PIO_STORAGE_SOURCES_LOG_PATH"] = os.path.join(tmpdir, "events")
+    os.environ["PIO_STORAGE_SOURCES_FS_TYPE"] = "localfs"
+    os.environ["PIO_STORAGE_SOURCES_FS_PATH"] = os.path.join(tmpdir, "models")
+    os.environ["PIO_STORAGE_REPOSITORIES_METADATA_SOURCE"] = "DB"
+    os.environ["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "LOG"
+    os.environ["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "FS"
+
+    import jax
+
+    from predictionio_tpu.ops import als
 
     result = {
-        "metric": f"ml{SCALE}_als_train_wallclock",
-        "value": round(tpu_s, 4),
+        "metric": "ml100k_als_train_wallclock",
+        "value": None,
         "unit": "s",
-        "rmse": round(tpu_rmse, 4),
         "rank": RANK,
         "iterations": ITERATIONS,
         "device": str(jax.devices()[0]),
     }
+    extras: dict = {"pallas": PALLAS_RECORD}
 
-    if RUN_CPU_BASELINE:
-        # --- CPU baseline (same algorithm, numpy) ---
-        t0 = time.perf_counter()
-        Un, Vn = numpy_als(
-            data.row_buckets,
-            data.col_buckets,
-            NUM_USERS,
-            NUM_ITEMS,
-            RANK,
-            ITERATIONS,
-            REG,
-            SEED,
+    section_t0 = time.perf_counter()
+
+    def _mark(name):
+        nonlocal_t = time.perf_counter()
+        extras.setdefault("section_seconds", {})[name] = round(
+            nonlocal_t - _mark.t0, 1
         )
-        cpu_s = time.perf_counter() - t0
-        pred = (Un[rows] * Vn[cols]).sum(1)
-        result["vs_baseline"] = round(cpu_s / tpu_s, 2)
-        result["baseline_cpu_s"] = round(cpu_s, 4)
-        result["baseline_rmse"] = round(
-            float(np.sqrt(np.mean((pred - vals) ** 2))), 4
+        _mark.t0 = nonlocal_t
+
+    _mark.t0 = section_t0
+
+    # core scales FIRST: on remote-tunnel TPU attachments (this box),
+    # per-dispatch latency grows to ~130 ms once the process has run many
+    # device calls, which would pollute the fused-program wall-clocks if
+    # serving/e2e ran before them (measured: 100k 6.7 ms fresh vs 268 ms
+    # after the other sections)
+    for scale in RUN_SCALES:
+        try:
+            bench_core(scale, extras, result)
+        except Exception as e:  # record, keep benching
+            extras[scale] = {"error": f"{type(e).__name__}: {e}"}
+        _mark(f"core_{scale}")
+
+    if RUN_SERVING:
+        try:
+            bench_serving(extras)
+        except Exception as e:
+            extras["serving"] = {"error": f"{type(e).__name__}: {e}"}
+        _mark("serving")
+
+    if E2E_EVENTS > 0:
+        try:
+            bench_e2e(extras)
+        except Exception as e:
+            extras["e2e"] = {"error": f"{type(e).__name__}: {e}"}
+        _mark("e2e")
+
+    # sharded-trainer microbench runs in a child process on the virtual
+    # 8-device CPU mesh (this process owns the real TPU backend)
+    try:
+        import subprocess
+        import sys as _sys
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        proc = subprocess.run(
+            [_sys.executable, os.path.abspath(__file__), "--sharded-child"],
+            capture_output=True, text=True, timeout=900, env=env,
         )
+        extras["sharded"] = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        extras["sharded"] = {"error": f"{type(e).__name__}: {e}"}
+    _mark("sharded")
+
+    result.update(extras)
     print(json.dumps(result))
 
 

@@ -276,6 +276,22 @@ def cmd_eventserver(args) -> int:
     return 0
 
 
+def cmd_storageserver(args) -> int:
+    """Serve this host's storage repositories over HTTP so remote
+    processes (event server / trainer / engine server on other machines)
+    can bind their repositories to it via the ``http`` backend — the
+    client-server storage role JDBC Postgres plays in the reference."""
+    from predictionio_tpu.server.storage_server import StorageServer
+
+    StorageServer(
+        host=args.ip,
+        port=args.port,
+        auth_key=args.auth_key,
+        server_config=_load_server_config(args) if args.server_config else None,
+    ).start(background=False)
+    return 0
+
+
 def cmd_adminserver(args) -> int:
     from predictionio_tpu.server.admin_server import AdminServer
 
@@ -539,6 +555,13 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("--ip", default="0.0.0.0")
     ad.add_argument("--port", type=int, default=7071)
     ad.set_defaults(fn=cmd_adminserver)
+
+    ss = sub.add_parser("storageserver")
+    ss.add_argument("--ip", default="0.0.0.0")
+    ss.add_argument("--port", type=int, default=7072)
+    ss.add_argument("--auth-key", help="shared key clients must present")
+    ss.add_argument("--server-config", help="server.conf path (TLS)")
+    ss.set_defaults(fn=cmd_storageserver)
 
     db = sub.add_parser("dashboard")
     db.add_argument("--ip", default="0.0.0.0")

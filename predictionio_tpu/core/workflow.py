@@ -88,6 +88,9 @@ def run_train(
         serving_params=_params_json(engine_params.serving),
     )
     instance_id = instances.insert(instance)
+    # adopt the generated id locally: remote backends (http) can't mutate
+    # our copy server-side, and the later update() keys on instance.id
+    instance.id = instance_id
     logger.info("engine instance %s created (INIT)", instance_id)
 
     try:

@@ -81,6 +81,9 @@ def run_evaluation(
         batch=batch,
     )
     instance_id = instances.insert(instance)
+    # adopt the generated id locally: remote backends (http) can't mutate
+    # our copy server-side, and the later update() keys on instance.id
+    instance.id = instance_id
 
     try:
         params_list = generator.engine_params_list if generator else None

@@ -90,6 +90,13 @@ class BiMap(Generic[K, V]):
 
     string_long = string_int  # Python ints are unbounded
 
+    @staticmethod
+    def from_dense(ids: Sequence[str]) -> "BiMap[str, int]":
+        """Wrap an already-dense id list (index = list position) — the
+        zero-copy constructor for columnar reads whose id lists came out
+        of ``scan_ratings``/``index_spans`` pre-indexed."""
+        return BiMap({k: i for i, k in enumerate(ids)})
+
     # -- vectorized paths --------------------------------------------------
     def to_index_array(self, keys: Sequence[K]) -> np.ndarray:
         """Bulk key->index conversion to an int32 numpy array."""

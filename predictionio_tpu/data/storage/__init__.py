@@ -135,6 +135,15 @@ def _s3_backend() -> _Backend:
     )
 
 
+def _http_backend() -> _Backend:
+    from predictionio_tpu.data.storage import httpstorage as hs
+
+    return _Backend(
+        client_factory=lambda cfg: hs.HTTPStorageClient(cfg),
+        daos=dict(hs.DAOS),
+    )
+
+
 _BACKEND_TYPES: dict[str, Callable[[], _Backend]] = {
     "sqlite": _sqlite_backend,
     "memory": _memory_backend,
@@ -142,10 +151,12 @@ _BACKEND_TYPES: dict[str, Callable[[], _Backend]] = {
     "jsonl": _jsonl_backend,
     "hdfs": _hdfs_backend,
     "s3": _s3_backend,
+    "http": _http_backend,
 }
 
 # which repositories each backend type can serve (capability subsets,
-# reference SURVEY §2.3: jdbc=all, hbase=events, localfs/hdfs/s3=models)
+# reference SURVEY §2.3: jdbc=all, hbase=events, localfs/hdfs/s3=models;
+# http = the client-server backend, jdbc's role: all three repos)
 _TYPE_CAPABILITIES: dict[str, tuple[str, ...]] = {
     "sqlite": REPOSITORIES,
     "memory": REPOSITORIES,
@@ -153,6 +164,7 @@ _TYPE_CAPABILITIES: dict[str, tuple[str, ...]] = {
     "jsonl": (EVENTDATA,),
     "hdfs": (MODELDATA,),
     "s3": (MODELDATA,),
+    "http": REPOSITORIES,
 }
 
 

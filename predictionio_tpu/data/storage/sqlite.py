@@ -614,6 +614,93 @@ class SQLiteEvents(base.Events):
                 raise
             return cur.rowcount > 0
 
+    def scan_ratings(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        *,
+        event_names=None,
+        entity_type: str | None = None,
+        target_entity_type: str | None = None,
+        rating_key: str | None = "rating",
+        default_ratings: dict[str, float] | None = None,
+    ) -> base.RatingsBatch:
+        """Columnar fast path: a 4-column SQL projection with json1
+        extracting the rating — the DB does the filtering and property
+        parse, Python only dense-indexes ids in fetchmany batches; no
+        Event objects (reference JDBCPEvents JdbcRDD read,
+        storage/jdbc/.../JDBCPEvents.scala:91)."""
+        import numpy as np
+
+        if rating_key is not None and '"' in rating_key:
+            raise ValueError("rating_key must not contain double quotes")
+        t = self._table(app_id, channel_id)
+        clauses, params = ["targetentityid IS NOT NULL"], []
+        if entity_type is not None:
+            clauses.append("entitytype = ?")
+            params.append(entity_type)
+        if target_entity_type is not None:
+            clauses.append("targetentitytype = ?")
+            params.append(target_entity_type)
+        if event_names is not None:
+            event_names = list(event_names)
+            if not event_names:
+                return base.RatingsBatch(
+                    [], [],
+                    np.empty(0, np.int32), np.empty(0, np.int32),
+                    np.empty(0, np.float32),
+                )
+            clauses.append("event IN (" + ",".join("?" * len(event_names)) + ")")
+            params.extend(event_names)
+        if rating_key is None:
+            value_col = "NULL"  # pure implicit: event-name defaults only
+        else:
+            # json_type filter: JSON booleans extract as integers 1/0 in
+            # sqlite, but the base/jsonl backends reject booleans (fall
+            # back to the event-name default) — parity requires the same
+            path_expr = f"properties, '$.\"{rating_key}\"'"
+            value_col = (
+                f"CASE WHEN json_type({path_expr}) IN ('integer', 'real') "
+                f"THEN json_extract({path_expr}) ELSE NULL END"
+            )
+        sql = (
+            f"SELECT entityid, targetentityid, event, {value_col} "
+            f"FROM {t} WHERE " + " AND ".join(clauses)
+        )
+        user_map: dict[str, int] = {}
+        item_map: dict[str, int] = {}
+        rows: list[int] = []
+        cols: list[int] = []
+        vals: list[float] = []
+        defaults = default_ratings or {}
+        with self._c.lock:
+            try:
+                cur = self._c.conn.execute(sql, params)
+            except sqlite3.OperationalError as err:
+                if _is_missing_table(err):
+                    cur = None
+                else:
+                    raise
+            while cur is not None:
+                batch = cur.fetchmany(65536)
+                if not batch:
+                    break
+                for u, it, ev, v in batch:
+                    if not isinstance(v, (int, float)) or isinstance(v, bool):
+                        v = defaults.get(ev)
+                        if v is None:
+                            continue
+                    rows.append(user_map.setdefault(u, len(user_map)))
+                    cols.append(item_map.setdefault(it, len(item_map)))
+                    vals.append(float(v))
+        return base.RatingsBatch(
+            entity_ids=list(user_map),
+            target_ids=list(item_map),
+            rows=np.asarray(rows, dtype=np.int32),
+            cols=np.asarray(cols, dtype=np.int32),
+            vals=np.asarray(vals, dtype=np.float32),
+        )
+
     def find(
         self,
         app_id: int,

@@ -109,6 +109,36 @@ def find_by_entity(
     )
 
 
+def find_ratings(
+    app_name: str,
+    channel_name: str | None = None,
+    event_names: Sequence[str] | None = None,
+    entity_type: str | None = None,
+    target_entity_type: str | None = None,
+    rating_key: str | None = "rating",
+    default_ratings: dict[str, float] | None = None,
+    storage: Storage | None = None,
+):
+    """Columnar bulk training read: dense-indexed (rows, cols, vals)
+    arrays plus the id lists, WITHOUT materializing per-event Python
+    objects — the streaming replacement for ``find`` + per-event loops in
+    template DataSources (reference PEvents.find -> RDD pipeline,
+    data/.../storage/PEvents.scala:38-188). Returns a
+    :class:`predictionio_tpu.data.storage.base.RatingsBatch`.
+    """
+    storage = storage or get_storage()
+    app_id, channel_id = app_name_to_id(app_name, channel_name, storage)
+    return storage.get_events().scan_ratings(
+        app_id,
+        channel_id,
+        event_names=event_names,
+        entity_type=entity_type,
+        target_entity_type=target_entity_type,
+        rating_key=rating_key,
+        default_ratings=default_ratings,
+    )
+
+
 def aggregate_properties(
     app_name: str,
     entity_type: str,

@@ -24,7 +24,6 @@ the event-store read never stalls the device path mid-computation.
 from __future__ import annotations
 
 import logging
-from collections import defaultdict
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -40,6 +39,8 @@ from predictionio_tpu.core import (
     WorkflowContext,
 )
 from predictionio_tpu.data import store
+from predictionio_tpu.data.storage.base import RatingsBatch
+from predictionio_tpu.models.columnar import aggregate_counts
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.ops import als as als_ops
 
@@ -75,11 +76,12 @@ class DataSourceParams(Params):
 class TrainingData(SanityCheck):
     users: list[str] = field(default_factory=list)
     items: dict[str, list[str]] = field(default_factory=dict)
-    view_events: list[tuple[str, str]] = field(default_factory=list)
-    buy_events: list[tuple[str, str]] = field(default_factory=list)
+    # bulk signals, columnar (no per-event Python objects at 10^7 scale)
+    view_events: RatingsBatch = field(default_factory=RatingsBatch.empty)
+    buy_events: RatingsBatch = field(default_factory=RatingsBatch.empty)
 
     def sanity_check(self) -> None:
-        if not self.view_events:
+        if not len(self.view_events):
             raise ValueError(
                 "viewEvents in TrainingData cannot be empty. Please check if "
                 "DataSource generates TrainingData correctly."
@@ -96,20 +98,16 @@ class ECommerceDataSource(DataSource):
             iid: pm.get_opt("categories", default=[]) or []
             for iid, pm in store.aggregate_properties(app, entity_type="item").items()
         }
-        views = [
-            (e.entity_id, e.target_entity_id)
-            for e in store.find(
-                app, entity_type="user", event_names=["view"],
-                target_entity_type="item",
-            )
-        ]
-        buys = [
-            (e.entity_id, e.target_entity_id)
-            for e in store.find(
-                app, entity_type="user", event_names=["buy"],
-                target_entity_type="item",
-            )
-        ]
+        views = store.find_ratings(
+            app, entity_type="user", event_names=["view"],
+            target_entity_type="item", rating_key=None,
+            default_ratings={"view": 1.0},
+        )
+        buys = store.find_ratings(
+            app, entity_type="user", event_names=["buy"],
+            target_entity_type="item", rating_key=None,
+            default_ratings={"buy": 1.0},
+        )
         return TrainingData(
             users=users, items=items, view_events=views, buy_events=buys
         )
@@ -167,19 +165,12 @@ class ECommAlgorithm(Algorithm):
     query_class = Query
 
     def train(self, ctx: WorkflowContext, td: TrainingData) -> ECommModel:
-        counts: dict[tuple[str, str], float] = defaultdict(float)
-        for u, i in td.view_events:
-            counts[(u, i)] += 1.0
-        if not counts:
+        if not len(td.view_events):
             raise ValueError("cannot train on zero view events")
-        ratings = [(u, i, c) for (u, i), c in counts.items()]
-        user_index = BiMap.string_int(u for u, _, _ in ratings)
-        item_index = BiMap.string_int(list(td.items) + [i for _, i, _ in ratings])
-        rows = user_index.to_index_array([u for u, _, _ in ratings])
-        cols = item_index.to_index_array([i for _, i, _ in ratings])
-        vals = np.asarray([c for _, _, c in ratings], dtype=np.float32)
+        r = aggregate_counts(td.view_events, extra_items=td.items)
+        user_index, item_index = r.user_index, r.item_index
         data = als_ops.build_ratings_data(
-            rows, cols, vals, len(user_index), len(item_index)
+            r.rows, r.cols, r.vals, len(user_index), len(item_index)
         )
         from predictionio_tpu.parallel.als_sharded import train_for_context
 

@@ -15,7 +15,6 @@ Query: ``{"users": [...], "num": N, "whiteList": [...]?,
 from __future__ import annotations
 
 import logging
-from collections import defaultdict
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -31,6 +30,8 @@ from predictionio_tpu.core import (
     WorkflowContext,
 )
 from predictionio_tpu.data import store
+from predictionio_tpu.data.storage.base import RatingsBatch
+from predictionio_tpu.models.columnar import aggregate_counts
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.ops import als as als_ops
 
@@ -64,10 +65,11 @@ class DataSourceParams(Params):
 @dataclass
 class TrainingData(SanityCheck):
     users: list[str] = field(default_factory=list)
-    follow_events: list[tuple[str, str]] = field(default_factory=list)
+    # bulk signal, columnar (no per-event Python objects at 10^7 scale)
+    follow_events: RatingsBatch = field(default_factory=RatingsBatch.empty)
 
     def sanity_check(self) -> None:
-        if not self.follow_events:
+        if not len(self.follow_events):
             raise ValueError("TrainingData has no follow events")
 
 
@@ -77,13 +79,11 @@ class RecommendedUserDataSource(DataSource):
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         app = self.params.app_name
         users = list(store.aggregate_properties(app, entity_type="user"))
-        follows = [
-            (e.entity_id, e.target_entity_id)
-            for e in store.find(
-                app, entity_type="user", event_names=["follow"],
-                target_entity_type="user",
-            )
-        ]
+        follows = store.find_ratings(
+            app, entity_type="user", event_names=["follow"],
+            target_entity_type="user", rating_key=None,
+            default_ratings={"follow": 1.0},
+        )
         return TrainingData(users=users, follow_events=follows)
 
 
@@ -126,20 +126,12 @@ class ALSAlgorithm(Algorithm):
     query_class = Query
 
     def train(self, ctx: WorkflowContext, td: TrainingData) -> RecommendedUserModel:
-        counts: dict[tuple[str, str], float] = defaultdict(float)
-        for follower, followed in td.follow_events:
-            counts[(follower, followed)] += 1.0
-        if not counts:
+        if not len(td.follow_events):
             raise ValueError("cannot train on zero follow events")
-        follower_index = BiMap.string_int(f for f, _ in counts)
-        followed_index = BiMap.string_int(
-            list(td.users) + [t for _, t in counts]
-        )
-        rows = follower_index.to_index_array([f for f, _ in counts])
-        cols = followed_index.to_index_array([t for _, t in counts])
-        vals = np.asarray(list(counts.values()), dtype=np.float32)
+        r = aggregate_counts(td.follow_events, extra_items=td.users)
+        followed_index = r.item_index
         data = als_ops.build_ratings_data(
-            rows, cols, vals, len(follower_index), len(followed_index)
+            r.rows, r.cols, r.vals, len(r.user_index), len(followed_index)
         )
         params = als_ops.ALSParams(
             rank=self.params.rank,

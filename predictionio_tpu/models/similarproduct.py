@@ -43,6 +43,12 @@ from predictionio_tpu.core import (
 )
 from predictionio_tpu.data import store
 from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.storage.base import RatingsBatch
+from predictionio_tpu.models.columnar import (
+    IndexedRatings,
+    aggregate_counts,
+    from_triples,
+)
 from predictionio_tpu.ops import als as als_ops
 
 logger = logging.getLogger(__name__)
@@ -77,11 +83,13 @@ class DataSourceParams(Params):
 class TrainingData(SanityCheck):
     users: list[str] = field(default_factory=list)
     items: dict[str, list[str]] = field(default_factory=dict)  # id -> categories
-    view_events: list[tuple[str, str]] = field(default_factory=list)
+    # bulk signal, columnar (no per-event Python objects at 10^7 scale)
+    view_events: RatingsBatch = field(default_factory=RatingsBatch.empty)
+    # order-sensitive small signal (latest like/dislike wins) stays a list
     like_events: list[tuple[str, str, bool]] = field(default_factory=list)
 
     def sanity_check(self) -> None:
-        if not self.view_events and not self.like_events:
+        if not len(self.view_events) and not self.like_events:
             raise ValueError("TrainingData has no view/like events")
 
 
@@ -96,13 +104,12 @@ class SimilarProductDataSource(DataSource):
             iid: pm.get_opt("categories", default=[]) or []
             for iid, pm in item_props.items()
         }
-        views = [
-            (e.entity_id, e.target_entity_id)
-            for e in store.find(
-                app, entity_type="user", event_names=["view"],
-                target_entity_type="item",
-            )
-        ]
+        # columnar bulk read: every view carries implicit weight 1.0
+        views = store.find_ratings(
+            app, entity_type="user", event_names=["view"],
+            target_entity_type="item", rating_key=None,
+            default_ratings={"view": 1.0},
+        )
         likes = [
             (e.entity_id, e.target_entity_id, e.event == "like")
             for e in store.find(
@@ -190,25 +197,10 @@ def _score_similar(model: SimilarProductModel, query: Query) -> PredictedResult:
     )
 
 
-def _view_counts(td: TrainingData) -> list[tuple[str, str, float]]:
-    """Aggregate view events into (user, item, count) triples."""
-    counts: dict[tuple[str, str], float] = defaultdict(float)
-    for u, i in td.view_events:
-        counts[(u, i)] += 1.0
-    return [(u, i, c) for (u, i), c in counts.items()]
-
-
-def _index_ratings(ratings, td: TrainingData):
-    """(user_index, item_index, rows, cols, vals) from rating triples;
-    items known only from ``$set`` entities still get index slots."""
-    if not ratings:
-        raise ValueError("cannot train on zero events")
-    user_index = BiMap.string_int(u for u, _, _ in ratings)
-    item_index = BiMap.string_int(list(td.items) + [i for _, i, _ in ratings])
-    rows = user_index.to_index_array([u for u, _, _ in ratings])
-    cols = item_index.to_index_array([i for _, i, _ in ratings])
-    vals = np.asarray([c for _, _, c in ratings], dtype=np.float32)
-    return user_index, item_index, rows, cols, vals
+def _view_counts(td: TrainingData) -> IndexedRatings:
+    """Aggregate view events into per-(user, item) counts, vectorized
+    (items known only from ``$set`` entities still get index slots)."""
+    return aggregate_counts(td.view_events, extra_items=td.items)
 
 
 class ALSAlgorithm(Algorithm):
@@ -217,15 +209,14 @@ class ALSAlgorithm(Algorithm):
     params_class = ALSAlgorithmParams
     query_class = Query
 
-    def _ratings(self, td: TrainingData) -> list[tuple[str, str, float]]:
+    def _ratings(self, td: TrainingData) -> IndexedRatings:
         return _view_counts(td)
 
     def train(self, ctx: WorkflowContext, td: TrainingData) -> SimilarProductModel:
-        user_index, item_index, rows, cols, vals = _index_ratings(
-            self._ratings(td), td
-        )
+        r = self._ratings(td)
+        user_index, item_index = r.user_index, r.item_index
         data = als_ops.build_ratings_data(
-            rows, cols, vals, len(user_index), len(item_index)
+            r.rows, r.cols, r.vals, len(user_index), len(item_index)
         )
         params = als_ops.ALSParams(
             rank=self.params.rank,
@@ -252,11 +243,13 @@ class LikeAlgorithm(ALSAlgorithm):
     """like=1 / dislike=-1 signal instead of view counts
     (reference multi/LikeAlgorithm.scala: latest like/dislike wins)."""
 
-    def _ratings(self, td: TrainingData) -> list[tuple[str, str, float]]:
+    def _ratings(self, td: TrainingData) -> IndexedRatings:
         latest: dict[tuple[str, str], float] = {}
         for u, i, is_like in td.like_events:  # events are time-ordered
             latest[(u, i)] = 1.0 if is_like else -1.0
-        return [(u, i, v) for (u, i), v in latest.items()]
+        return from_triples(
+            [(u, i, v) for (u, i), v in latest.items()], extra_items=td.items
+        )
 
 
 @dataclass
@@ -282,13 +275,12 @@ class CosineAlgorithm(Algorithm):
     def train(self, ctx: WorkflowContext, td: TrainingData) -> CosineModel:
         from predictionio_tpu.ops.cosine_sim import item_similarity_topn
 
-        user_index, item_index, rows, cols, vals = _index_ratings(
-            _view_counts(td), td
-        )
+        r = _view_counts(td)
         scores, ids = item_similarity_topn(
-            rows, cols, vals, len(user_index), len(item_index),
+            r.rows, r.cols, r.vals, len(r.user_index), len(r.item_index),
             top_n=self.params.top_n,
         )
+        item_index = r.item_index
         return CosineModel(
             item_index=item_index,
             sim_scores=scores,

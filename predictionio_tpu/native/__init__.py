@@ -311,11 +311,27 @@ def parse_events_jsonl(data: bytes) -> list:
     return events
 
 
+def _span_type_mask(
+    scanned: "ScannedEvents", field: int, wanted: str
+) -> np.ndarray:
+    """Boolean mask of lines whose ``field`` span equals ``wanted``,
+    computed by dense-indexing the (few) distinct values."""
+    idx, names = index_spans(
+        scanned.buf, scanned.offs[:, field], scanned.lens[:, field]
+    )
+    ok = np.array([name == wanted for name in names], dtype=bool)
+    if not len(ok):
+        return np.zeros(len(scanned), dtype=bool)
+    return (idx >= 0) & ok[np.clip(idx, 0, None)]
+
+
 def load_ratings_jsonl(
     data: bytes,
     event_names: Sequence[str] | None = None,
-    rating_key: str = "rating",
+    rating_key: str | None = "rating",
     default_ratings: dict[str, float] | None = None,
+    entity_type: str | None = None,
+    target_entity_type: str | None = None,
 ) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray]:
     """One call from a JSONL event buffer to ALS training arrays:
     (user_ids, item_ids, rows, cols, ratings) with dense indices — the
@@ -325,6 +341,8 @@ def load_ratings_jsonl(
 
     ``default_ratings`` maps event names to implicit values (the "buy" ->
     4.0 rule); explicit ``rating_key`` properties win.
+    ``entity_type``/``target_entity_type`` restrict lines the way the
+    template DataSources do (entityType="user", targetEntityType="item").
     """
     scanned = scan_events(data)
     n = len(scanned)
@@ -332,6 +350,10 @@ def load_ratings_jsonl(
     keep &= (scanned.flags == 0) & (scanned.offs[:, F_ENTITY_ID] >= 0) & (
         scanned.offs[:, F_TARGET_ENTITY_ID] >= 0
     )
+    if entity_type is not None:
+        keep &= _span_type_mask(scanned, F_ENTITY_TYPE, entity_type)
+    if target_entity_type is not None:
+        keep &= _span_type_mask(scanned, F_TARGET_ENTITY_TYPE, target_entity_type)
 
     # event-name filter + implicit defaults need the event spans decoded;
     # dense-index the (few) distinct event names instead of per-line str
@@ -347,10 +369,13 @@ def load_ratings_jsonl(
         else:
             keep &= False
 
-    ratings = extract_number(
-        scanned.buf, scanned.offs[:, F_PROPERTIES], scanned.lens[:, F_PROPERTIES],
-        rating_key,
-    )
+    if rating_key is None:  # pure implicit: defaults only, no extraction
+        ratings = np.full(n, np.nan, dtype=np.float64)
+    else:
+        ratings = extract_number(
+            scanned.buf, scanned.offs[:, F_PROPERTIES],
+            scanned.lens[:, F_PROPERTIES], rating_key,
+        )
     if default_ratings and len(ev_names):
         defaults = np.array(
             [default_ratings.get(name, np.nan) for name in ev_names],
@@ -390,6 +415,13 @@ def load_ratings_jsonl(
             except Exception:
                 continue
             if event_names is not None and d.get("event") not in set(event_names):
+                continue
+            if entity_type is not None and d.get("entityType") != entity_type:
+                continue
+            if (
+                target_entity_type is not None
+                and d.get("targetEntityType") != target_entity_type
+            ):
                 continue
             u, it = d.get("entityId"), d.get("targetEntityId")
             if not u or not it:

@@ -27,6 +27,24 @@ same math as single-chip, with no truncation of blockbuster rows.
 ``lax.fori_loop`` (dynamic trip count) with donated factor buffers; each
 half-iteration is one ``shard_map`` region per bucket set. No per-bucket
 Python dispatch, no host round-trips of the factors.
+
+**Memory model (the all_gather working set).** Per chip, each
+half-iteration holds: (a) its shard of both factor matrices —
+``(rows + cols) / n_shards * D * 4`` bytes, shrinking with mesh size;
+(b) its shard of the bucket tables (col_ids/ratings/mask ~= 12 bytes per
+rating / n_shards), shrinking with mesh size; and (c) the ``all_gather``
+of the FULL opposite factor matrix (``_train_fused_sharded.shard_fn``) —
+``opposite_rows * D * 4`` bytes, which does NOT shrink with mesh size.
+(c) is the design ceiling: on 16-GiB v5e chips the gathered side caps at
+roughly 10^8 rows at rank 20 or 1.6*10^7 at rank 128 (at half of HBM).
+MovieLens-20M (2.7*10^4 items, rank 20 -> 2 MiB gathered) and any
+catalog up to ~10^7 entities are far below it; the gather is one fused
+ICI collective and is the latency-optimal choice there (ALX makes the
+same trade, PAPERS.md). Past that ceiling the half-step must switch to a
+blocked gather / ppermute ring over opposite-factor slabs (the
+ring-top-k pattern in parallel/ring_topk.py applied to training) —
+deliberately NOT implemented until a workload needs it; this docstring
+is the recorded decision.
 """
 
 from __future__ import annotations

@@ -1,0 +1,136 @@
+"""HTTP storage service: the client-server storage backend's server side.
+
+The reference's production deployment serves all three repositories from
+a client-server SQL database (JDBC Postgres/MySQL —
+storage/jdbc/.../JDBCLEvents.scala:37, Storage.scala:218-233) so the
+event server, trainer, and engine server on DIFFERENT hosts share state.
+This service provides the same property for the TPU framework: it wraps
+a local Storage (sqlite DAOs by default) and exposes every DAO method
+over HTTP; remote processes configure the ``http`` backend
+(data/storage/httpstorage.py) with this service's URL and get the full
+registry capability set — no shared filesystem required.
+
+Protocol: ``POST /rpc/<repo>/<method>`` with a JSON body
+``{"args": [...], "kwargs": {...}}`` encoded by data/storage/wire.py;
+responds ``{"result": ...}`` or ``{"error": <ExceptionName>,
+"message": ...}``. Method names are allowlisted against the public
+surface of the DAO base classes — nothing else is callable. Optional
+shared-key auth via the ``x-pio-storage-key`` header (the credential
+analog of the reference's JDBC username/password).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+from predictionio_tpu.data.event import EventValidationError
+from predictionio_tpu.data.storage import Storage, base, get_storage, wire
+from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+
+logger = logging.getLogger(__name__)
+
+# repo name on the wire -> (Storage accessor, DAO base class)
+_REPOS: dict[str, tuple[str, type]] = {
+    "apps": ("get_metadata_apps", base.Apps),
+    "access_keys": ("get_metadata_access_keys", base.AccessKeys),
+    "channels": ("get_metadata_channels", base.Channels),
+    "engine_instances": ("get_metadata_engine_instances", base.EngineInstances),
+    "evaluation_instances": (
+        "get_metadata_evaluation_instances",
+        base.EvaluationInstances,
+    ),
+    "events": ("get_events", base.Events),
+    "models": ("get_model_data_models", base.Models),
+}
+
+
+def _public_methods(cls: type) -> frozenset[str]:
+    return frozenset(
+        name
+        for name in dir(cls)
+        if not name.startswith("_") and callable(getattr(cls, name, None))
+    )
+
+
+_ALLOWED: dict[str, frozenset[str]] = {
+    repo: _public_methods(cls) for repo, (_, cls) in _REPOS.items()
+}
+
+
+class StorageServer:
+    """Serves a Storage's DAOs over HTTP (see module docstring)."""
+
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        host: str = "0.0.0.0",
+        port: int = 7072,
+        auth_key: str | None = None,
+        server_config=None,
+    ):
+        self.storage = storage or get_storage()
+        self.auth_key = auth_key
+        self.host = host
+        self.app = HTTPApp(
+            self._router(),
+            host=host,
+            port=port,
+            ssl_context=(
+                server_config.ssl_context() if server_config is not None else None
+            ),
+        )
+
+    def _router(self) -> Router:
+        router = Router()
+
+        @router.route("GET", "/")
+        def status(request: Request) -> Response:
+            return Response.json(
+                {
+                    "status": "alive",
+                    "service": "pio storage server",
+                    "repos": sorted(_REPOS),
+                }
+            )
+
+        @router.route("POST", "/rpc/<repo>/<method>")
+        def rpc(request: Request) -> Response:
+            if self.auth_key is not None:
+                if request.headers.get("x-pio-storage-key") != self.auth_key:
+                    return Response.error("invalid storage key", 401)
+            repo = request.path_params["repo"]
+            method = request.path_params["method"]
+            if repo not in _REPOS:
+                return Response.error(f"unknown repository {repo}", 404)
+            if method not in _ALLOWED[repo]:
+                return Response.error(
+                    f"method {method} not allowed on {repo}", 403
+                )
+            accessor, _ = _REPOS[repo]
+            dao = getattr(self.storage, accessor)()
+            payload = request.json() or {}
+            args = [wire.decode(a) for a in payload.get("args", [])]
+            kwargs = {k: wire.decode(v) for k, v in payload.get("kwargs", {}).items()}
+            try:
+                result = getattr(dao, method)(*args, **kwargs)
+            except (EventValidationError, ValueError, KeyError, TypeError) as e:
+                return Response.json(
+                    {"error": type(e).__name__, "message": str(e)}, status=400
+                )
+            except Exception as e:  # backend failure: 500 with the class
+                logger.exception("storage rpc %s.%s failed", repo, method)
+                return Response.json(
+                    {"error": type(e).__name__, "message": str(e)}, status=500
+                )
+            return Response.json({"result": wire.encode(result)})
+
+        return router
+
+    def start(self, background: bool = True) -> int:
+        port = self.app.start(background=background)
+        logger.info("Storage Server listening on %s:%d", self.host, port)
+        return port
+
+    def stop(self) -> None:
+        self.app.stop()

@@ -232,3 +232,209 @@ class TestAdvisorRegressions:
         assert models.delete("m") is False  # advisory bool from the probe
         assert fake.deletes == ["pio_model_m.bin"]  # but the delete ran
         assert models.get("m") is None
+
+
+class TestSpliceImport:
+    """Import splice-through fast path for jsonl (cli/commands.py):
+    validated lines append verbatim; edge lines take the parse path."""
+
+    def _run_import(self, tmp_path, lines):
+        import predictionio_tpu.cli.commands as commands
+        from predictionio_tpu.data.storage import App, Storage
+
+        s = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+                "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+                "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "events"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+            }
+        )
+        s.get_metadata_apps().insert(App(0, "Imp"))
+        f = tmp_path / "in.jsonl"
+        f.write_text("\n".join(lines) + "\n")
+        n = commands.import_events("Imp", str(f), storage=s)
+        return s, n
+
+    def test_mixed_fast_and_fallback_lines(self, tmp_path):
+        import json as _json
+
+        lines = [
+            # fast path: plain rate events
+            '{"event":"rate","entityType":"user","entityId":"u1",'
+            '"targetEntityType":"item","targetEntityId":"i1",'
+            '"properties":{"rating":3.0},"eventTime":"2020-01-01T00:00:00.000Z"}',
+            '{"event":"buy","entityType":"user","entityId":"u2",'
+            '"targetEntityType":"item","targetEntityId":"i2",'
+            '"eventTime":"2020-01-02T00:00:00.000Z"}',
+            # reserved event -> slow path (still valid)
+            '{"event":"$set","entityType":"user","entityId":"u3",'
+            '"properties":{"a":1},"eventTime":"2020-01-03T00:00:00.000Z"}',
+            # no eventTime -> slow path stamps receipt time
+            '{"event":"rate","entityType":"user","entityId":"u4",'
+            '"targetEntityType":"item","targetEntityId":"i4",'
+            '"properties":{"rating":1.0}}',
+            # explicit eventId preserved on the fast path
+            '{"event":"rate","entityType":"user","entityId":"u5",'
+            '"targetEntityType":"item","targetEntityId":"i5",'
+            '"properties":{"rating":2.0},"eventTime":"2020-01-05T00:00:00.000Z",'
+            '"eventId":"fixedid01"}',
+        ]
+        s, n = self._run_import(tmp_path, lines)
+        assert n == 5
+        events = s.get_events().find(1)
+        assert len(events) == 5
+        by_entity = {e.entity_id: e for e in events}
+        # every event got an id and creation time, and replays cleanly
+        for e in events:
+            assert e.event_id and e.creation_time is not None
+        assert by_entity["u5"].event_id == "fixedid01"
+        assert by_entity["u1"].properties["rating"] == 3.0
+        assert by_entity["u3"].event == "$set"
+        # the log file contains valid JSON lines only
+        log = tmp_path / "events" / "events_1.jsonl"
+        for line in log.read_text().splitlines():
+            _json.loads(line)
+
+    def test_invalid_lines_rejected_like_slow_path(self, tmp_path):
+        from predictionio_tpu.data.event import EventValidationError
+
+        lines = [
+            # pio_ entityType is illegal -> must reach the validator
+            '{"event":"rate","entityType":"pio_user","entityId":"u1",'
+            '"eventTime":"2020-01-01T00:00:00.000Z"}',
+        ]
+        with pytest.raises(EventValidationError):
+            self._run_import(tmp_path, lines)
+
+    def test_pio_property_goes_to_validator(self, tmp_path):
+        from predictionio_tpu.data.event import EventValidationError
+
+        lines = [
+            '{"event":"rate","entityType":"user","entityId":"u1",'
+            '"properties":{"pio_x":1},"eventTime":"2020-01-01T00:00:00.000Z"}',
+        ]
+        with pytest.raises(EventValidationError):
+            self._run_import(tmp_path, lines)
+
+    def test_scan_ratings_after_splice_import(self, tmp_path):
+        lines = [
+            '{"event":"rate","entityType":"user","entityId":"u%d",'
+            '"targetEntityType":"item","targetEntityId":"i%d",'
+            '"properties":{"rating":%d.0},"eventTime":"2020-01-01T00:00:00.000Z"}'
+            % (i, i % 3, i % 5 + 1)
+            for i in range(50)
+        ]
+        s, n = self._run_import(tmp_path, lines)
+        assert n == 50
+        b = s.get_events().scan_ratings(1, event_names=["rate"])
+        assert len(b) == 50
+        assert sorted(b.entity_ids) == sorted({f"u{i}" for i in range(50)})
+
+    def test_malformed_event_time_rejected_not_spliced(self, tmp_path):
+        """A bad eventTime must fail at import (as the slow path does),
+        never be appended verbatim to poison the log."""
+        from predictionio_tpu.data.event import EventValidationError
+
+        lines = [
+            '{"event":"rate","entityType":"user","entityId":"u1",'
+            '"targetEntityType":"item","targetEntityId":"i1",'
+            '"eventTime":"NOT-A-DATE"}',
+        ]
+        with pytest.raises((EventValidationError, ValueError)):
+            self._run_import(tmp_path, lines)
+
+    def test_escaped_reserved_property_key_caught(self, tmp_path):
+        """A JSON-escaped reserved key (\\u0070io_x == pio_x) must reach
+        the validator, not slip through the raw-byte screen."""
+        from predictionio_tpu.data.event import EventValidationError
+
+        lines = [
+            '{"event":"rate","entityType":"user","entityId":"u1",'
+            '"properties":{"\\u0070io_x":1},'
+            '"eventTime":"2020-01-01T00:00:00.000Z"}',
+        ]
+        with pytest.raises(EventValidationError):
+            self._run_import(tmp_path, lines)
+
+    def test_delete_marker_injection_blocked(self, tmp_path):
+        """A wire line with a top-level "$delete" key must NOT be spliced
+        verbatim (it would act as a jsonl delete marker and erase an
+        attacker-chosen existing event on replay)."""
+        # seed a victim event through the normal path
+        import predictionio_tpu.cli.commands as commands
+        from predictionio_tpu.data.storage import App, Storage
+
+        s = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+                "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+                "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "events"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+            }
+        )
+        s.get_metadata_apps().insert(App(0, "Victim"))
+        victim_id = s.get_events().insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": 3.0}), 1)
+        evil = (
+            '{"event":"view","entityType":"user","entityId":"u9",'
+            '"targetEntityType":"item","targetEntityId":"i9",'
+            '"eventTime":"2020-01-01T00:00:00.000Z",'
+            '"$delete":"%s"}' % victim_id
+        )
+        f = tmp_path / "evil.jsonl"
+        f.write_text(evil + "\n")
+        n = commands.import_events("Victim", str(f), storage=s)
+        assert n == 1
+        events = s.get_events().find(1)
+        # the victim survives and the imported event exists (sans the
+        # unknown key, dropped by the slow path)
+        assert {e.entity_id for e in events} == {"u1", "u9"}
+        assert s.get_events().get(victim_id, 1) is not None
+
+    def test_dollar_delete_value_does_not_force_recompaction(self, tmp_path):
+        """A property VALUE containing "$delete" must not make every
+        scan_ratings call rewrite the whole log."""
+        client = JSONLStorageClient({"path": str(tmp_path)})
+        events = JSONLEvents(client)
+        events.init(2)
+        events.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": 3.0, "note": "$delete me"}), 2)
+        log = client.base_path / "events_2.jsonl"
+        mtime_before = log.stat().st_mtime_ns
+        b = events.scan_ratings(2, event_names=["rate"])
+        assert len(b) == 1
+        assert log.stat().st_mtime_ns == mtime_before  # no rewrite
+
+    def test_sqlite_boolean_rating_matches_other_backends(self, tmp_path):
+        """JSON boolean ratings must be rejected (event-name default wins)
+        on sqlite exactly as on the base/jsonl paths."""
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage import base as storage_base
+
+        s = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+            }
+        )
+        ev = s.get_events()
+        ev.init(1)
+        ev.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": True}), 1)
+        kwargs = dict(event_names=["rate"], default_ratings={"rate": 9.0})
+        fast = ev.scan_ratings(1, **kwargs)
+        slow = storage_base.Events.scan_ratings(ev, 1, **kwargs)
+        assert list(fast.vals) == list(slow.vals) == [9.0]

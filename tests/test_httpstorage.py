@@ -1,0 +1,356 @@
+"""Client-server storage backend: wire codec, DAO parity over HTTP, and
+the quickstart lifecycle with separate OS processes sharing state ONLY
+through the storage service (the reference's JDBC-Postgres deployment
+topology, storage/jdbc/.../JDBCLEvents.scala:37)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event, EventValidationError
+from predictionio_tpu.data.storage import (
+    App,
+    Channel,
+    EngineInstance,
+    Model,
+    Storage,
+    test_storage as make_test_storage,
+)
+from predictionio_tpu.data.storage import wire
+from predictionio_tpu.server.storage_server import StorageServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+T0 = datetime(2021, 5, 1, 12, 30, tzinfo=timezone.utc)
+
+
+class TestWireCodec:
+    def test_scalars_and_containers(self):
+        for v in (None, True, 3, 2.5, "x", [1, "a"], {"k": [1, 2]}):
+            assert wire.decode(wire.encode(v)) == v
+        assert wire.decode(wire.encode((1, 2))) == (1, 2)
+        assert wire.decode(wire.encode({1, 2})) == {1, 2}
+
+    def test_special_types(self):
+        assert wire.decode(wire.encode(...)) is ...
+        assert wire.decode(wire.encode(b"\x00\xff")) == b"\x00\xff"
+        assert wire.decode(wire.encode(T0)) == T0
+        arr = np.arange(6, dtype=np.int32).reshape(2, 3)
+        out = wire.decode(wire.encode(arr))
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == arr.dtype
+
+    def test_event_roundtrip(self):
+        e = Event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            properties={"rating": 4.5, "tags": ["a"]},
+            event_time=T0, event_id="e1",
+        )
+        out = wire.decode(wire.encode(e))
+        assert out.entity_id == "u1" and out.properties["rating"] == 4.5
+        assert out.event_time == T0
+
+    def test_reserved_key_dict_escaped(self):
+        d = {"__dt__": "not a date", "x": 1}
+        assert wire.decode(wire.encode(d)) == d
+
+    def test_unknown_dataclass_rejected(self):
+        with pytest.raises(ValueError, match="unknown wire dataclass"):
+            wire.decode({"__dc__": "Exploit", "f": {}})
+
+
+@pytest.fixture()
+def remote_storage():
+    """An http-backend Storage talking to an in-process StorageServer
+    wrapping a memory store."""
+    backing = make_test_storage()
+    server = StorageServer(storage=backing, host="127.0.0.1", port=0,
+                           auth_key="sekret")
+    port = server.start(background=True)
+    remote = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_REMOTE_TYPE": "http",
+            "PIO_STORAGE_SOURCES_REMOTE_URL": f"http://127.0.0.1:{port}",
+            "PIO_STORAGE_SOURCES_REMOTE_AUTH_KEY": "sekret",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "REMOTE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "REMOTE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "REMOTE",
+        }
+    )
+    yield remote, backing, port
+    server.stop()
+
+
+class TestRemoteDAOs:
+    def test_metadata_roundtrip(self, remote_storage):
+        remote, backing, _ = remote_storage
+        apps = remote.get_metadata_apps()
+        app_id = apps.insert(App(0, "RemoteApp", "over http"))
+        assert backing.get_metadata_apps().get(app_id).name == "RemoteApp"
+        assert apps.get_by_name("RemoteApp").description == "over http"
+        chans = remote.get_metadata_channels()
+        ch_id = chans.insert(Channel(0, "live", app_id))
+        assert [c.name for c in chans.get_by_appid(app_id)] == ["live"]
+        assert chans.delete(ch_id)
+
+    def test_events_roundtrip_and_validation(self, remote_storage):
+        remote, _, _ = remote_storage
+        events = remote.get_events()
+        events.init(3)
+        eid = events.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": 5.0}, event_time=T0),
+            3,
+        )
+        got = events.get(eid, 3)
+        assert got.properties["rating"] == 5.0 and got.event_time == T0
+        found = events.find(3, event_names=["rate"], target_entity_type="item")
+        assert len(found) == 1
+        assert events.delete(eid, 3) and events.get(eid, 3) is None
+
+    def test_scan_ratings_ships_arrays(self, remote_storage):
+        remote, _, _ = remote_storage
+        events = remote.get_events()
+        events.init(4)
+        events.batch_insert(
+            [
+                Event(event="rate", entity_type="user", entity_id=f"u{i % 3}",
+                      target_entity_type="item", target_entity_id=f"i{i % 2}",
+                      properties={"rating": float(i % 5 + 1)})
+                for i in range(20)
+            ],
+            4,
+        )
+        b = remote.get_events().scan_ratings(4, event_names=["rate"])
+        assert len(b) == 20
+        assert isinstance(b.rows, np.ndarray) and b.rows.dtype == np.int32
+        assert sorted(b.entity_ids) == ["u0", "u1", "u2"]
+
+    def test_models_and_instances(self, remote_storage):
+        remote, _, _ = remote_storage
+        models = remote.get_model_data_models()
+        models.insert(Model("m1", b"\x01\x02weights"))
+        assert models.get("m1").models == b"\x01\x02weights"
+        insts = remote.get_metadata_engine_instances()
+        iid = insts.insert(
+            EngineInstance(
+                id="", status="INIT", start_time=T0, end_time=T0,
+                engine_id="e", engine_version="0", engine_variant="default",
+                engine_factory="f",
+            )
+        )
+        inst = insts.get(iid)
+        assert inst.status == "INIT" and inst.start_time == T0
+
+    def test_server_side_error_propagates_as_same_class(self, remote_storage):
+        remote, _, _ = remote_storage
+        events = remote.get_events()
+        events.init(9)
+        # aggregate_properties without entity_type raises ValueError
+        # server-side; the client re-raises the same exception class
+        with pytest.raises(ValueError, match="entity_type"):
+            events.aggregate_properties(9)
+
+    def test_dunder_methods_blocked(self, remote_storage):
+        remote, _, _ = remote_storage
+        from predictionio_tpu.data.storage.httpstorage import HTTPStorageError
+
+        client = remote.get_events()._client
+        with pytest.raises(HTTPStorageError):
+            client.call("events", "__class__", (), {})
+
+    def test_auth_required(self, remote_storage):
+        _, _, port = remote_storage
+        bad = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_R_TYPE": "http",
+                "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{port}",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+            }
+        )
+        from predictionio_tpu.data.storage.httpstorage import HTTPStorageError
+
+        with pytest.raises(HTTPStorageError, match="HTTP 401|invalid storage key"):
+            bad.get_metadata_apps().get_by_name("x")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def pio(args, env, timeout=180, check=True):
+    proc = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", *args],
+        capture_output=True, text=True, env=env, timeout=timeout, cwd=REPO,
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"pio {' '.join(args)} failed rc={proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+        )
+    return proc
+
+
+class TestMultiProcessQuickstart:
+    def test_quickstart_via_storage_service(self, tmp_path):
+        """The quickstart lifecycle with event server, trainer, and engine
+        server as separate OS processes that share NO filesystem — every
+        repository rides the storage service on localhost."""
+        sport = free_port()
+        # the storage service owns the only on-disk state
+        server_env = dict(os.environ)
+        server_env.update(
+            PIO_FS_BASEDIR=str(tmp_path / "server_store"),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+        )
+        storage_proc = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli.main",
+             "storageserver", "--ip", "127.0.0.1", "--port", str(sport)],
+            env=server_env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        # client processes: NO basedir of their own; repositories -> http
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+            PIO_STORAGE_SOURCES_REMOTE_TYPE="http",
+            PIO_STORAGE_SOURCES_REMOTE_URL=f"http://127.0.0.1:{sport}",
+            PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="REMOTE",
+            PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="REMOTE",
+            PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="REMOTE",
+            # a basedir that must stay empty proves nothing bypasses http
+            PIO_FS_BASEDIR=str(tmp_path / "client_store_must_stay_empty"),
+        )
+        engine_server = None
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{sport}/", timeout=2
+                    ) as resp:
+                        if resp.status == 200:
+                            break
+                except Exception:
+                    time.sleep(0.2)
+            else:
+                raise AssertionError("storage service never came up")
+
+            out = pio(["app", "new", "HttpApp"], env).stdout
+            access_key = [
+                line.split(":", 1)[1].strip()
+                for line in out.splitlines()
+                if line.startswith("Access Key:")
+            ][0]
+
+            # event server process ingests over HTTP -> storage service
+            eport = free_port()
+            es = subprocess.Popen(
+                [sys.executable, "-m", "predictionio_tpu.cli.main",
+                 "eventserver", "--ip", "127.0.0.1", "--port", str(eport)],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            try:
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    try:
+                        with urllib.request.urlopen(
+                            f"http://127.0.0.1:{eport}/", timeout=2
+                        ) as resp:
+                            break
+                    except Exception:
+                        time.sleep(0.2)
+                for u in range(8):
+                    for i in range(5):
+                        body = json.dumps({
+                            "event": "rate", "entityType": "user",
+                            "entityId": f"u{u}", "targetEntityType": "item",
+                            "targetEntityId": f"i{(u + i) % 6}",
+                            "properties": {"rating": float((u * i) % 5 + 1)},
+                        }).encode()
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{eport}/events.json"
+                            f"?accessKey={access_key}",
+                            data=body,
+                            headers={"Content-Type": "application/json"},
+                        )
+                        with urllib.request.urlopen(req, timeout=10) as resp:
+                            assert resp.status == 201
+            finally:
+                es.terminate()
+                es.wait(timeout=15)
+
+            # train in a third process; models land in the service
+            variant = {
+                "id": "http-quick",
+                "engineFactory":
+                    "predictionio_tpu.models.recommendation.engine",
+                "datasource": {"params": {"app_name": "HttpApp"}},
+                "algorithms": [
+                    {"name": "als", "params": {"rank": 4, "num_iterations": 3}}
+                ],
+            }
+            vf = tmp_path / "engine.json"
+            vf.write_text(json.dumps(variant))
+            out = pio(["train", "--variant", str(vf)], env).stdout
+            assert "Training completed" in out
+
+            # deploy in a fourth process; model loads from the service
+            qport = free_port()
+            engine_server = subprocess.Popen(
+                [sys.executable, "-m", "predictionio_tpu.cli.main",
+                 "deploy", "--variant", str(vf),
+                 "--ip", "127.0.0.1", "--port", str(qport)],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if engine_server.poll() is not None:
+                    raise AssertionError(
+                        "deploy exited early: "
+                        + engine_server.stderr.read().decode()
+                    )
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{qport}/", timeout=2
+                    ) as resp:
+                        if resp.status == 200:
+                            break
+                except Exception:
+                    time.sleep(0.5)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{qport}/queries.json",
+                data=json.dumps({"user": "u1", "num": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.loads(resp.read())
+            assert len(body["itemScores"]) == 3
+
+            # no client process ever touched local storage
+            client_dir = tmp_path / "client_store_must_stay_empty"
+            assert not client_dir.exists() or not any(client_dir.iterdir())
+        finally:
+            if engine_server is not None and engine_server.poll() is None:
+                engine_server.kill()
+            storage_proc.terminate()
+            storage_proc.wait(timeout=15)

@@ -381,7 +381,7 @@ class TestECommerce:
             )
         )
         model = algo.train(CTX, td)
-        seen = {i for u, i in td.view_events if u == "u0"}
+        seen = {i for u, i in td.view_events.iter_pairs() if u == "u0"}
         result = algo.predict(model, ecom.Query(user="u0", num=10))
         assert seen.isdisjoint({s.item for s in result.itemScores})
 
