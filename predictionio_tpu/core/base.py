@@ -117,6 +117,22 @@ class Algorithm(Component, Generic[PD, M, Q, P], abc.ABC):
         """
         return [(ix, self.predict(model, q)) for ix, q in queries]
 
+    def train_sweep(
+        self, ctx: WorkflowContext, prepared_data: PD, params_list: Sequence[Any]
+    ) -> "list[M] | None":
+        """Train MANY param variants of this algorithm at once, or None.
+
+        The evaluation-sweep vectorization hook (SURVEY §7): sweeps call
+        this with every candidate's params for one algorithm slot; an
+        implementation that can stack the trainings (vmap over a
+        candidate axis — see ops.als.als_train_sweep) returns one model
+        per candidate in order. Returning None (the default) tells the
+        sweep to fall back to one ``train`` call per candidate. The
+        reference has no analog — candidates run serially on one
+        SparkContext (BaseEngine.batchEval).
+        """
+        return None
+
     # -- model persistence hooks (reference makePersistentModel) ----------
     def make_persistent_model(self, model: M) -> Any:
         """Return the object to persist for this model. Returning the model

@@ -39,9 +39,11 @@ class FastEvalEngineWorkflow:
         self.ctx = ctx
         self.datasource_cache: dict[str, Any] = {}
         self.preparator_cache: dict[str, Any] = {}
+        self.models_cache: dict[str, Any] = {}
         self.algorithms_cache: dict[str, Any] = {}
         self.hits = {"datasource": 0, "preparator": 0, "algorithms": 0}
         self.misses = {"datasource": 0, "preparator": 0, "algorithms": 0}
+        self.swept_candidates = 0  # candidates trained via vmapped sweeps
 
     def _eval_sets(self, ep: EngineParams):
         key = _key(ep.datasource)
@@ -67,16 +69,76 @@ class FastEvalEngineWorkflow:
             self.hits["preparator"] += 1
         return key, self.preparator_cache[key]
 
+    def _models(self, ep: EngineParams, prep_key: str, prepared_sets):
+        """Per eval set: the trained model per algorithm. A separate cache
+        stage from predictions so ``prewarm_sweeps`` can fill it with
+        vmapped batch trainings before candidates are walked serially."""
+        key = prep_key + "|" + _key(*ep.algorithms)
+        if key not in self.models_cache:
+            self.models_cache[key] = [
+                [
+                    a.train(self.ctx, pd)
+                    for a in self.engine.make_algorithms(ep)
+                ]
+                for pd, _info, _qa in prepared_sets
+            ]
+        return self.models_cache[key]
+
+    def prewarm_sweeps(self, engine_params_list: Sequence[EngineParams]) -> None:
+        """Vectorize candidate trainings where the algorithm supports it.
+
+        Groups candidates sharing the datasource+preparator prefix and a
+        single-algorithm slot of the same component name, then offers the
+        whole group's params to ``Algorithm.train_sweep`` (the vmap hook
+        — see ops.als.als_train_sweep). Supported groups land in the
+        models cache in one device program; unsupported ones fall back to
+        serial ``train`` calls with identical results. The reference has
+        no analog: batchEval runs candidates serially
+        (core/.../core/BaseEngine.scala:61-70).
+        """
+        groups: dict[tuple[str, str], list[EngineParams]] = {}
+        for ep in engine_params_list:
+            if len(ep.algorithms) != 1:
+                continue
+            prefix = _key(ep.datasource) + "|" + _key(ep.preparator)
+            groups.setdefault((prefix, ep.algorithms[0][0]), []).append(ep)
+        for (_prefix, _name), eps in groups.items():
+            # distinct algorithm params only; singletons gain nothing
+            seen: dict[str, EngineParams] = {}
+            for ep in eps:
+                seen.setdefault(_key(*ep.algorithms), ep)
+            distinct = list(seen.values())
+            if len(distinct) < 2:
+                continue
+            prep_key, prepared_sets = self._prepared(distinct[0])
+            algo = self.engine.make_algorithms(distinct[0])[0]
+            params_list = [ep.algorithms[0][1] for ep in distinct]
+            per_set_models = []
+            for pd, _info, _qa in prepared_sets:
+                models = algo.train_sweep(self.ctx, pd, params_list)
+                if models is None:
+                    per_set_models = None
+                    break
+                per_set_models.append(models)
+            if per_set_models is None:
+                continue
+            for ci, ep in enumerate(distinct):
+                key = prep_key + "|" + _key(*ep.algorithms)
+                self.models_cache[key] = [
+                    [set_models[ci]] for set_models in per_set_models
+                ]
+            self.swept_candidates += len(distinct)
+
     def _predictions(self, ep: EngineParams):
         """Per eval set: list over algorithms of {query_ix: prediction}."""
         prep_key, prepared_sets = self._prepared(ep)
         key = prep_key + "|" + _key(*ep.algorithms)
         if key not in self.algorithms_cache:
             self.misses["algorithms"] += 1
+            algorithms = self.engine.make_algorithms(ep)
+            per_set_models = self._models(ep, prep_key, prepared_sets)
             per_set = []
-            for pd, info, qa in prepared_sets:
-                algorithms = self.engine.make_algorithms(ep)
-                models = [a.train(self.ctx, pd) for a in algorithms]
+            for (pd, info, qa), models in zip(prepared_sets, per_set_models):
                 indexed = list(enumerate(q for q, _ in qa))
                 per_algo = [
                     dict(a.batch_predict(m, indexed))
@@ -112,8 +174,12 @@ class FastEvalEngine(Engine):
         workflow_params: WorkflowParams | None = None,
     ):
         workflow = FastEvalEngineWorkflow(self, ctx)
+        workflow.prewarm_sweeps(engine_params_list)
         out = [(ep, workflow.eval(ep)) for ep in engine_params_list]
         logger.info(
-            "FastEvalEngine cache hits=%s misses=%s", workflow.hits, workflow.misses
+            "FastEvalEngine cache hits=%s misses=%s swept=%d",
+            workflow.hits,
+            workflow.misses,
+            workflow.swept_candidates,
         )
         return out

@@ -368,6 +368,7 @@ class Events(abc.ABC):
         target_entity_type: str | None = None,
         rating_key: "str | None" = "rating",
         default_ratings: "dict[str, float] | None" = None,
+        override_ratings: "dict[str, float] | None" = None,
     ) -> "RatingsBatch":
         """Columnar bulk read for (entity -> target, value) training data.
 
@@ -379,9 +380,11 @@ class Events(abc.ABC):
         sqlite: SQL projection + json1 extraction); this default walks
         ``find`` and is the correctness fallback for small stores.
 
-        ``default_ratings`` maps event names to implicit values (the
-        quickstart's "buy" -> 4.0 rule); an explicit numeric
-        ``rating_key`` property wins. ``rating_key=None`` skips property
+        ``default_ratings`` maps event names to implicit values used when
+        the ``rating_key`` property is absent; ``override_ratings`` maps
+        event names to FORCED values that beat any property (the
+        reference's ``case "buy" => 4.0`` ignores properties for buy
+        events — DataSource.scala:55). ``rating_key=None`` skips property
         extraction entirely — pure implicit feedback, every matching
         event takes its event-name default (view-count style reads).
         """
@@ -401,13 +404,15 @@ class Events(abc.ABC):
         ):
             if e.target_entity_id is None:
                 continue
-            v = (
-                e.properties.to_dict().get(rating_key)
-                if rating_key is not None
-                else None
-            )
-            if not isinstance(v, (int, float)) or isinstance(v, bool):
-                v = (default_ratings or {}).get(e.event)
+            v = (override_ratings or {}).get(e.event)
+            if v is None:
+                v = (
+                    e.properties.to_dict().get(rating_key)
+                    if rating_key is not None
+                    else None
+                )
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    v = (default_ratings or {}).get(e.event)
             if v is None:
                 continue
             rows.append(user_map.setdefault(e.entity_id, len(user_map)))

@@ -40,6 +40,11 @@ class JSONLStorageClient:
         ).expanduser()
         self.base_path.mkdir(parents=True, exist_ok=True)
         self.lock = threading.RLock()
+        # (mtime_ns, size) of logs last proven replay-clean (no delete
+        # markers / duplicate ids): lets scan_ratings skip the uniqueness
+        # pass — and, in degraded no-native mode, avoid re-compacting —
+        # until the file changes
+        self.clean_stat: dict[Path, tuple[int, int]] = {}
 
 
 class JSONLEvents(base.Events):
@@ -215,6 +220,7 @@ class JSONLEvents(base.Events):
         target_entity_type: str | None = None,
         rating_key: str | None = "rating",
         default_ratings: dict[str, float] | None = None,
+        override_ratings: dict[str, float] | None = None,
     ) -> base.RatingsBatch:
         """Columnar fast path: native byte scan of the raw log — no Python
         Event objects (the HBase-analog bulk training read; reference
@@ -223,35 +229,60 @@ class JSONLEvents(base.Events):
         Log semantics (last-write-wins per event id, ``$delete`` records)
         are restored by compacting first when the log isn't already
         append-only-unique; the common import->train flow appends unique
-        inserts only, so the precondition is one cheap byte/span pass.
+        inserts only, so the precondition is one cheap byte/span pass
+        (reused for the ratings extraction — single scan when no
+        compaction is needed).
         """
         from predictionio_tpu import native
 
         # one lock acquisition across check + compact + re-read: releasing
         # between them would let a concurrent writer append a replacement
         # the re-read then double-counts
+        def _stat(path: Path) -> tuple[int, int]:
+            st = path.stat()
+            return (st.st_mtime_ns, st.st_size)
+
         with self._locked(app_id, channel_id) as path:
             buf = path.read_bytes() if path.exists() else b""
-            # delete MARKERS are whole records '{"$delete": ...}' — anchor
-            # the probe at line starts so a property VALUE containing the
-            # string "$delete" (which survives rewriting) can't trigger a
-            # full-log compaction on every training read
-            needs_compact = buf.startswith(b'{"$delete"') or (
-                b'\n{"$delete"' in buf
-            )
-            if not needs_compact and buf:
-                scanned = native.scan_events(buf)
-                ids = scanned.offs[:, native.F_EVENT_ID]
-                idx, uniq = native.index_spans(
-                    scanned.buf, ids, scanned.lens[:, native.F_EVENT_ID]
+            scanned = None
+            if buf and self._c.clean_stat.get(path) == _stat(path):
+                needs_compact = False  # unchanged since last proven clean
+            else:
+                # delete MARKERS are whole records '{"$delete": ...}' —
+                # anchor the probe at line starts so a property VALUE
+                # containing "$delete" (which survives rewriting) can't
+                # trigger a full-log compaction on every training read
+                needs_compact = buf.startswith(b'{"$delete"') or (
+                    b'\n{"$delete"' in buf
                 )
-                n_with_id = int((ids >= 0).sum())
-                needs_compact = len(uniq) < n_with_id
+                if not needs_compact and buf:
+                    scanned = native.scan_events(buf)
+                    ids = scanned.offs[:, native.F_EVENT_ID]
+                    idx, uniq = native.index_spans(
+                        scanned.buf, ids, scanned.lens[:, native.F_EVENT_ID]
+                    )
+                    n_with_id = int((ids >= 0).sum())
+                    n_lines = int(
+                        (scanned.flags & native.FLAG_EMPTY == 0).sum()
+                    )
+                    # uniqueness is only provable for lines whose event-id
+                    # span was scanned; any unscannable line (degraded
+                    # pure-Python mode flags ALL lines, escaped ids flag a
+                    # few) could hide a replacement -> compact
+                    needs_compact = (
+                        len(uniq) < n_with_id or n_with_id < n_lines
+                    )
             if needs_compact:
                 # compact inline: the flock is not reentrant, so reuse the
                 # under-lock body rather than calling compact()
                 self._compact_locked(app_id, channel_id, path)
                 buf = path.read_bytes()
+                scanned = None  # buf changed; rescan below
+            if buf:
+                # post-compact (or just-proven-clean) logs stay clean
+                # until the file changes; record the stat so the next
+                # read skips the uniqueness pass / re-compaction
+                self._c.clean_stat[path] = _stat(path)
         users, items, rows, cols, vals = native.load_ratings_jsonl(
             buf,
             event_names=list(event_names) if event_names is not None else None,
@@ -259,6 +290,8 @@ class JSONLEvents(base.Events):
             default_ratings=default_ratings,
             entity_type=entity_type,
             target_entity_type=target_entity_type,
+            override_ratings=override_ratings,
+            scanned=scanned,
         )
         return base.RatingsBatch(
             entity_ids=users, target_ids=items, rows=rows, cols=cols, vals=vals

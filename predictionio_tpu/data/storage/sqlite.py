@@ -624,6 +624,7 @@ class SQLiteEvents(base.Events):
         target_entity_type: str | None = None,
         rating_key: str | None = "rating",
         default_ratings: dict[str, float] | None = None,
+        override_ratings: dict[str, float] | None = None,
     ) -> base.RatingsBatch:
         """Columnar fast path: a 4-column SQL projection with json1
         extracting the rating — the DB does the filtering and property
@@ -645,11 +646,7 @@ class SQLiteEvents(base.Events):
         if event_names is not None:
             event_names = list(event_names)
             if not event_names:
-                return base.RatingsBatch(
-                    [], [],
-                    np.empty(0, np.int32), np.empty(0, np.int32),
-                    np.empty(0, np.float32),
-                )
+                return base.RatingsBatch.empty()
             clauses.append("event IN (" + ",".join("?" * len(event_names)) + ")")
             params.extend(event_names)
         if rating_key is None:
@@ -673,6 +670,7 @@ class SQLiteEvents(base.Events):
         cols: list[int] = []
         vals: list[float] = []
         defaults = default_ratings or {}
+        forced = override_ratings or {}
         with self._c.lock:
             try:
                 cur = self._c.conn.execute(sql, params)
@@ -686,7 +684,10 @@ class SQLiteEvents(base.Events):
                 if not batch:
                     break
                 for u, it, ev, v in batch:
-                    if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    fv = forced.get(ev)
+                    if fv is not None:
+                        v = fv
+                    elif not isinstance(v, (int, float)) or isinstance(v, bool):
                         v = defaults.get(ev)
                         if v is None:
                             continue

@@ -117,6 +117,7 @@ def find_ratings(
     target_entity_type: str | None = None,
     rating_key: str | None = "rating",
     default_ratings: dict[str, float] | None = None,
+    override_ratings: dict[str, float] | None = None,
     storage: Storage | None = None,
 ):
     """Columnar bulk training read: dense-indexed (rows, cols, vals)
@@ -136,6 +137,7 @@ def find_ratings(
         target_entity_type=target_entity_type,
         rating_key=rating_key,
         default_ratings=default_ratings,
+        override_ratings=override_ratings,
     )
 
 

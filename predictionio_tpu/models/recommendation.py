@@ -101,13 +101,16 @@ class RecommendationDataSource(DataSource):
     params_class = DataSourceParams
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        # buy is FORCED to buy_rating, beating any rating property — the
+        # reference ignores properties for buy events (DataSource.scala:55
+        # `case "buy" => 4.0`)
         batch = store.find_ratings(
             app_name=self.params.app_name,
             entity_type="user",
             event_names=list(self.params.event_names),
             target_entity_type="item",
             rating_key="rating",
-            default_ratings={"buy": self.params.buy_rating},
+            override_ratings={"buy": self.params.buy_rating},
         )
         return TrainingData(
             user_ids=batch.entity_ids,
@@ -127,11 +130,18 @@ class RecommendationDataSource(DataSource):
         idx = np.arange(n)
         for fold in range(k):
             mask = idx % k == fold
+            # compact the train fold's id space to entities that actually
+            # appear in it: a user whose only ratings fell in the test
+            # fold must be ABSENT from the model (unseen-user -> empty
+            # prediction), not scored from untrained random-init factors
+            rows_tr, cols_tr = td.rows[~mask], td.cols[~mask]
+            used_u = np.unique(rows_tr)
+            used_i = np.unique(cols_tr)
             train = TrainingData(
-                user_ids=td.user_ids,
-                item_ids=td.item_ids,
-                rows=td.rows[~mask],
-                cols=td.cols[~mask],
+                user_ids=[td.user_ids[u] for u in used_u],
+                item_ids=[td.item_ids[i] for i in used_i],
+                rows=np.searchsorted(used_u, rows_tr).astype(np.int32),
+                cols=np.searchsorted(used_i, cols_tr).astype(np.int32),
                 ratings=td.ratings[~mask],
             )
             qa = [
@@ -264,6 +274,62 @@ class ALSAlgorithm(Algorithm):
             user_factors=np.asarray(U),
             item_factors=np.asarray(V),
         )
+
+    def train_sweep(
+        self, ctx: WorkflowContext, td: TrainingData, params_list
+    ) -> list[ALSModel] | None:
+        """Stacked candidate trainings for evaluation sweeps: ONE bucket
+        layout build and ONE vmapped device program train every
+        reg/seed candidate (ops.als.als_train_sweep). Falls back (None)
+        when candidates differ in program shape (rank, iterations,
+        dtype, bucket widths) or in non-ALS knobs."""
+        if len(td.ratings) == 0 or len(params_list) < 2:
+            return None
+        base = params_list[0]
+        for p in params_list:
+            if (
+                p.rank != base.rank
+                or p.num_iterations != base.num_iterations
+                or p.compute_dtype != base.compute_dtype
+                or tuple(p.bucket_widths) != tuple(base.bucket_widths)
+                or p.sharded_train
+            ):
+                return None
+        user_index = BiMap.from_dense(td.user_ids)
+        item_index = BiMap.from_dense(td.item_ids)
+        data = als_ops.build_ratings_data(
+            td.rows,
+            td.cols,
+            np.asarray(td.ratings, dtype=np.float32),
+            len(user_index),
+            len(item_index),
+            bucket_widths=tuple(base.bucket_widths),
+        )
+        candidates = [
+            als_ops.ALSParams(
+                rank=p.rank,
+                iterations=p.num_iterations,
+                reg=p.lambda_,
+                seed=p.seed,
+                compute_dtype=p.compute_dtype,
+            )
+            for p in params_list
+        ]
+        results = als_ops.als_train_sweep(data, candidates)
+        logger.info(
+            "ALS sweep: %d candidates trained in one vmapped program "
+            "(%d users x %d items, rank %d)",
+            len(candidates), len(user_index), len(item_index), base.rank,
+        )
+        return [
+            ALSModel(
+                user_index=user_index,
+                item_index=item_index,
+                user_factors=np.asarray(U),
+                item_factors=np.asarray(V),
+            )
+            for U, V in results
+        ]
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         from predictionio_tpu.ops.topk import top_k_items

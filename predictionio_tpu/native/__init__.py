@@ -332,6 +332,8 @@ def load_ratings_jsonl(
     default_ratings: dict[str, float] | None = None,
     entity_type: str | None = None,
     target_entity_type: str | None = None,
+    override_ratings: dict[str, float] | None = None,
+    scanned: "ScannedEvents | None" = None,
 ) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray]:
     """One call from a JSONL event buffer to ALS training arrays:
     (user_ids, item_ids, rows, cols, ratings) with dense indices — the
@@ -339,12 +341,16 @@ def load_ratings_jsonl(
     BiMap.stringInt, examples/scala-parallel-recommendation/
     custom-prepartor/src/main/scala/DataSource.scala:35-60).
 
-    ``default_ratings`` maps event names to implicit values (the "buy" ->
-    4.0 rule); explicit ``rating_key`` properties win.
-    ``entity_type``/``target_entity_type`` restrict lines the way the
-    template DataSources do (entityType="user", targetEntityType="item").
+    ``default_ratings`` maps event names to implicit values used when the
+    ``rating_key`` property is absent; ``override_ratings`` maps event
+    names to FORCED values that beat any property (the reference's
+    ``case "buy" => 4.0`` rule — DataSource.scala:55 ignores properties
+    for buy events). ``entity_type``/``target_entity_type`` restrict
+    lines the way the template DataSources do. Pass ``scanned`` to reuse
+    a prior :func:`scan_events` of the same ``data`` (single-pass reads).
     """
-    scanned = scan_events(data)
+    if scanned is None:
+        scanned = scan_events(data)
     n = len(scanned)
     keep = np.ones(n, dtype=bool)
     keep &= (scanned.flags == 0) & (scanned.offs[:, F_ENTITY_ID] >= 0) & (
@@ -385,6 +391,15 @@ def load_ratings_jsonl(
             ev_idx >= 0, defaults[np.clip(ev_idx, 0, None)], np.nan
         )
         ratings = np.where(np.isnan(ratings), line_default, ratings)
+    if override_ratings and len(ev_names):
+        forced = np.array(
+            [override_ratings.get(name, np.nan) for name in ev_names],
+            dtype=np.float64,
+        )
+        line_forced = np.where(
+            ev_idx >= 0, forced[np.clip(ev_idx, 0, None)], np.nan
+        )
+        ratings = np.where(np.isnan(line_forced), ratings, line_forced)
     keep &= ~np.isnan(ratings)
 
     kept = np.flatnonzero(keep)
@@ -426,9 +441,11 @@ def load_ratings_jsonl(
             u, it = d.get("entityId"), d.get("targetEntityId")
             if not u or not it:
                 continue
-            v = (d.get("properties") or {}).get(rating_key)
-            if not isinstance(v, (int, float)) or isinstance(v, bool):
-                v = (default_ratings or {}).get(d.get("event"))
+            v = (override_ratings or {}).get(d.get("event"))
+            if v is None:
+                v = (d.get("properties") or {}).get(rating_key)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    v = (default_ratings or {}).get(d.get("event"))
             if v is None:
                 continue
             rows.append(user_map.setdefault(u, len(user_map)))

@@ -396,6 +396,8 @@ def _solve_bucket_inline(
     params: ALSParams,
     seg_row=None,
     num_solved_rows: int | None = None,
+    reg=None,
+    alpha=None,
 ):
     """One bucket's solve, for use inside a larger jitted computation
     (same math as the standalone solve_bucket_* entry points).
@@ -404,14 +406,20 @@ def _solve_bucket_inline(
     with ``num_solved_rows`` distinct rows; per-segment Gramians/rhs are
     scatter-added into the solved rows before regularization, so hot rows
     train on ALL their ratings with bounded memory.
+
+    ``reg``/``alpha`` override the static ``params`` values with TRACED
+    scalars — the hook the vmapped parameter sweep (als_train_sweep) uses
+    to train many regularization candidates in one program.
     """
     col_ids, ratings, mask = bucket_arrays
+    reg = params.reg if reg is None else reg
+    alpha = params.alpha if alpha is None else alpha
     D = factors_other.shape[1]
     dt = jnp.dtype(params.compute_dtype)
     vg = factors_other[col_ids].astype(dt)
     if params.implicit:
-        conf_minus_1 = (params.alpha * ratings * mask).astype(dt)
-        rhs_w = ((1.0 + params.alpha * ratings) * mask).astype(dt)
+        conf_minus_1 = (alpha * ratings * mask).astype(dt)
+        rhs_w = ((1.0 + alpha * ratings) * mask).astype(dt)
         A, b = _gramian_rhs(vg, conf_minus_1, rhs_w)
         weighted = params.implicit_weighted_reg
     else:
@@ -425,7 +433,7 @@ def _solve_bucket_inline(
         A = jnp.zeros((R, D, D), A.dtype).at[seg_row].add(A)
         b = jnp.zeros((R, D), b.dtype).at[seg_row].add(b)
         n = jnp.zeros((R,), n.dtype).at[seg_row].add(n)
-    lam = params.reg * (n if weighted else jnp.ones_like(n))
+    lam = reg * (n if weighted else jnp.ones_like(n))
     lam = jnp.where(n > 0, lam, 1.0)
     A = A + lam[:, None, None] * jnp.eye(D, dtype=jnp.float32)
     if params.implicit:
@@ -505,6 +513,107 @@ def als_train(data: RatingsData, params: ALSParams):
         static_params,
         params.iterations,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("params",), donate_argnums=(0, 1))
+def _train_fused_sweep(
+    U0, V0, regs, alphas, row_arrays, col_arrays, params: ALSParams, iterations
+):
+    """C candidate trainings as ONE vmapped device program.
+
+    U0/V0: [C, rows, D] / [C, cols, D] per-candidate inits; regs/alphas:
+    [C] traced hyperparameters. The bucket tables are shared across the
+    batch (in_axes=None) — XLA sees one batched program whose matmuls
+    carry an extra candidate dimension, keeping the MXU fed where C
+    sequential small trainings would each underfill it.
+    """
+
+    def one(U, V, reg, alpha):
+        def half(target, other, bucket_arrays_list):
+            gram = (
+                compute_gram(other, params.compute_dtype)
+                if params.implicit
+                else None
+            )
+            for row_ids, col_ids, ratings, mask, seg_row in bucket_arrays_list:
+                x = _solve_bucket_inline(
+                    other,
+                    gram,
+                    (col_ids, ratings, mask),
+                    params,
+                    seg_row=seg_row,
+                    num_solved_rows=row_ids.shape[0],
+                    reg=reg,
+                    alpha=alpha,
+                )
+                target = target.at[row_ids].set(x)
+            return target
+
+        def step(_, carry):
+            U, V = carry
+            U = half(U, V, row_arrays)
+            V = half(V, U, col_arrays)
+            return (U, V)
+
+        return jax.lax.fori_loop(0, iterations, step, (U, V))
+
+    return jax.vmap(one, in_axes=(0, 0, 0, 0))(U0, V0, regs, alphas)
+
+
+def als_train_sweep(
+    data: RatingsData, params_list: Sequence[ALSParams]
+) -> list[tuple[jax.Array, jax.Array]]:
+    """Train every candidate in ``params_list`` in ONE device program.
+
+    The TPU answer to SURVEY §7's evaluation-sweep hard part: the
+    reference runs sweep candidates serially on one SparkContext; here
+    independent small trainings stack on the candidate axis (vmap), so a
+    lambda/seed sweep costs roughly one training's dispatch overhead.
+
+    Candidates must share the static program shape — rank, iterations,
+    bucket layout, compute dtype, implicit flag, and reg-weighting flags;
+    ``reg``, ``alpha``, and ``seed`` may vary per candidate (they ride as
+    traced inputs / stacked inits). Raises ValueError otherwise.
+
+    Returns a list of per-candidate (U, V), matching ``als_train`` for
+    the same params bit-for-bit in program structure (same bucket math;
+    tiny float differences can arise from batched-op scheduling).
+    """
+    if not params_list:
+        raise ValueError("params_list must not be empty")
+    base = params_list[0]
+    static_fields = (
+        "rank", "iterations", "implicit", "weighted_reg",
+        "implicit_weighted_reg", "compute_dtype", "bucket_widths",
+    )
+    for p in params_list[1:]:
+        diffs = [f for f in static_fields if getattr(p, f) != getattr(base, f)]
+        if diffs:
+            raise ValueError(
+                "als_train_sweep candidates must share the static program "
+                f"shape; differing fields: {diffs} (sweep reg/alpha/seed "
+                "instead, or run separate trainings)"
+            )
+    U0 = []
+    V0 = []
+    for p in params_list:
+        key_u, key_v = jax.random.split(jax.random.PRNGKey(p.seed))
+        U0.append(init_factors(data.num_rows, p.rank, key_u))
+        V0.append(init_factors(data.num_cols, p.rank, key_v))
+    regs = jnp.asarray([p.reg for p in params_list], jnp.float32)
+    alphas = jnp.asarray([p.alpha for p in params_list], jnp.float32)
+    static_params = dataclasses.replace(base, iterations=0, reg=0.0, alpha=0.0)
+    U, V = _train_fused_sweep(
+        jnp.stack(U0),
+        jnp.stack(V0),
+        regs,
+        alphas,
+        _device_bucket_arrays(data.row_buckets),
+        _device_bucket_arrays(data.col_buckets),
+        static_params,
+        base.iterations,
+    )
+    return [(U[c], V[c]) for c in range(len(params_list))]
 
 
 def als_train_stepwise(data: RatingsData, params: ALSParams):

@@ -261,3 +261,151 @@ class TestFastEval:
         plain_results = plain.batch_eval(CTX, candidates)
         for (ep_f, rf), (ep_p, rp) in zip(fast_results, plain_results):
             assert rf == rp
+
+
+class TestVectorizedSweep:
+    """vmapped candidate trainings inside sweeps (SURVEY §7 hard part:
+    stacking independent small trainings instead of serial runs)."""
+
+    def test_ops_sweep_matches_serial(self):
+        import numpy as np
+
+        from predictionio_tpu.ops import als
+
+        rng = np.random.default_rng(0)
+        rows = rng.integers(0, 40, 1200).astype(np.int32)
+        cols = rng.integers(0, 25, 1200).astype(np.int32)
+        vals = rng.integers(1, 6, 1200).astype(np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 40, 25,
+                                      bucket_widths=(16, 64))
+        cands = [
+            als.ALSParams(rank=4, iterations=3, reg=r, seed=s)
+            for r, s in [(0.01, 1), (0.2, 1), (0.5, 2)]
+        ]
+        for p, (U, V) in zip(cands, als.als_train_sweep(data, cands)):
+            Us, Vs = als.als_train(data, p)
+            np.testing.assert_allclose(
+                np.asarray(U), np.asarray(Us), rtol=1e-5, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(V), np.asarray(Vs), rtol=1e-5, atol=1e-5
+            )
+
+    def test_ops_sweep_implicit_alpha(self):
+        import numpy as np
+
+        from predictionio_tpu.ops import als
+
+        rng = np.random.default_rng(1)
+        rows = rng.integers(0, 30, 800).astype(np.int32)
+        cols = rng.integers(0, 20, 800).astype(np.int32)
+        vals = np.ones(800, np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 30, 20,
+                                      bucket_widths=(32,))
+        cands = [
+            als.ALSParams(rank=4, iterations=3, reg=0.05, implicit=True,
+                          alpha=a, seed=3)
+            for a in (0.5, 2.0)
+        ]
+        for p, (U, V) in zip(cands, als.als_train_sweep(data, cands)):
+            Us, Vs = als.als_train(data, p)
+            np.testing.assert_allclose(
+                np.asarray(U), np.asarray(Us), rtol=1e-5, atol=1e-5
+            )
+
+    def test_ops_sweep_rejects_shape_mismatch(self):
+        import numpy as np
+
+        from predictionio_tpu.ops import als
+
+        data = als.build_ratings_data(
+            np.asarray([0, 1], np.int32), np.asarray([0, 1], np.int32),
+            np.asarray([1.0, 2.0], np.float32), 2, 2,
+        )
+        with pytest.raises(ValueError, match="static program shape"):
+            als.als_train_sweep(
+                data,
+                [als.ALSParams(rank=4), als.ALSParams(rank=8)],
+            )
+        with pytest.raises(ValueError, match="must not be empty"):
+            als.als_train_sweep(data, [])
+
+    def test_fast_eval_sweep_path_matches_serial(self, storage):
+        """A lambda sweep through FastEvalEngine must produce the same
+        scores whether candidates train serially or via the vmapped
+        train_sweep hook, and the hook must actually engage."""
+        import numpy as np
+
+        from predictionio_tpu.core.engine import Engine as PlainEngine
+        from predictionio_tpu.data.storage import App
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.models.recommendation import (
+            ALSAlgorithm,
+            ALSAlgorithmParams,
+            DataSourceParams,
+            RecommendationDataSource,
+            RecommendationPreparator,
+        )
+        from predictionio_tpu.core.base import FirstServing
+        from predictionio_tpu.core.params import EngineParams
+        from predictionio_tpu.data.storage import set_storage
+
+        app_id = storage.get_metadata_apps().insert(App(0, "SweepApp"))
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(7)
+        events.batch_insert(
+            [
+                Event(event="rate", entity_type="user",
+                      entity_id=f"u{rng.integers(0, 25)}",
+                      target_entity_type="item",
+                      target_entity_id=f"i{rng.integers(0, 15)}",
+                      properties={"rating": float(rng.integers(1, 6))})
+                for _ in range(600)
+            ],
+            app_id,
+        )
+        set_storage(storage)
+        try:
+            def components():
+                return dict(
+                    datasource_classes=RecommendationDataSource,
+                    preparator_classes=RecommendationPreparator,
+                    algorithm_classes={"als": ALSAlgorithm},
+                    serving_classes=FirstServing,
+                )
+
+            candidates = [
+                EngineParams(
+                    datasource=("", DataSourceParams(app_name="SweepApp")),
+                    algorithms=[("als", ALSAlgorithmParams(
+                        rank=4, num_iterations=3, lambda_=lam, seed=5))],
+                )
+                for lam in (0.01, 0.1, 0.5)
+            ]
+            fast = FastEvalEngine(**components())
+            wf = FastEvalEngineWorkflow(fast, CTX)
+            wf.prewarm_sweeps(candidates)
+            assert wf.swept_candidates == 3  # the vmap hook engaged
+            fast_out = [(ep, wf.eval(ep)) for ep in candidates]
+            plain_out = PlainEngine(**components()).batch_eval(CTX, candidates)
+
+            def scores(outs):
+                all_scores = []
+                for _ep, sets in outs:
+                    se = 0.0
+                    n = 0
+                    for _info, served in sets:
+                        for q, p, a in served:
+                            if p.itemScores:
+                                se += (p.itemScores[0].score
+                                       - a["rating"]) ** 2
+                                n += 1
+                    all_scores.append(se / max(n, 1))
+                return all_scores
+
+            np.testing.assert_allclose(
+                scores(fast_out), scores(plain_out), rtol=1e-4
+            )
+        finally:
+            set_storage(None)

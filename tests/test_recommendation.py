@@ -206,3 +206,55 @@ class TestTrainPredict:
         r1 = algo.predict(model, rec.Query("u3", 3))
         r2 = algo.predict(restored, rec.Query("u3", 3))
         assert [s.item for s in r1.itemScores] == [s.item for s in r2.itemScores]
+
+
+class TestReviewRegressions:
+    def test_buy_rating_forced_over_property(self, seeded_app):
+        """buy events train at buy_rating even with a rating property
+        (reference DataSource.scala:55 ignores properties for buy)."""
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import set_storage
+        from predictionio_tpu.models.recommendation import (
+            DataSourceParams,
+            RecommendationDataSource,
+        )
+
+        storage = seeded_app
+        app_id = storage.get_metadata_apps().get_by_name("RecApp").id
+        storage.get_events().insert(
+            Event(event="buy", entity_type="user", entity_id="uX",
+                  target_entity_type="item", target_entity_id="i0",
+                  properties={"rating": 1.0}), app_id)
+        set_storage(storage)
+        try:
+            td = RecommendationDataSource(
+                DataSourceParams(app_name="RecApp")
+            ).read_training(None)
+        finally:
+            set_storage(None)
+        ux = td.user_ids.index("uX")
+        vals = [float(v) for r, v in zip(td.rows, td.ratings) if r == ux]
+        assert vals == [4.0]
+
+    def test_eval_folds_exclude_test_only_users(self, seeded_app):
+        """A user whose only ratings fell in the test fold must be absent
+        from that fold's training id space (unseen-user semantics)."""
+        from predictionio_tpu.data.storage import set_storage
+        from predictionio_tpu.models.recommendation import (
+            DataSourceParams,
+            RecommendationDataSource,
+        )
+
+        set_storage(seeded_app)
+        try:
+            folds = RecommendationDataSource(
+                DataSourceParams(app_name="RecApp")
+            ).read_eval(None)
+        finally:
+            set_storage(None)
+        for train, _info, qa in folds:
+            n_users = len(train.user_ids)
+            n_items = len(train.item_ids)
+            # every indexed entity appears in at least one training rating
+            assert set(train.rows.tolist()) == set(range(n_users))
+            assert set(train.cols.tolist()) == set(range(n_items))

@@ -487,3 +487,47 @@ class TestScanRatings:
         events.init(7)
         b = events.scan_ratings(7)
         assert len(b) == 0 and b.entity_ids == [] and b.target_ids == []
+
+    def test_override_beats_property(self, any_storage):
+        """Reference semantics: buy is FORCED to the configured value even
+        when the event carries a rating property (DataSource.scala:55)."""
+        events = any_storage.get_events()
+        events.init(8)
+        events.insert(
+            Event(event="buy", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": 1.0}), 8)
+        events.insert(
+            Event(event="rate", entity_type="user", entity_id="u2",
+                  target_entity_type="item", target_entity_id="i2",
+                  properties={"rating": 2.0}), 8)
+        b = events.scan_ratings(
+            8, event_names=["rate", "buy"],
+            override_ratings={"buy": 4.0},
+        )
+        got = {
+            (b.entity_ids[r], float(v)) for r, v in zip(b.rows, b.vals)
+        }
+        assert got == {("u1", 4.0), ("u2", 2.0)}
+
+    def test_replay_semantics_without_native_codec(self, any_storage, monkeypatch):
+        """Degraded pure-Python mode (no C++ toolchain) must still honor
+        last-write-wins and deletes in the columnar read."""
+        from predictionio_tpu import native
+
+        monkeypatch.setattr(native, "_load", lambda: None)
+        events = any_storage.get_events()
+        events.init(12)
+        eid = events.insert(_event(1, target="i1"), 12)
+        events.insert(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": 5.0}, event_id=eid), 12)
+        doomed = events.insert(_event(2, entity="u2", target="i2"), 12)
+        events.delete(doomed, 12)
+        b = events.scan_ratings(12, event_names=["rate"])
+        got = {
+            (b.entity_ids[r], b.target_ids[c], float(v))
+            for r, c, v in zip(b.rows, b.cols, b.vals)
+        }
+        assert got == {("u1", "i1", 5.0)}
