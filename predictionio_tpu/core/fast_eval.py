@@ -146,6 +146,10 @@ class FastEvalEngineWorkflow:
                 ]
                 per_set.append((per_algo, info, qa))
             self.algorithms_cache[key] = per_set
+            # the factor models were consumed into (small) predictions;
+            # dropping them bounds sweep memory at O(1) models instead of
+            # O(candidates x folds)
+            self.models_cache.pop(key, None)
         else:
             self.hits["algorithms"] += 1
         return self.algorithms_cache[key]

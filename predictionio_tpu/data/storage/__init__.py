@@ -144,6 +144,15 @@ def _http_backend() -> _Backend:
     )
 
 
+def _search_backend() -> _Backend:
+    from predictionio_tpu.data.storage import searchstore as ss
+
+    return _Backend(
+        client_factory=lambda cfg: ss.SearchStorageClient(cfg),
+        daos=dict(ss.DAOS),
+    )
+
+
 _BACKEND_TYPES: dict[str, Callable[[], _Backend]] = {
     "sqlite": _sqlite_backend,
     "memory": _memory_backend,
@@ -152,6 +161,7 @@ _BACKEND_TYPES: dict[str, Callable[[], _Backend]] = {
     "hdfs": _hdfs_backend,
     "s3": _s3_backend,
     "http": _http_backend,
+    "search": _search_backend,
 }
 
 # which repositories each backend type can serve (capability subsets,
@@ -165,6 +175,7 @@ _TYPE_CAPABILITIES: dict[str, tuple[str, ...]] = {
     "hdfs": (MODELDATA,),
     "s3": (MODELDATA,),
     "http": REPOSITORIES,
+    "search": REPOSITORIES,
 }
 
 

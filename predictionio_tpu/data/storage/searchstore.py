@@ -1,0 +1,195 @@
+"""``search`` backend: indexed document store with full-text event search.
+
+The TPU framework's analog of the reference's Elasticsearch backend
+(storage/elasticsearch/ — metadata DAOs + ESLEvents/ESPEvents over an
+indexed document store, ESUtils query builders). There is no external ES
+here; the same ROLE — a storage source whose events are additionally
+full-text indexed and queryable — is filled by sqlite's FTS5 engine in
+the embedded database, behind the standard registry contract:
+
+- every DAO of the sqlite backend is reused as-is (metadata, models,
+  events CRUD/find/scan_ratings all behave identically),
+- event writes additionally maintain an FTS5 index over the event name,
+  entity/target ids and types, and flattened property text,
+- :meth:`SearchEvents.search` answers FTS queries ("laptop AND NOT
+  refurbished") with ranked Events — the ESUtils-query-DSL analog.
+
+Configured like any source: ``PIO_STORAGE_SOURCES_<NAME>_TYPE=search``
+plus ``_PATH`` for the database file; serves all three repositories.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import sqlite as sq
+from predictionio_tpu.data.storage.sqlite import _is_missing_table
+
+
+class SearchStorageClient(sq.SQLiteStorageClient):
+    """SQLite client whose event namespaces carry an FTS5 index."""
+
+    def __init__(self, config: dict | None = None):
+        super().__init__(config)
+        with self.lock:
+            has_fts = self.conn.execute(
+                "SELECT sqlite_compileoption_used('ENABLE_FTS5')"
+            ).fetchone()[0]
+        if not has_fts:  # pragma: no cover - stock builds ship FTS5
+            raise RuntimeError(
+                "search storage backend needs an sqlite build with FTS5"
+            )
+
+
+def _flatten_properties(props: dict) -> str:
+    """Property bag -> searchable text: keys and scalar values, nested
+    containers walked (the ES document-body analog)."""
+    out: list[str] = []
+
+    def walk(v):
+        if isinstance(v, dict):
+            for k, vv in v.items():
+                out.append(str(k))
+                walk(vv)
+        elif isinstance(v, (list, tuple)):
+            for vv in v:
+                walk(vv)
+        elif v is not None:
+            out.append(str(v))
+
+    walk(props)
+    return " ".join(out)
+
+
+class SearchEvents(sq.SQLiteEvents):
+    """SQLiteEvents + FTS5 maintenance and a ranked ``search`` query."""
+
+    @staticmethod
+    def _fts(app_id: int, channel_id: int | None) -> str:
+        return SearchEvents._table(app_id, channel_id) + "_fts"
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        super().init(app_id, channel_id)
+        fts = self._fts(app_id, channel_id)
+        with self._c.lock, self._c.conn:
+            self._c.conn.execute(
+                f"CREATE VIRTUAL TABLE IF NOT EXISTS {fts} USING fts5("
+                "event_id UNINDEXED, event, entitytype, entityid, "
+                "targetentitytype, targetentityid, properties)"
+            )
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        with self._c.lock, self._c.conn:
+            self._c.conn.execute(
+                f"DROP TABLE IF EXISTS {self._fts(app_id, channel_id)}"
+            )
+        return super().remove(app_id, channel_id)
+
+    def _index_rows(self, events_ids, app_id: int, channel_id: int | None):
+        fts = self._fts(app_id, channel_id)
+        rows = [
+            (
+                event_id,
+                e.event,
+                e.entity_type,
+                e.entity_id,
+                e.target_entity_type or "",
+                e.target_entity_id or "",
+                _flatten_properties(e.properties.to_dict()),
+            )
+            for e, event_id in events_ids
+        ]
+        def write() -> None:
+            with self._c.lock, self._c.conn:
+                # replace semantics: stale index rows of re-inserted ids
+                self._c.conn.executemany(
+                    f"DELETE FROM {fts} WHERE event_id = ?",
+                    [(eid,) for _, eid in events_ids],
+                )
+                self._c.conn.executemany(
+                    f"INSERT INTO {fts} VALUES (?,?,?,?,?,?,?)", rows
+                )
+
+        try:
+            write()
+        except sqlite3.OperationalError as err:
+            # auto-create the namespace like the base insert contract —
+            # covers DB files created by the plain sqlite backend (base
+            # event table exists, FTS table doesn't)
+            if not _is_missing_table(err):
+                raise
+            self.init(app_id, channel_id)
+            write()
+
+    # single-event insert is NOT overridden: SQLiteEvents.insert routes
+    # through self.batch_insert, so the override below indexes it once.
+
+    def batch_insert(
+        self, events, app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        events = list(events)
+        ids = super().batch_insert(events, app_id, channel_id)
+        self._index_rows(list(zip(events, ids)), app_id, channel_id)
+        return ids
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        deleted = super().delete(event_id, app_id, channel_id)
+        if deleted:
+            try:
+                with self._c.lock, self._c.conn:
+                    self._c.conn.execute(
+                        f"DELETE FROM {self._fts(app_id, channel_id)} "
+                        "WHERE event_id = ?",
+                        (event_id,),
+                    )
+            except sqlite3.OperationalError as err:
+                if not _is_missing_table(err):
+                    raise  # no FTS table -> nothing indexed to remove
+        return deleted
+
+    def search(
+        self,
+        app_id: int,
+        query: str,
+        channel_id: int | None = None,
+        limit: int | None = 20,
+    ) -> list[Event]:
+        """Ranked full-text search over an app's events.
+
+        ``query`` uses FTS5 match syntax (terms, AND/OR/NOT, prefix*,
+        column filters like ``properties: laptop``) — the role of the
+        reference's ESUtils query-DSL builders
+        (storage/elasticsearch/.../ESUtils.scala). Results are Events in
+        bm25 relevance order.
+        """
+        fts = self._fts(app_id, channel_id)
+        sql = f"SELECT event_id FROM {fts} WHERE {fts} MATCH ? ORDER BY rank"
+        if limit is not None and limit >= 0:
+            sql += f" LIMIT {int(limit)}"
+        try:
+            rows = self._c.query(sql, (query,))
+        except sqlite3.OperationalError as err:
+            if _is_missing_table(err):
+                return []
+            raise
+        out = []
+        for (event_id,) in rows:
+            e = self.get(event_id, app_id, channel_id)
+            if e is not None:
+                out.append(e)
+        return out
+
+
+DAOS = {
+    "Apps": sq.SQLiteApps,
+    "AccessKeys": sq.SQLiteAccessKeys,
+    "Channels": sq.SQLiteChannels,
+    "EngineInstances": sq.SQLiteEngineInstances,
+    "EvaluationInstances": sq.SQLiteEvaluationInstances,
+    "Models": sq.SQLiteModels,
+    "Events": SearchEvents,
+}

@@ -1,0 +1,131 @@
+"""Search backend: full-text-indexed event store (the elasticsearch-role
+backend — reference storage/elasticsearch/, ESLEvents + ESUtils DSL)."""
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage
+
+
+@pytest.fixture()
+def search_storage(tmp_path):
+    s = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_IDX_TYPE": "search",
+            "PIO_STORAGE_SOURCES_IDX_PATH": str(tmp_path / "s.db"),
+        }
+    )
+    yield s
+    s.close()
+
+
+def _ev(event, entity, target=None, props=None):
+    return Event(
+        event=event, entity_type="user", entity_id=entity,
+        target_entity_type="item" if target else None,
+        target_entity_id=target, properties=props or {},
+    )
+
+
+class TestSearchEvents:
+    def test_fulltext_over_properties(self, search_storage):
+        events = search_storage.get_events()
+        events.init(1)
+        events.insert(_ev("view", "u1", "laptop-1",
+                          {"title": "gaming laptop", "brand": "acme"}), 1)
+        events.insert(_ev("view", "u2", "phone-1",
+                          {"title": "budget phone", "brand": "acme"}), 1)
+        events.insert(_ev("view", "u3", "laptop-2",
+                          {"title": "refurbished laptop"}), 1)
+
+        hits = events.search(1, "laptop")
+        assert {e.target_entity_id for e in hits} == {"laptop-1", "laptop-2"}
+        hits = events.search(1, "laptop NOT refurbished")
+        assert [e.target_entity_id for e in hits] == ["laptop-1"]
+        hits = events.search(1, "acme")
+        assert {e.target_entity_id for e in hits} == {"laptop-1", "phone-1"}
+        assert events.search(1, "nonexistent") == []
+
+    def test_index_follows_replace_and_delete(self, search_storage):
+        events = search_storage.get_events()
+        events.init(2)
+        eid = events.insert(_ev("view", "u1", "i1", {"title": "red shoe"}), 2)
+        # replace: the old text must leave the index
+        events.insert(
+            Event(event="view", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"title": "blue boot"}, event_id=eid), 2)
+        assert events.search(2, "shoe") == []
+        assert len(events.search(2, "boot")) == 1
+        events.delete(eid, 2)
+        assert events.search(2, "boot") == []
+
+    def test_search_scoped_per_app_and_channel(self, search_storage):
+        events = search_storage.get_events()
+        events.init(3)
+        events.init(3, channel_id=7)
+        events.insert(_ev("view", "u1", "i1", {"k": "alpha"}), 3)
+        events.insert(_ev("view", "u2", "i2", {"k": "alpha"}), 3, 7)
+        assert len(events.search(3, "alpha")) == 1
+        assert len(events.search(3, "alpha", channel_id=7)) == 1
+        assert events.search(99, "alpha") == []
+
+    def test_batch_insert_indexed(self, search_storage):
+        events = search_storage.get_events()
+        events.init(4)
+        events.batch_insert(
+            [_ev("rate", f"u{i}", f"i{i}", {"note": f"tag{i}"})
+             for i in range(10)],
+            4,
+        )
+        assert len(events.search(4, "tag7")) == 1
+        assert len(events.search(4, "tag*", limit=None)) == 10
+
+    def test_columnar_scan_unaffected(self, search_storage):
+        """scan_ratings rides the sqlite fast path untouched by the index."""
+        events = search_storage.get_events()
+        events.init(5)
+        events.batch_insert(
+            [_ev("rate", f"u{i % 3}", f"i{i % 2}", {"rating": float(i % 5 + 1)})
+             for i in range(30)],
+            5,
+        )
+        b = events.scan_ratings(5, event_names=["rate"])
+        assert len(b) == 30 and sorted(b.entity_ids) == ["u0", "u1", "u2"]
+
+    def test_indexing_over_plain_sqlite_db(self, tmp_path):
+        """Pointing the search backend at a DB created by the plain
+        sqlite backend must auto-create the FTS index on first write
+        (the base insert contract)."""
+        plain = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "x.db"),
+            }
+        )
+        plain.get_events().init(1)
+        plain.get_events().insert(_ev("view", "u0", "i0", {"t": "old"}), 1)
+        plain.close()
+        srch = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_IDX_TYPE": "search",
+                "PIO_STORAGE_SOURCES_IDX_PATH": str(tmp_path / "x.db"),
+            }
+        )
+        events = srch.get_events()
+        eid = events.insert(_ev("view", "u1", "i1", {"t": "fresh"}), 1)
+        assert len(events.search(1, "fresh")) == 1
+        assert events.delete(eid, 1)  # delete tolerates partial index
+        assert len(events.find(1)) == 1
+        srch.close()
+
+    def test_single_insert_indexed_once(self, search_storage):
+        """insert routes through the batch override exactly once (no
+        double FTS writes)."""
+        events = search_storage.get_events()
+        events.init(6)
+        events.insert(_ev("view", "u1", "i1", {"t": "solo"}), 6)
+        (count,) = search_storage._client("IDX").query(
+            "SELECT count(*) FROM pio_event_6_fts"
+        )[0]
+        assert count == 1

@@ -45,6 +45,13 @@ def storages(tmp_path):
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
     }
+    search_env = {
+        "PIO_STORAGE_SOURCES_IDX_TYPE": "search",
+        "PIO_STORAGE_SOURCES_IDX_PATH": str(tmp_path / "pio_search.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "IDX",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "IDX",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "IDX",
+    }
     jsonl_env = {
         "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
         "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio2.db"),
@@ -54,13 +61,23 @@ def storages(tmp_path):
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
     }
-    return [make_test_storage(), Storage(env=sqlite_env), Storage(env=jsonl_env)]
+    return [
+        make_test_storage(),
+        Storage(env=sqlite_env),
+        Storage(env=jsonl_env),
+        Storage(env=search_env),
+    ]
 
 
-@pytest.fixture(params=["memory", "sqlite+localfs", "sqlite+jsonl"])
+@pytest.fixture(params=["memory", "sqlite+localfs", "sqlite+jsonl", "search"])
 def any_storage(request, tmp_path):
-    mem, sql, jl = storages(tmp_path)
-    s = {"memory": mem, "sqlite+localfs": sql, "sqlite+jsonl": jl}[request.param]
+    mem, sql, jl, srch = storages(tmp_path)
+    s = {
+        "memory": mem,
+        "sqlite+localfs": sql,
+        "sqlite+jsonl": jl,
+        "search": srch,
+    }[request.param]
     yield s
     s.close()
 
