@@ -560,6 +560,37 @@ def main() -> None:
 
     apply_platform_env()  # honor JAX_PLATFORMS even under plugin boot hooks
 
+    # probe the accelerator in a watchdogged child first: a dead remote
+    # tunnel hangs backend init indefinitely, and a bench that hangs
+    # produces no artifact at all — degrading to CPU (clearly labeled in
+    # "device") beats that
+    import subprocess
+
+    device_fallback = None
+    try:
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from predictionio_tpu.utils import apply_platform_env;"
+                "apply_platform_env();import jax;"
+                "print(jax.devices()[0].platform)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            # -c children resolve predictionio_tpu via cwd; pin it to the
+            # repo dir so the probe works when bench.py runs from elsewhere
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if probe.returncode != 0:
+            device_fallback = "probe failed: " + probe.stderr.strip()[-500:]
+    except subprocess.TimeoutExpired:
+        device_fallback = "probe timed out after 240s (accelerator unreachable)"
+    if device_fallback is not None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        apply_platform_env()
+
     # all storage for serving/e2e lives in one throwaway dir; configure
     # BEFORE the first get_storage() call binds the singleton
     tmpdir = tempfile.mkdtemp(prefix="pio_bench_")
@@ -588,6 +619,9 @@ def main() -> None:
         "device": str(jax.devices()[0]),
     }
     extras: dict = {"pallas": PALLAS_RECORD}
+    if device_fallback is not None:
+        # the artifact must explain a CPU run on a TPU box by itself
+        extras["device_fallback"] = device_fallback
 
     section_t0 = time.perf_counter()
 
