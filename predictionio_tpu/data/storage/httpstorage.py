@@ -119,6 +119,14 @@ HTTPEvaluationInstances = _make_dao_class(
     "evaluation_instances", base.EvaluationInstances
 )
 HTTPEvents = _make_dao_class("events", base.Events)
+# backend extensions beyond the base surface (wire.EXTENSION_METHODS is
+# the shared source of truth with the server allowlist): proxied
+# opportunistically, 403 from the service when the backing DAO lacks
+# them (e.g. full-text search served by the `search` backend)
+for _repo, _methods in wire.EXTENSION_METHODS.items():
+    if _repo == "events":
+        for _m in _methods:
+            setattr(HTTPEvents, _m, _make_proxy(_repo, _m))
 HTTPModels = _make_dao_class("models", base.Models)
 
 DAOS = {

@@ -25,6 +25,13 @@ import numpy as np
 from predictionio_tpu.data.event import Event, parse_time
 from predictionio_tpu.data.storage import base as storage_base
 
+# DAO methods beyond the base-class surface that ride the wire when the
+# backing implementation has them (403 from the service otherwise) —
+# single source of truth for the server allowlist and the client proxies
+EXTENSION_METHODS: dict[str, tuple[str, ...]] = {
+    "events": ("search",),  # full-text queries of the `search` backend
+}
+
 _TAGS = (
     "__dt__", "__b64__", "__nd__", "__event__", "__pm__", "__dc__",
     "__ellipsis__", "__dict__", "__tuple__", "__set__",

@@ -54,7 +54,8 @@ def _public_methods(cls: type) -> frozenset[str]:
 
 
 _ALLOWED: dict[str, frozenset[str]] = {
-    repo: _public_methods(cls) for repo, (_, cls) in _REPOS.items()
+    repo: _public_methods(cls) | frozenset(wire.EXTENSION_METHODS.get(repo, ()))
+    for repo, (_, cls) in _REPOS.items()
 }
 
 
@@ -109,6 +110,10 @@ class StorageServer:
                 )
             accessor, _ = _REPOS[repo]
             dao = getattr(self.storage, accessor)()
+            if not callable(getattr(dao, method, None)):
+                return Response.error(
+                    f"backend does not implement {repo}.{method}", 403
+                )
             payload = request.json() or {}
             args = [wire.decode(a) for a in payload.get("args", [])]
             kwargs = {k: wire.decode(v) for k, v in payload.get("kwargs", {}).items()}

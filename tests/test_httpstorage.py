@@ -354,3 +354,51 @@ class TestMultiProcessQuickstart:
                 engine_server.kill()
             storage_proc.terminate()
             storage_proc.wait(timeout=15)
+
+
+class TestRemoteSearch:
+    def test_fulltext_search_over_http(self, tmp_path):
+        """The search backend's FTS queries work through the storage
+        service (extension method beyond the base Events surface)."""
+        backing = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_IDX_TYPE": "search",
+                "PIO_STORAGE_SOURCES_IDX_PATH": str(tmp_path / "s.db"),
+            }
+        )
+        server = StorageServer(storage=backing, host="127.0.0.1", port=0)
+        port = server.start(background=True)
+        try:
+            remote = Storage(
+                env={
+                    "PIO_STORAGE_SOURCES_R_TYPE": "http",
+                    "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{port}",
+                    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+                    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+                }
+            )
+            events = remote.get_events()
+            events.init(1)
+            events.insert(
+                Event(event="view", entity_type="user", entity_id="u1",
+                      target_entity_type="item", target_entity_id="i1",
+                      properties={"title": "gaming laptop"}), 1)
+            events.insert(
+                Event(event="view", entity_type="user", entity_id="u2",
+                      target_entity_type="item", target_entity_id="i2",
+                      properties={"title": "office chair"}), 1)
+            hits = events.search(1, "laptop")
+            assert [e.target_entity_id for e in hits] == ["i1"]
+        finally:
+            server.stop()
+
+    def test_search_403_on_backend_without_it(self, remote_storage):
+        """A memory-backed service rejects the extension method cleanly."""
+        remote, _, _ = remote_storage
+        from predictionio_tpu.data.storage.httpstorage import HTTPStorageError
+
+        events = remote.get_events()
+        events.init(2)
+        with pytest.raises(HTTPStorageError, match="does not implement"):
+            events.search(2, "anything")
