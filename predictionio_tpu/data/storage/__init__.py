@@ -144,6 +144,15 @@ def _http_backend() -> _Backend:
     )
 
 
+def _partitioned_backend() -> _Backend:
+    from predictionio_tpu.data.storage import partitioned as pt
+
+    return _Backend(
+        client_factory=lambda cfg: pt.PartitionedStorageClient(cfg),
+        daos={"Events": pt.PartitionedEvents},
+    )
+
+
 def _search_backend() -> _Backend:
     from predictionio_tpu.data.storage import searchstore as ss
 
@@ -158,6 +167,7 @@ _BACKEND_TYPES: dict[str, Callable[[], _Backend]] = {
     "memory": _memory_backend,
     "localfs": _localfs_backend,
     "jsonl": _jsonl_backend,
+    "partitioned": _partitioned_backend,
     "hdfs": _hdfs_backend,
     "s3": _s3_backend,
     "http": _http_backend,
@@ -172,6 +182,7 @@ _TYPE_CAPABILITIES: dict[str, tuple[str, ...]] = {
     "memory": REPOSITORIES,
     "localfs": (MODELDATA,),
     "jsonl": (EVENTDATA,),
+    "partitioned": (EVENTDATA,),
     "hdfs": (MODELDATA,),
     "s3": (MODELDATA,),
     "http": REPOSITORIES,

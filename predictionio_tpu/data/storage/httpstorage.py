@@ -119,15 +119,25 @@ HTTPEvaluationInstances = _make_dao_class(
     "evaluation_instances", base.EvaluationInstances
 )
 HTTPEvents = _make_dao_class("events", base.Events)
+HTTPModels = _make_dao_class("models", base.Models)
+
+_REPO_TO_CLASS = {
+    "apps": HTTPApps,
+    "access_keys": HTTPAccessKeys,
+    "channels": HTTPChannels,
+    "engine_instances": HTTPEngineInstances,
+    "evaluation_instances": HTTPEvaluationInstances,
+    "events": HTTPEvents,
+    "models": HTTPModels,
+}
 # backend extensions beyond the base surface (wire.EXTENSION_METHODS is
 # the shared source of truth with the server allowlist): proxied
-# opportunistically, 403 from the service when the backing DAO lacks
-# them (e.g. full-text search served by the `search` backend)
+# opportunistically on every repo's class, 403 from the service when the
+# backing DAO lacks them (e.g. full-text search served by the `search`
+# backend)
 for _repo, _methods in wire.EXTENSION_METHODS.items():
-    if _repo == "events":
-        for _m in _methods:
-            setattr(HTTPEvents, _m, _make_proxy(_repo, _m))
-HTTPModels = _make_dao_class("models", base.Models)
+    for _m in _methods:
+        setattr(_REPO_TO_CLASS[_repo], _m, _make_proxy(_repo, _m))
 
 DAOS = {
     "Apps": HTTPApps,

@@ -32,6 +32,67 @@ from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.memory import query_events
 
 
+def fold_jsonl_file(
+    path: Path, table: dict[str, Event], deleted: set[str] | None = None
+) -> None:
+    """Fold one event log into ``table``: records upsert by event id,
+    ``{"$delete": id}`` markers pop — the shared last-write-wins replay
+    used by the jsonl and partitioned backends. When ``deleted`` is given
+    it accumulates the ids whose *final* state is deleted (a re-insert
+    after a delete removes the id again)."""
+    if not path.exists():
+        return
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if "$delete" in rec:
+                eid = rec["$delete"]
+                table.pop(eid, None)
+                if deleted is not None:
+                    deleted.add(eid)
+            else:
+                e = Event.from_dict(rec)
+                table[e.event_id] = e
+                if deleted is not None:
+                    deleted.discard(e.event_id)
+
+
+def has_delete_markers(buf: bytes) -> bool:
+    """Delete MARKERS are whole records ``{"$delete": ...}`` — the probe
+    anchors at line starts so a property VALUE containing "$delete"
+    (which survives rewriting) can't look like one."""
+    return buf.startswith(b'{"$delete"') or b'\n{"$delete"' in buf
+
+
+def prove_clean(buf: bytes):
+    """Prove an event-log buffer replay-clean (no delete markers, unique
+    event ids) so a columnar scan can treat it as a plain record set.
+
+    Returns ``(needs_compact, scanned)`` where ``scanned`` is the native
+    span scan (reusable by the ratings extraction) or None. Uniqueness is
+    only provable for lines whose event-id span was scanned; any
+    unscannable line (degraded pure-Python mode flags ALL lines, escaped
+    ids flag a few) could hide a replacement -> needs_compact.
+    """
+    from predictionio_tpu import native
+
+    if not buf:
+        return False, None
+    if has_delete_markers(buf):
+        return True, None
+    scanned = native.scan_events(buf)
+    ids = scanned.offs[:, native.F_EVENT_ID]
+    _, uniq = native.index_spans(
+        scanned.buf, ids, scanned.lens[:, native.F_EVENT_ID]
+    )
+    n_with_id = int((ids >= 0).sum())
+    n_lines = int((scanned.flags & native.FLAG_EMPTY == 0).sum())
+    return (len(uniq) < n_with_id or n_with_id < n_lines), scanned
+
+
 class JSONLStorageClient:
     def __init__(self, config: dict | None = None):
         self.config = config or {}
@@ -82,21 +143,8 @@ class JSONLEvents(base.Events):
 
     def _replay(self, app_id: int, channel_id: int | None) -> dict[str, Event]:
         """Fold the log: last record per event id wins."""
-        path = self._file(app_id, channel_id)
         table: dict[str, Event] = {}
-        if not path.exists():
-            return table
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                rec = json.loads(line)
-                if "$delete" in rec:
-                    table.pop(rec["$delete"], None)
-                else:
-                    e = Event.from_dict(rec)
-                    table[e.event_id] = e
+        fold_jsonl_file(self._file(app_id, channel_id), table)
         return table
 
     def _append(self, app_id: int, channel_id: int | None, record: dict) -> None:
@@ -248,30 +296,7 @@ class JSONLEvents(base.Events):
             if buf and self._c.clean_stat.get(path) == _stat(path):
                 needs_compact = False  # unchanged since last proven clean
             else:
-                # delete MARKERS are whole records '{"$delete": ...}' —
-                # anchor the probe at line starts so a property VALUE
-                # containing "$delete" (which survives rewriting) can't
-                # trigger a full-log compaction on every training read
-                needs_compact = buf.startswith(b'{"$delete"') or (
-                    b'\n{"$delete"' in buf
-                )
-                if not needs_compact and buf:
-                    scanned = native.scan_events(buf)
-                    ids = scanned.offs[:, native.F_EVENT_ID]
-                    idx, uniq = native.index_spans(
-                        scanned.buf, ids, scanned.lens[:, native.F_EVENT_ID]
-                    )
-                    n_with_id = int((ids >= 0).sum())
-                    n_lines = int(
-                        (scanned.flags & native.FLAG_EMPTY == 0).sum()
-                    )
-                    # uniqueness is only provable for lines whose event-id
-                    # span was scanned; any unscannable line (degraded
-                    # pure-Python mode flags ALL lines, escaped ids flag a
-                    # few) could hide a replacement -> compact
-                    needs_compact = (
-                        len(uniq) < n_with_id or n_with_id < n_lines
-                    )
+                needs_compact, scanned = prove_clean(buf)
             if needs_compact:
                 # compact inline: the flock is not reentrant, so reuse the
                 # under-lock body rather than calling compact()

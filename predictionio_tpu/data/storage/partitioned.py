@@ -1,0 +1,761 @@
+"""Hash-partitioned, segment-rotated event backend — the scalable event store.
+
+The reference's big-data event path is HBase: one table per (app, channel),
+row key = MD5(entityType+entityId) hash prefix + eventTime + uuid-low so
+writes spread across regions, point gets address one region directly, and
+scans prune by key/time range (reference
+storage/hbase/src/main/scala/org/apache/predictionio/data/storage/hbase/HBEventsUtil.scala:54-133,
+HBLEvents.scala:37, HBPEvents.scala:31-88). This backend keeps those scale
+properties on a filesystem (local disk or a mounted DFS) with no region
+servers:
+
+- **Hash-spread writes.** Each (app, channel) namespace is split into P
+  independent partition logs. Generated event ids embed their partition
+  (``<pp>-<uuid>`` with pp = MD5("entityType:entityId") % P), so an entity's
+  generated events co-locate (the HBase row-prefix rule) and every point op
+  addresses exactly one partition; ingest across entities fans out over P
+  uncontended locks. Explicit foreign ids route by MD5 of the id itself, so
+  a replacement always lands in the same partition as the original.
+- **Segment rotation + time-pruned scans.** Each partition is an append-only
+  ``active.jsonl`` sealed into an immutable ``seg_NNNNNN.jsonl`` at a size
+  threshold. Sealing records the segment's [min, max] event-time (native
+  span scan, no Python parse) in a sidecar, so time-windowed ``find``s skip
+  disjoint segments wholesale — the analog of HBase's eventTime range scan.
+- **Supersede-aware pruning.** Skipping a segment is only sound if nothing
+  in it replaces or deletes a record in an earlier segment. Explicit-id
+  inserts and deletes log their ids to a per-partition ``supersede.log``;
+  sealing folds that list into the segment sidecar, and a pruned segment
+  still *applies* its supersede set during replay (pops without parsing).
+  Bulk ``append_jsonl`` into a non-empty partition cannot know what it
+  replaces, so the segment it seals into is marked opaque = never pruned;
+  ``compact`` rewrites partitions into exact, fully-prunable segments.
+- **Parallel bulk reads.** ``find`` replays partitions on a thread pool;
+  ``scan_ratings`` concatenates the partition logs and runs the native
+  columnar codec once — the TableInputFormat-split analog feeding arrays,
+  not per-record Python objects.
+
+The partition count is fixed at namespace creation (persisted in
+``_meta.json``; the stored value wins over config thereafter) because id
+routing must stay stable for the life of the data.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import re
+import shutil
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime
+from pathlib import Path
+from typing import Sequence
+
+import numpy as np
+
+try:  # advisory cross-process locks; Unix-only (this framework targets Linux)
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
+    fcntl = None
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.jsonl import (
+    fold_jsonl_file,
+    has_delete_markers,
+    prove_clean,
+)
+from predictionio_tpu.data.storage.memory import query_events
+
+_SEG_RE = re.compile(r"^seg_(\d{6})\.jsonl$")
+_PP_ID_RE = re.compile(r"^([0-9a-f]{2})-")
+MAX_PARTITIONS = 256  # two hex digits embed the partition in the event id
+
+
+class PartitionedStorageClient:
+    def __init__(self, config: dict | None = None):
+        self.config = dict(config or {})
+        self.base_path = Path(
+            self.config.get("path", "~/.pio_tpu/events_partitioned")
+        ).expanduser()
+        self.base_path.mkdir(parents=True, exist_ok=True)
+        self.partitions = int(self.config.get("partitions", 8))
+        if not 1 <= self.partitions <= MAX_PARTITIONS:
+            raise ValueError(
+                f"partitions must be in [1, {MAX_PARTITIONS}], "
+                f"got {self.partitions}"
+            )
+        self.segment_bytes = int(
+            self.config.get("segment_bytes", 64 * 1024 * 1024)
+        )
+        self.lock = threading.RLock()
+        # per-partition-dir thread locks (cross-process safety comes from
+        # the flock; a global lock here would serialize the parallel scans)
+        self.path_locks: dict[str, threading.RLock] = {}
+        # namespace dir -> partition count (immutable once created, so a
+        # plain cache; invalidated on remove())
+        self.ns_partitions: dict[str, int] = {}
+        # namespace dir -> tuple of (path, mtime_ns, size) last proven
+        # replay-clean (unique ids, no delete markers): lets scan_ratings
+        # skip the uniqueness pass until any file changes
+        self.clean_stat: dict[Path, tuple] = {}
+
+
+class PartitionedEvents(base.Events):
+    """Events DAO over hash-partitioned segment logs (capability subset:
+    events only — like hbase in the reference, SURVEY §2.3)."""
+
+    def __init__(self, client: PartitionedStorageClient):
+        self._c = client
+
+    # -- layout ------------------------------------------------------------
+
+    def _ns_dir(self, app_id: int, channel_id: int | None) -> Path:
+        name = f"events_{app_id}" + (
+            f"_{channel_id}" if channel_id is not None else ""
+        )
+        return self._c.base_path / name
+
+    def _n_partitions(self, ns: Path) -> int:
+        """Partition count for a namespace: the persisted value wins.
+
+        Cached per client (the count is immutable once created), so the
+        hot write/read paths don't take the client lock or touch disk."""
+        n = self._c.ns_partitions.get(str(ns))
+        if n is not None:
+            return n
+        meta = ns / "_meta.json"
+        with self._c.lock:
+            if not meta.exists():
+                ns.mkdir(parents=True, exist_ok=True)
+                # per-process-unique temp name: a shared name would let two
+                # first-initializers publish each other's half-written file
+                tmp = ns / f"_meta.json.tmp.{os.getpid()}.{uuid.uuid4().hex}"
+                tmp.write_text(
+                    json.dumps({"partitions": self._c.partitions})
+                )
+                try:
+                    # atomic create-if-absent: a concurrent process may
+                    # have written meta between the check and now — theirs
+                    # wins
+                    os.link(tmp, meta)
+                except FileExistsError:
+                    pass
+                finally:
+                    tmp.unlink(missing_ok=True)
+            n = int(json.loads(meta.read_text())["partitions"])
+            self._c.ns_partitions[str(ns)] = n
+            return n
+
+    def _pdir(self, ns: Path, pp: int) -> Path:
+        d = ns / f"p{pp:02x}"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def _tlock(self, pdir: Path) -> threading.RLock:
+        with self._c.lock:
+            return self._c.path_locks.setdefault(
+                str(pdir), threading.RLock()
+            )
+
+    @contextlib.contextmanager
+    def _locked(self, pdir: Path):
+        """Per-partition thread lock + cross-process flock on the
+        partition's sidecar lock file (append vs seal vs compact must
+        serialize; the lock file is separate from the data because
+        seal/compact replace inodes). Per-partition, not client-global, so
+        scans of different partitions proceed in parallel."""
+        with self._tlock(pdir):
+            if fcntl is None:  # pragma: no cover - non-POSIX
+                yield
+                return
+            with open(pdir / ".lock", "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+
+    @contextlib.contextmanager
+    def _locked_all(self, ns: Path, n: int):
+        """All partition locks, acquired in ascending order (deadlock-free
+        against any other ordered acquirer) — the cross-partition snapshot
+        for bulk reads."""
+        with contextlib.ExitStack() as stack:
+            for pp in range(n):
+                stack.enter_context(self._locked(self._pdir(ns, pp)))
+            yield
+
+    @staticmethod
+    def _segments(pdir: Path) -> list[Path]:
+        return sorted(
+            (p for p in pdir.iterdir() if _SEG_RE.match(p.name)),
+            key=lambda p: p.name,
+        )
+
+    # -- routing -----------------------------------------------------------
+
+    @staticmethod
+    def _hash_pp(key: str, n: int) -> int:
+        return int.from_bytes(
+            hashlib.md5(key.encode("utf-8")).digest()[:4], "big"
+        ) % n
+
+    def _route(self, event_id: str, n: int) -> int:
+        """Partition of an event id — deterministic from the id alone, so
+        gets, deletes, and replacements always address the same log."""
+        m = _PP_ID_RE.match(event_id)
+        if m:
+            pp = int(m.group(1), 16)
+            if pp < n:
+                return pp
+        return self._hash_pp(event_id, n)
+
+    # -- sealing -----------------------------------------------------------
+
+    def _read_supersedes(self, pdir: Path) -> list[str]:
+        """Pending supersede ids for the active segment: ("X <id>" explicit
+        insert | "D <id>" delete) per line."""
+        log = pdir / "supersede.log"
+        if not log.exists():
+            return []
+        ids: list[str] = []
+        for line in log.read_text().splitlines():
+            if line:
+                ids.append(line.partition(" ")[2])
+        return ids
+
+    def _seal_locked(self, pdir: Path) -> None:
+        """Rotate active into an immutable segment + sidecar. Caller holds
+        the partition lock."""
+        from predictionio_tpu import native
+
+        active = pdir / "active.jsonl"
+        buf = active.read_bytes() if active.exists() else b""
+        if not buf:
+            return
+        logged = self._read_supersedes(pdir)
+        opaque = (pdir / "active.opaque").exists()
+        scanned = native.scan_events(buf)
+        nonempty = (scanned.flags & native.FLAG_EMPTY) == 0
+        has_deletes = has_delete_markers(buf)
+        # Validate logged supersede entries against the segment's actual
+        # content: writes log the id BEFORE appending the record, so a
+        # crash between the two leaves an orphan entry; folding it into
+        # the sidecar unvalidated would pop a LIVE older version whenever
+        # this segment is pruned. An entry counts only if its record (or
+        # its delete marker) really is in the segment. The validation scan
+        # runs only when there is something to validate — the bulk-ingest
+        # path (no explicit ids, no deletes) skips it entirely.
+        delete_idx: list[int] = []
+        supersedes: list[str] = []
+        if logged or has_deletes:
+            delete_ids: set[str] = set()
+            present: set[str] = set()
+            lines = buf.split(b"\n")
+            for i in range(len(scanned.flags)):
+                if not nonempty[i]:
+                    continue
+                line = lines[i]
+                if line.startswith(b'{"$delete"'):
+                    delete_ids.add(json.loads(line)["$delete"])
+                    delete_idx.append(i)
+                    continue
+                eid = scanned.field_str(i, native.F_EVENT_ID)
+                if eid is None:
+                    try:
+                        eid = json.loads(line).get("eventId")
+                    except ValueError:  # pragma: no cover - corrupt line
+                        eid = None
+                if eid is not None:
+                    present.add(eid)
+            supersedes = sorted(
+                {s for s in logged if s in present or s in delete_ids}
+                | delete_ids
+            )
+        min_ts = max_ts = None
+        if not opaque:
+            times = native.parse_times(
+                scanned.buf,
+                scanned.offs[:, native.F_EVENT_TIME],
+                scanned.lens[:, native.F_EVENT_TIME],
+            )
+            valid = nonempty & ~np.isnan(times)
+            # lines without a parseable eventTime are either delete
+            # markers (accounted: their ids are in the sidecar supersede
+            # set, which a pruned segment still applies) or foreign
+            # records we can't bound — any unaccounted one makes the
+            # segment unprunable
+            n_nan = int(nonempty.sum()) - int(valid.sum())
+            if valid.any() and n_nan <= len(delete_idx):
+                min_ts = float(times[valid].min())
+                max_ts = float(times[valid].max())
+            else:
+                opaque = True
+        segs = self._segments(pdir)
+        n = (int(_SEG_RE.match(segs[-1].name).group(1)) + 1) if segs else 1
+        seg = pdir / f"seg_{n:06d}.jsonl"
+        side = {
+            "min_ts": min_ts,
+            "max_ts": max_ts,
+            "supersedes": supersedes,
+            "opaque": opaque,
+        }
+        active.rename(seg)
+        (pdir / f"seg_{n:06d}.meta.json").write_text(json.dumps(side))
+        (pdir / "supersede.log").unlink(missing_ok=True)
+        (pdir / "active.opaque").unlink(missing_ok=True)
+
+    def _maybe_seal_locked(self, pdir: Path) -> None:
+        active = pdir / "active.jsonl"
+        if active.exists() and active.stat().st_size >= self._c.segment_bytes:
+            self._seal_locked(pdir)
+
+    # -- replay ------------------------------------------------------------
+
+    @staticmethod
+    def _fold_file(path: Path, table: dict[str, Event]) -> None:
+        fold_jsonl_file(path, table)
+
+    def _replay_partition(
+        self, pdir: Path, window: tuple[float | None, float | None] | None
+    ) -> dict[str, Event]:
+        """Fold one partition's logs, pruning sealed segments disjoint from
+        ``window`` (epoch-seconds [start, until)); a pruned segment still
+        applies its supersede set so replacements/deletes that were sealed
+        past the window can't resurrect stale versions."""
+        table: dict[str, Event] = {}
+        for seg in self._segments(pdir):
+            pruned = False
+            if window is not None:
+                side_path = pdir / (seg.stem + ".meta.json")
+                if side_path.exists():
+                    side = json.loads(side_path.read_text())
+                    if not side.get("opaque") and side["min_ts"] is not None:
+                        qs, qu = window
+                        disjoint = (
+                            qu is not None and side["min_ts"] >= qu
+                        ) or (qs is not None and side["max_ts"] < qs)
+                        if disjoint:
+                            for sid in side["supersedes"]:
+                                table.pop(sid, None)
+                            pruned = True
+            if not pruned:
+                self._fold_file(seg, table)
+        self._fold_file(pdir / "active.jsonl", table)
+        return table
+
+    # -- DAO contract ------------------------------------------------------
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        ns = self._ns_dir(app_id, channel_id)
+        n = self._n_partitions(ns)
+        for pp in range(n):
+            self._pdir(ns, pp)
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        ns = self._ns_dir(app_id, channel_id)
+        with self._c.lock:
+            existed = ns.exists()
+            if existed:
+                shutil.rmtree(ns)
+            self._c.clean_stat.pop(ns, None)
+            self._c.ns_partitions.pop(str(ns), None)
+        return existed
+
+    def _append_locked(self, pdir: Path, blob: bytes) -> None:
+        with open(pdir / "active.jsonl", "ab") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _log_supersede_locked(self, pdir: Path, tag: str, eid: str) -> None:
+        with open(pdir / "supersede.log", "a") as f:
+            f.write(f"{tag} {eid}\n")
+            f.flush()
+            # fsync BEFORE the data append's fsync: if the record survives
+            # a crash its supersede entry must too, or a later sealed
+            # segment would be marked prunable without it and windowed
+            # reads could resurrect the stale older version (the inverse
+            # crash — entry without record — is validated away at seal)
+            os.fsync(f.fileno())
+
+    def insert(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> str:
+        ns = self._ns_dir(app_id, channel_id)
+        n = self._n_partitions(ns)
+        explicit = bool(event.event_id)
+        if explicit:
+            event_id = event.event_id
+            pp = self._route(event_id, n)
+        else:
+            pp = self._hash_pp(f"{event.entity_type}:{event.entity_id}", n)
+            event_id = f"{pp:02x}-{uuid.uuid4().hex}"
+        e = event.with_event_id(event_id)
+        pdir = self._pdir(ns, pp)
+        line = (json.dumps(e.to_dict(for_api=False)) + "\n").encode()
+        with self._locked(pdir):
+            if explicit:
+                self._log_supersede_locked(pdir, "X", event_id)
+            self._append_locked(pdir, line)
+            self._maybe_seal_locked(pdir)
+        return event_id
+
+    def batch_insert(
+        self, events, app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        """Bulk append: one lock acquisition + write + fsync per touched
+        partition (the ingest fast path; per-event fsync would dominate)."""
+        ns = self._ns_dir(app_id, channel_id)
+        n = self._n_partitions(ns)
+        ids: list[str] = []
+        per_part: dict[int, list[bytes]] = {}
+        per_part_x: dict[int, list[str]] = {}
+        for event in events:
+            explicit = bool(event.event_id)
+            if explicit:
+                event_id = event.event_id
+                pp = self._route(event_id, n)
+                per_part_x.setdefault(pp, []).append(event_id)
+            else:
+                pp = self._hash_pp(
+                    f"{event.entity_type}:{event.entity_id}", n
+                )
+                event_id = f"{pp:02x}-{uuid.uuid4().hex}"
+            ids.append(event_id)
+            per_part.setdefault(pp, []).append(
+                (json.dumps(
+                    event.with_event_id(event_id).to_dict(for_api=False)
+                ) + "\n").encode()
+            )
+        for pp, lines in per_part.items():
+            pdir = self._pdir(ns, pp)
+            with self._locked(pdir):
+                for eid in per_part_x.get(pp, ()):
+                    self._log_supersede_locked(pdir, "X", eid)
+                self._append_locked(pdir, b"".join(lines))
+                self._maybe_seal_locked(pdir)
+        return ids
+
+    def append_jsonl(
+        self, blob: bytes, app_id: int, channel_id: int | None = None
+    ) -> None:
+        """Import splice fast path: route pre-rendered JSONL lines to their
+        partitions with one native span scan (no per-record Python objects)
+        and one locked write+fsync per partition. Lines must each carry an
+        eventId (cli import validates). A partition that already holds data
+        gets its in-flight segment marked opaque — the import may replace
+        ids we can't enumerate cheaply, so that segment is never pruned
+        (``compact`` restores exact prunable segments)."""
+        from predictionio_tpu import native
+
+        if not blob:
+            return
+        if not blob.endswith(b"\n"):
+            blob += b"\n"
+        ns = self._ns_dir(app_id, channel_id)
+        n = self._n_partitions(ns)
+        scanned = native.scan_events(blob)
+        line_offs = []  # (start, end) byte spans per line
+        pos = 0
+        while pos < len(blob):
+            nl = blob.index(b"\n", pos)
+            line_offs.append((pos, nl + 1))
+            pos = nl + 1
+        per_part: dict[int, list[bytes]] = {}
+        for i, (s, t) in enumerate(line_offs):
+            if i < len(scanned.flags) and (
+                scanned.flags[i] & native.FLAG_EMPTY
+            ):
+                continue
+            eid = None
+            if i < len(scanned.flags):
+                eid = scanned.field_str(i, native.F_EVENT_ID)
+            if eid is None:
+                rec = json.loads(blob[s:t])
+                eid = rec.get("eventId")
+                if eid is None:
+                    raise ValueError(
+                        "append_jsonl line missing eventId "
+                        "(required for partition routing)"
+                    )
+            pp = self._route(eid, n)
+            per_part.setdefault(pp, []).append(blob[s:t])
+        for pp, lines in per_part.items():
+            pdir = self._pdir(ns, pp)
+            with self._locked(pdir):
+                active = pdir / "active.jsonl"
+                nonempty = (
+                    active.exists() and active.stat().st_size > 0
+                ) or bool(self._segments(pdir))
+                if nonempty:
+                    (pdir / "active.opaque").touch()
+                self._append_locked(pdir, b"".join(lines))
+                self._maybe_seal_locked(pdir)
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        ns = self._ns_dir(app_id, channel_id)
+        if not ns.exists():
+            return None
+        pdir = self._pdir(ns, self._route(event_id, self._n_partitions(ns)))
+        with self._locked(pdir):
+            return self._replay_partition(pdir, None).get(event_id)
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        ns = self._ns_dir(app_id, channel_id)
+        if not ns.exists():
+            return False
+        pdir = self._pdir(ns, self._route(event_id, self._n_partitions(ns)))
+        with self._locked(pdir):
+            if event_id not in self._replay_partition(pdir, None):
+                return False
+            self._log_supersede_locked(pdir, "D", event_id)
+            self._append_locked(
+                pdir, (json.dumps({"$delete": event_id}) + "\n").encode()
+            )
+        return True
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed_order: bool = False,
+    ) -> list[Event]:
+        ns = self._ns_dir(app_id, channel_id)
+        if not ns.exists():
+            return []
+        n = self._n_partitions(ns)
+        window = None
+        if start_time is not None or until_time is not None:
+            window = (
+                start_time.timestamp() if start_time is not None else None,
+                until_time.timestamp() if until_time is not None else None,
+            )
+
+        def scan(pp: int) -> dict[str, Event]:
+            pdir = self._pdir(ns, pp)
+            with self._locked(pdir):
+                return self._replay_partition(pdir, window)
+
+        events: list[Event] = []
+        if n == 1:
+            events = list(scan(0).values())
+        else:
+            with ThreadPoolExecutor(
+                max_workers=min(n, os.cpu_count() or 4)
+            ) as pool:
+                for table in pool.map(scan, range(n)):
+                    events.extend(table.values())
+        return query_events(
+            events,
+            start_time,
+            until_time,
+            entity_type,
+            entity_id,
+            event_names,
+            target_entity_type,
+            target_entity_id,
+            limit,
+            reversed_order,
+        )
+
+    @staticmethod
+    def _write_atomic(path: Path, blob: bytes) -> None:
+        tmp = path.with_name(path.name + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        tmp.replace(path)
+
+    def _compact_partition_locked(self, pdir: Path) -> int:
+        """Rewrite one partition to its live records in exact, bounded,
+        supersede-free segments; returns the live count. Caller holds the
+        partition lock.
+
+        Crash-safe in two phases. Phase 1 publishes the COMPLETE live set
+        (plus tombstones for ids whose final state is deleted, since the
+        old segments still exist) into ``active.jsonl`` via tmp+rename —
+        from that commit point, replay over [old segments + new active]
+        is correct under any crash, because active folds last. Phase 2
+        removes the old segments and re-establishes bounded sealed
+        segments, each published via its own tmp+rename (a torn write
+        never enters replay), truncating active only after every segment
+        is durable; in every intermediate state replay sees either the
+        full copy in active, or segments plus a redundant identical copy
+        (which the next scan's uniqueness check compacts away)."""
+        table: dict[str, Event] = {}
+        deleted: set[str] = set()
+        segs = self._segments(pdir)
+        for seg in segs:
+            fold_jsonl_file(seg, table, deleted)
+        active = pdir / "active.jsonl"
+        fold_jsonl_file(active, table, deleted)
+        if not table and not deleted and not segs:
+            return 0  # untouched partition: nothing to rewrite
+
+        lines: dict[str, bytes] = {}
+        times: dict[str, float] = {}
+        for eid, e in table.items():
+            lines[eid] = (json.dumps(e.to_dict(for_api=False)) + "\n").encode()
+            times[eid] = e.event_time.timestamp()
+
+        # phase 1 — commit point
+        full = b"".join(
+            (json.dumps({"$delete": eid}) + "\n").encode()
+            for eid in sorted(deleted)
+        ) + b"".join(lines.values())
+        self._write_atomic(active, full)
+
+        for seg in self._segments(pdir):
+            (pdir / (seg.stem + ".meta.json")).unlink(missing_ok=True)
+            seg.unlink()
+        (pdir / "supersede.log").unlink(missing_ok=True)
+        (pdir / "active.opaque").unlink(missing_ok=True)
+
+        # phase 2 — re-segment; full chunks become sealed segments, the
+        # tail stays in active
+        seg_n = 0
+        chunk: list[str] = []
+        size = 0
+
+        def seal_chunk() -> None:
+            nonlocal seg_n, chunk, size
+            seg_n += 1
+            seg = pdir / f"seg_{seg_n:06d}.jsonl"
+            self._write_atomic(seg, b"".join(lines[eid] for eid in chunk))
+            ts = [times[eid] for eid in chunk]
+            (pdir / f"seg_{seg_n:06d}.meta.json").write_text(
+                json.dumps({
+                    "min_ts": min(ts),
+                    "max_ts": max(ts),
+                    "supersedes": [],
+                    "opaque": False,
+                })
+            )
+            chunk, size = [], 0
+
+        for eid, line in lines.items():
+            chunk.append(eid)
+            size += len(line)
+            if size >= self._c.segment_bytes:
+                seal_chunk()
+        self._write_atomic(
+            active, b"".join(lines[eid] for eid in chunk)
+        )
+        return len(table)
+
+    def compact(self, app_id: int, channel_id: int | None = None) -> int:
+        """Rewrite every partition to its live records; returns the live
+        count."""
+        ns = self._ns_dir(app_id, channel_id)
+        if not ns.exists():
+            return 0
+        n = self._n_partitions(ns)
+        total = 0
+        for pp in range(n):
+            pdir = self._pdir(ns, pp)
+            with self._locked(pdir):
+                total += self._compact_partition_locked(pdir)
+        with self._c.lock:
+            self._c.clean_stat.pop(ns, None)
+        return total
+
+    # -- columnar bulk read ------------------------------------------------
+
+    def _all_files(self, ns: Path, n: int) -> list[Path]:
+        files: list[Path] = []
+        for pp in range(n):
+            pdir = self._pdir(ns, pp)
+            files.extend(self._segments(pdir))
+            active = pdir / "active.jsonl"
+            if active.exists():
+                files.append(active)
+        return files
+
+    def scan_ratings(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        *,
+        event_names=None,
+        entity_type: str | None = None,
+        target_entity_type: str | None = None,
+        rating_key: str | None = "rating",
+        default_ratings: dict[str, float] | None = None,
+        override_ratings: dict[str, float] | None = None,
+    ) -> base.RatingsBatch:
+        """Columnar fast path: concatenate the partition logs and run the
+        native codec once. Sound because ids route deterministically to one
+        partition and, once proven unique store-wide (native span index,
+        cached until any file changes), last-write-wins degenerates to
+        order-free; duplicate ids or delete markers trigger a compact
+        first, exactly like the jsonl backend."""
+        from predictionio_tpu import native
+
+        ns = self._ns_dir(app_id, channel_id)
+        if not ns.exists():
+            return base.RatingsBatch.empty()
+        n = self._n_partitions(ns)
+
+        def read_all_locked() -> tuple[bytes, tuple]:
+            parts: list[bytes] = []
+            stats = []
+            for path in self._all_files(ns, n):
+                b = path.read_bytes()
+                if b and not b.endswith(b"\n"):
+                    b += b"\n"
+                st = path.stat()
+                stats.append((str(path), st.st_mtime_ns, st.st_size))
+                parts.append(b)
+            return b"".join(parts), tuple(stats)
+
+        # the whole prove -> compact -> re-read sequence holds every
+        # partition lock: a writer cannot slip a duplicate id or delete
+        # marker between the compaction and the snapshot the cache (and
+        # this scan) trusts — which also makes recording the post-compact
+        # state clean sound in degraded no-native mode, where uniqueness
+        # is unprovable but compaction just restored it by construction
+        with self._locked_all(ns, n):
+            buf, stat_key = read_all_locked()
+            scanned = None
+            if not (buf and self._c.clean_stat.get(ns) == stat_key):
+                needs_compact, scanned = prove_clean(buf)
+                if needs_compact:
+                    for pp in range(n):
+                        self._compact_partition_locked(self._pdir(ns, pp))
+                    buf, stat_key = read_all_locked()
+                    scanned = None
+            if buf:
+                with self._c.lock:
+                    self._c.clean_stat[ns] = stat_key
+        users, items, rows, cols, vals = native.load_ratings_jsonl(
+            buf,
+            event_names=list(event_names) if event_names is not None else None,
+            rating_key=rating_key,
+            default_ratings=default_ratings,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            override_ratings=override_ratings,
+            scanned=scanned,
+        )
+        return base.RatingsBatch(
+            entity_ids=users, target_ids=items, rows=rows, cols=cols, vals=vals
+        )

@@ -61,22 +61,38 @@ def storages(tmp_path):
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
     }
+    part_env = {
+        "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio3.db"),
+        "PIO_STORAGE_SOURCES_PART_TYPE": "partitioned",
+        "PIO_STORAGE_SOURCES_PART_PATH": str(tmp_path / "eventparts"),
+        "PIO_STORAGE_SOURCES_PART_PARTITIONS": "4",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PART",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+    }
     return [
         make_test_storage(),
         Storage(env=sqlite_env),
         Storage(env=jsonl_env),
         Storage(env=search_env),
+        Storage(env=part_env),
     ]
 
 
-@pytest.fixture(params=["memory", "sqlite+localfs", "sqlite+jsonl", "search"])
+@pytest.fixture(
+    params=[
+        "memory", "sqlite+localfs", "sqlite+jsonl", "search", "partitioned"
+    ]
+)
 def any_storage(request, tmp_path):
-    mem, sql, jl, srch = storages(tmp_path)
+    mem, sql, jl, srch, part = storages(tmp_path)
     s = {
         "memory": mem,
         "sqlite+localfs": sql,
         "sqlite+jsonl": jl,
         "search": srch,
+        "partitioned": part,
     }[request.param]
     yield s
     s.close()
