@@ -121,8 +121,8 @@ def _hdfs_backend() -> _Backend:
     from predictionio_tpu.data.storage import objectstore as obj
 
     return _Backend(
-        client_factory=lambda cfg: obj.DFSStorageClient(cfg),
-        daos={"Models": obj.DFSModels},
+        client_factory=lambda cfg: obj.dfs_storage_client(cfg),
+        daos={"Models": obj.dfs_models},
     )
 
 

@@ -1,4 +1,4 @@
-"""Remote model stores: S3 and mounted-DFS backends (Models only).
+"""Remote model stores: S3 and DFS backends (Models only).
 
 Parity with the reference's models-only backends (SURVEY §2.3):
 
@@ -6,33 +6,201 @@ Parity with the reference's models-only backends (SURVEY §2.3):
   optional bucket/prefix/endpoint). Gated on ``boto3`` being importable
   (it is not baked into every image); tests and air-gapped deployments
   can inject any duck-typed client via ``config["client"]``.
-- ``DFSModels`` — reference storage/hdfs/.../HDFSModels.scala:31 (Hadoop
-  FileSystem read/write). There is no JVM Hadoop client here; the
-  TPU-native equivalent is a POSIX-mounted distributed filesystem (HDFS
-  fuse mount, GCS fuse, NFS) addressed by ``path``.
+- the ``hdfs`` source — reference storage/hdfs/.../HDFSModels.scala:31
+  (Hadoop FileSystem read/write). Two client modes, chosen by config:
+  ``NAMENODE`` set -> ``WebHDFSModels``, a real DFS client speaking the
+  WebHDFS REST protocol (the HTTP API every Hadoop namenode exposes)
+  with the stdlib only; ``PATH`` alone -> ``DFSModels`` on a
+  POSIX-mounted distributed filesystem (hdfs-fuse, gcsfuse, NFS).
 """
 
 from __future__ import annotations
+
+import json as _json
+import urllib.error
+import urllib.parse
+import urllib.request
 
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.localfs import LocalFSModels, LocalFSStorageClient
 
 
 class DFSStorageClient(LocalFSStorageClient):
-    """Models on a mounted distributed filesystem (hdfs-backend analog)."""
+    """Models on a mounted distributed filesystem (hdfs mount mode)."""
 
     def __init__(self, config: dict | None = None):
         config = dict(config or {})
         if "path" not in config:
             raise ValueError(
-                "hdfs storage source needs PATH: the mount point of the "
-                "distributed filesystem (e.g. an hdfs-fuse or gcsfuse dir)"
+                "hdfs storage source needs NAMENODE (WebHDFS endpoint) or "
+                "PATH (a mounted-DFS dir, e.g. hdfs-fuse or gcsfuse)"
             )
         super().__init__(config)
 
 
 class DFSModels(LocalFSModels):
     pass
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *args, **kwargs):  # pragma: no cover - trivial
+        return None
+
+
+class WebHDFSStorageClient:
+    """WebHDFS REST client (Models only) — the actual HDFS wire protocol.
+
+    Ops used: CREATE (overwrite) and OPEN with the protocol's two-step
+    namenode->datanode redirect (the first hop carries NO body; the data
+    flows only to the redirect target), DELETE, MKDIRS. Matches the
+    reference's Hadoop ``FileSystem`` usage (HDFSModels.scala:31-60) over
+    HTTP instead of the JVM RPC stack.
+
+    Config: ``NAMENODE`` host:port or http[s] URL (required), ``PATH``
+    base dir (default /pio/models), ``USER`` -> ``user.name`` query
+    param, ``TIMEOUT`` seconds per request.
+    """
+
+    def __init__(self, config: dict | None = None):
+        cfg = dict(config or {})
+        nn = cfg.get("namenode")
+        if not nn:
+            raise ValueError("webhdfs client needs NAMENODE")
+        if not nn.startswith(("http://", "https://")):
+            nn = "http://" + nn
+        self.config = cfg
+        self.base = nn.rstrip("/") + "/webhdfs/v1"
+        self.path = "/" + str(cfg.get("path", "/pio/models")).strip("/")
+        self.user = cfg.get("user")
+        self.timeout = float(cfg.get("timeout", 30))
+        self._opener = urllib.request.build_opener(_NoRedirect())
+        self._base_dir_made = False
+
+    def _url(self, path: str, op: str, **params: str) -> str:
+        q = {"op": op, **params}
+        if self.user:
+            q["user.name"] = self.user
+        return (
+            self.base
+            + urllib.parse.quote(path)
+            + "?"
+            + urllib.parse.urlencode(q)
+        )
+
+    def _open(self, req: urllib.request.Request):
+        """(status, headers, body) — redirects surface as plain statuses."""
+        try:
+            with self._opener.open(req, timeout=self.timeout) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code in (301, 302, 307):
+                return e.code, dict(e.headers), e.read()
+            raise
+
+    def op(
+        self,
+        method: str,
+        path: str,
+        opname: str,
+        data: bytes | None = None,
+        **params: str,
+    ):
+        """One WebHDFS operation, following at most one redirect. Data-
+        carrying ops (CREATE) require the redirect: the namenode names the
+        datanode to stream to, and only that second request has a body."""
+        status, headers, body = self._open(
+            urllib.request.Request(
+                self._url(path, opname, **params), method=method
+            )
+        )
+        if status in (301, 302, 307):
+            status, headers, body = self._open(
+                urllib.request.Request(
+                    headers["Location"], data=data, method=method
+                )
+            )
+            if status in (301, 302, 307):
+                # a second redirect (e.g. an http->https upgrade proxy) is
+                # outside the protocol's one-hop dance; treating it as
+                # success would report writes that never stored
+                raise RuntimeError(
+                    f"WebHDFS {opname}: datanode hop answered with another "
+                    f"redirect ({status} -> {headers.get('Location')})"
+                )
+        elif data is not None:
+            raise RuntimeError(
+                f"WebHDFS {opname} returned {status} without the expected "
+                "datanode redirect; refusing to treat the write as stored"
+            )
+        return status, body
+
+    def _ensure_base_dir(self) -> None:
+        if self._base_dir_made:
+            return
+        self.op("PUT", self.path, "MKDIRS")
+        self._base_dir_made = True
+
+    def put_bytes(self, path: str, data: bytes) -> None:
+        self._ensure_base_dir()
+        self.op("PUT", path, "CREATE", data=data, overwrite="true")
+
+    def get_bytes(self, path: str) -> bytes | None:
+        try:
+            _, body = self.op("GET", path, "OPEN")
+            return body
+        except urllib.error.HTTPError as e:
+            if e.code == 404:  # RemoteException: FileNotFoundException
+                return None
+            raise
+
+    def delete(self, path: str) -> bool:
+        try:
+            _, body = self.op("DELETE", path, "DELETE")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
+        try:
+            return bool(_json.loads(body)["boolean"])
+        except (ValueError, KeyError):
+            return False
+
+
+class WebHDFSModels(base.Models):
+    def __init__(self, client: WebHDFSStorageClient):
+        self._c = client
+
+    def _path(self, model_id: str) -> str:
+        # quote the id so arbitrary ids stay one path segment (injective,
+        # like the localfs id encoding)
+        return (
+            f"{self._c.path}/pio_model_"
+            f"{urllib.parse.quote(model_id, safe='')}.bin"
+        )
+
+    def insert(self, model: base.Model) -> None:
+        self._c.put_bytes(self._path(model.id), model.models)
+
+    def get(self, model_id: str) -> base.Model | None:
+        data = self._c.get_bytes(self._path(model_id))
+        return None if data is None else base.Model(model_id, data)
+
+    def delete(self, model_id: str) -> bool:
+        return self._c.delete(self._path(model_id))
+
+
+def dfs_storage_client(config: dict | None = None):
+    """hdfs source dispatcher: NAMENODE -> WebHDFS REST client, PATH
+    alone -> POSIX mount client."""
+    if (config or {}).get("namenode"):
+        return WebHDFSStorageClient(config)
+    return DFSStorageClient(config)
+
+
+def dfs_models(client) -> base.Models:
+    if isinstance(client, WebHDFSStorageClient):
+        return WebHDFSModels(client)
+    return DFSModels(client)
 
 
 class S3StorageClient:

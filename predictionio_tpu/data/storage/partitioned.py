@@ -124,10 +124,17 @@ class PartitionedEvents(base.Events):
 
         Cached per client (the count is immutable once created), so the
         hot write/read paths don't take the client lock or touch disk."""
+        meta = ns / "_meta.json"
         n = self._c.ns_partitions.get(str(ns))
         if n is not None:
-            return n
-        meta = ns / "_meta.json"
+            # one stat per op: if another process removed the namespace,
+            # the cached count must not let writes recreate data dirs
+            # without a meta file (the slow path re-publishes meta first,
+            # so the first-writer-wins invariant holds for the new life)
+            if meta.exists():
+                return n
+            with self._c.lock:
+                self._c.ns_partitions.pop(str(ns), None)
         with self._c.lock:
             if not meta.exists():
                 ns.mkdir(parents=True, exist_ok=True)
@@ -359,12 +366,20 @@ class PartitionedEvents(base.Events):
 
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:
         ns = self._ns_dir(app_id, channel_id)
-        with self._c.lock:
+        if not ns.exists():
+            return False
+        n = self._n_partitions(ns)
+        # hold every partition lock so an in-flight writer can't recreate
+        # files mid-rmtree; a writer arriving AFTER the remove recreates
+        # the namespace by design (insert auto-creates, and its
+        # _n_partitions re-publishes _meta.json first)
+        with self._locked_all(ns, n):
             existed = ns.exists()
             if existed:
                 shutil.rmtree(ns)
-            self._c.clean_stat.pop(ns, None)
-            self._c.ns_partitions.pop(str(ns), None)
+            with self._c.lock:
+                self._c.clean_stat.pop(ns, None)
+                self._c.ns_partitions.pop(str(ns), None)
         return existed
 
     def _append_locked(self, pdir: Path, blob: bytes) -> None:
@@ -436,8 +451,17 @@ class PartitionedEvents(base.Events):
         for pp, lines in per_part.items():
             pdir = self._pdir(ns, pp)
             with self._locked(pdir):
-                for eid in per_part_x.get(pp, ()):
-                    self._log_supersede_locked(pdir, "X", eid)
+                xids = per_part_x.get(pp)
+                if xids:
+                    # one write+fsync for the partition's whole entry
+                    # batch (still BEFORE the data append — see
+                    # _log_supersede_locked for the crash ordering)
+                    with open(pdir / "supersede.log", "a") as f:
+                        f.write(
+                            "".join(f"X {eid}\n" for eid in xids)
+                        )
+                        f.flush()
+                        os.fsync(f.fileno())
                 self._append_locked(pdir, b"".join(lines))
                 self._maybe_seal_locked(pdir)
         return ids
@@ -680,16 +704,6 @@ class PartitionedEvents(base.Events):
 
     # -- columnar bulk read ------------------------------------------------
 
-    def _all_files(self, ns: Path, n: int) -> list[Path]:
-        files: list[Path] = []
-        for pp in range(n):
-            pdir = self._pdir(ns, pp)
-            files.extend(self._segments(pdir))
-            active = pdir / "active.jsonl"
-            if active.exists():
-                files.append(active)
-        return files
-
     def scan_ratings(
         self,
         app_id: int,
@@ -715,17 +729,27 @@ class PartitionedEvents(base.Events):
             return base.RatingsBatch.empty()
         n = self._n_partitions(ns)
 
-        def read_all_locked() -> tuple[bytes, tuple]:
-            parts: list[bytes] = []
+        def read_all_locked() -> tuple[list[bytes], tuple]:
+            """Per-partition concatenated buffers + the store-wide stat
+            key (per-partition so dirt can be localized)."""
+            pbufs: list[bytes] = []
             stats = []
-            for path in self._all_files(ns, n):
-                b = path.read_bytes()
-                if b and not b.endswith(b"\n"):
-                    b += b"\n"
-                st = path.stat()
-                stats.append((str(path), st.st_mtime_ns, st.st_size))
-                parts.append(b)
-            return b"".join(parts), tuple(stats)
+            for pp in range(n):
+                pdir = self._pdir(ns, pp)
+                parts: list[bytes] = []
+                files = list(self._segments(pdir))
+                active = pdir / "active.jsonl"
+                if active.exists():
+                    files.append(active)
+                for path in files:
+                    b = path.read_bytes()
+                    if b and not b.endswith(b"\n"):
+                        b += b"\n"
+                    st = path.stat()
+                    stats.append((str(path), st.st_mtime_ns, st.st_size))
+                    parts.append(b)
+                pbufs.append(b"".join(parts))
+            return pbufs, tuple(stats)
 
         # the whole prove -> compact -> re-read sequence holds every
         # partition lock: a writer cannot slip a duplicate id or delete
@@ -733,19 +757,50 @@ class PartitionedEvents(base.Events):
         # this scan) trusts — which also makes recording the post-compact
         # state clean sound in degraded no-native mode, where uniqueness
         # is unprovable but compaction just restored it by construction
+        cross_partition_dupes = False
         with self._locked_all(ns, n):
-            buf, stat_key = read_all_locked()
+            pbufs, stat_key = read_all_locked()
+            buf = b"".join(pbufs)
             scanned = None
             if not (buf and self._c.clean_stat.get(ns) == stat_key):
                 needs_compact, scanned = prove_clean(buf)
                 if needs_compact:
+                    # ids route deterministically to one partition, so
+                    # dirt is per-partition: rewrite only the partitions
+                    # that are themselves unclean (degraded mode can't
+                    # prove any, so it compacts all — by design)
                     for pp in range(n):
-                        self._compact_partition_locked(self._pdir(ns, pp))
-                    buf, stat_key = read_all_locked()
+                        if prove_clean(pbufs[pp])[0]:
+                            self._compact_partition_locked(
+                                self._pdir(ns, pp)
+                            )
+                    pbufs, stat_key = read_all_locked()
+                    buf = b"".join(pbufs)
                     scanned = None
-            if buf:
+                    if native.native_available():
+                        needs_compact, scanned = prove_clean(buf)
+                        # still unclean with every partition individually
+                        # clean => duplicate ids ACROSS partitions (a
+                        # broken routing invariant, e.g. a partition
+                        # count changed out from under the data):
+                        # compaction cannot fix that — serve the exact
+                        # fold-based read instead of double-counting
+                        cross_partition_dupes = needs_compact
+            if buf and not cross_partition_dupes:
                 with self._c.lock:
                     self._c.clean_stat[ns] = stat_key
+        if cross_partition_dupes:  # pragma: no cover - invariant breach
+            return base.Events.scan_ratings(
+                self,
+                app_id,
+                channel_id,
+                event_names=event_names,
+                entity_type=entity_type,
+                target_entity_type=target_entity_type,
+                rating_key=rating_key,
+                default_ratings=default_ratings,
+                override_ratings=override_ratings,
+            )
         users, items, rows, cols, vals = native.load_ratings_jsonl(
             buf,
             event_names=list(event_names) if event_names is not None else None,

@@ -358,6 +358,23 @@ class TestScanRatings:
         )
         assert len(fast) == 30 - len(victims)
 
+    def test_only_dirty_partition_compacted(self, dao, monkeypatch):
+        """One delete dirties one partition; the scan must not rewrite
+        the other, clean partitions."""
+        self._load(dao)
+        victim = dao.find(APP, entity_id="u0", limit=1)[0].event_id
+        dao.delete(victim, APP)
+        compacted = []
+        orig = PartitionedEvents._compact_partition_locked
+        monkeypatch.setattr(
+            PartitionedEvents, "_compact_partition_locked",
+            lambda self, pdir: compacted.append(pdir.name)
+            or orig(self, pdir),
+        )
+        got = dao.scan_ratings(APP, event_names=["rate"])
+        assert len(got) == 29
+        assert compacted == [f"p{int(victim[:2], 16):02x}"]
+
     def test_degraded_mode_compacts_once_not_per_read(self, dao, monkeypatch):
         """Pure-Python mode can't prove id uniqueness, so the first scan
         compacts; the clean-stat cache must stop every later scan from
