@@ -23,6 +23,7 @@ with extras covering the whole story:
 Section failures degrade to an "error" entry instead of killing the run.
 Env knobs: BENCH_SCALES=100k,20m  BENCH_E2E_EVENTS=20000000
 BENCH_SERVING=1  BENCH_BASELINE=1  BENCH_PEAK_FLOPS=1.97e14
+BENCH_RANK_SWEEP=128  BENCH_E2E_BACKEND=jsonl|partitioned
 """
 
 from __future__ import annotations
@@ -55,6 +56,13 @@ RUN_SCALES = [
 RUN_CPU_BASELINE = os.environ.get("BENCH_BASELINE", "1") == "1"
 RUN_SERVING = os.environ.get("BENCH_SERVING", "1") == "1"
 E2E_EVENTS = int(os.environ.get("BENCH_E2E_EVENTS", "20000000"))
+# high-rank MFU sweep at the 20m scale (comma list; empty disables)
+RANK_SWEEP = [
+    int(r) for r in os.environ.get("BENCH_RANK_SWEEP", "128").split(",") if r
+]
+# event backend for the e2e import->train section: jsonl (default) or
+# partitioned (the scalable hash-partitioned store)
+E2E_BACKEND = os.environ.get("BENCH_E2E_BACKEND", "jsonl")
 # v5e bf16 MXU peak per chip; the f32 path (precision HIGHEST) runs
 # multiple bf16 passes, so bf16 peak is the honest shared denominator
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", "1.97e14"))
@@ -165,19 +173,19 @@ def time_train(als, data, params, repeats: int):
     return sorted(times)[len(times) // 2], U, V
 
 
-def core_child(scale: str, dtype: str) -> None:
-    """Child mode (--core-child <scale> <dtype>): ONE core training
-    measurement in a fresh process. On remote-tunnel TPU attachments,
-    per-dispatch/transfer latency degrades once a process has done heavy
-    device work (measured: the same 20m f32 run is 1.1 s as the first
-    section and 15.7 s after others), so every core number comes from its
-    own process. Prints one JSON object."""
+def core_child(scale: str, dtype: str, rank: int = RANK) -> None:
+    """Child mode (--core-child <scale> <dtype> [rank]): ONE core
+    training measurement in a fresh process. On remote-tunnel TPU
+    attachments, per-dispatch/transfer latency degrades once a process
+    has done heavy device work (measured: the same 20m f32 run is 1.1 s
+    as the first section and 15.7 s after others), so every core number
+    comes from its own process. Prints one JSON object."""
     from predictionio_tpu.ops import als
 
     rows, cols, vals, num_u, num_i = make_ml_shaped(scale)
     data = als.build_ratings_data(rows, cols, vals, num_u, num_i)
     params = als.ALSParams(
-        rank=RANK, iterations=ITERATIONS, reg=REG, seed=SEED,
+        rank=rank, iterations=ITERATIONS, reg=REG, seed=SEED,
         compute_dtype=dtype,
     )
     repeats = 5 if scale == "100k" else 3
@@ -185,17 +193,20 @@ def core_child(scale: str, dtype: str) -> None:
     print(json.dumps({
         "train_s": round(tpu_s, 4),
         "rmse": round(als.rmse(U, V, rows, cols, vals), 4),
-        "model_flops": als_flops(data, RANK, ITERATIONS),
+        "model_flops": als_flops(data, rank, ITERATIONS),
     }))
 
 
-def _run_core_child(scale: str, dtype: str) -> dict:
+def _run_core_child(scale: str, dtype: str, rank: int | None = None) -> dict:
     import subprocess
     import sys
 
+    argv = [sys.executable, os.path.abspath(__file__), "--core-child", scale, dtype]
+    if rank is not None:
+        argv.append(str(rank))
     proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--core-child", scale, dtype],
-        capture_output=True, text=True, timeout=1500, env=dict(os.environ),
+        argv, capture_output=True, text=True, timeout=1500,
+        env=dict(os.environ),
     )
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
@@ -248,6 +259,23 @@ def bench_core(scale: str, extras: dict, result: dict) -> None:
             "bf16_achieved_flops_per_s": round(flops / bf["train_s"], 3),
             "bf16_mfu": round(flops / bf["train_s"] / PEAK_FLOPS, 5),
         }
+        # MXU engagement beyond the gather-bound rank-20 north star:
+        # solve/gramian FLOPs grow ~rank^2-rank^3 while the gather only
+        # grows ~rank, so high ranks show what the design sustains when
+        # the workload actually has FLOPs
+        for r in RANK_SWEEP:
+            hi = _run_core_child(scale, "float32", r)
+            extras.setdefault("rank_sweep", {})[f"rank{r}"] = {
+                "train_s": hi["train_s"],
+                "rmse": hi["rmse"],
+                "model_flops": hi["model_flops"],
+                "achieved_flops_per_s": round(
+                    hi["model_flops"] / hi["train_s"], 3
+                ),
+                "mfu": round(
+                    hi["model_flops"] / hi["train_s"] / PEAK_FLOPS, 5
+                ),
+            }
     extras[scale] = entry
 
 
@@ -473,7 +501,7 @@ def bench_e2e(extras: dict) -> None:
         # how much of it predates this section (core-scale benchmarks)
         "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024,
         "rss_before_mb": rss_before_mb,
-        "event_backend": "jsonl",
+        "event_backend": E2E_BACKEND,
     }
 
 
@@ -554,7 +582,8 @@ def main() -> None:
 
         apply_platform_env()
         i = sys.argv.index("--core-child")
-        core_child(sys.argv[i + 1], sys.argv[i + 2])
+        rank = int(sys.argv[i + 3]) if len(sys.argv) > i + 3 else RANK
+        core_child(sys.argv[i + 1], sys.argv[i + 2], rank)
         return
     from predictionio_tpu.utils import apply_platform_env
 
@@ -598,7 +627,7 @@ def main() -> None:
     os.environ["PIO_FS_BASEDIR"] = os.path.join(tmpdir, "store")
     os.environ["PIO_STORAGE_SOURCES_DB_TYPE"] = "sqlite"
     os.environ["PIO_STORAGE_SOURCES_DB_PATH"] = os.path.join(tmpdir, "pio.db")
-    os.environ["PIO_STORAGE_SOURCES_LOG_TYPE"] = "jsonl"
+    os.environ["PIO_STORAGE_SOURCES_LOG_TYPE"] = E2E_BACKEND
     os.environ["PIO_STORAGE_SOURCES_LOG_PATH"] = os.path.join(tmpdir, "events")
     os.environ["PIO_STORAGE_SOURCES_FS_TYPE"] = "localfs"
     os.environ["PIO_STORAGE_SOURCES_FS_PATH"] = os.path.join(tmpdir, "models")

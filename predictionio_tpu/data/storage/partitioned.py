@@ -119,6 +119,26 @@ class PartitionedEvents(base.Events):
         )
         return self._c.base_path / name
 
+    def _publish_meta(self, ns: Path, n: int) -> int:
+        """Atomically create ``_meta.json`` with count ``n`` unless one
+        already exists; returns the winning count."""
+        meta = ns / "_meta.json"
+        if not meta.exists():
+            ns.mkdir(parents=True, exist_ok=True)
+            # per-process-unique temp name: a shared name would let two
+            # first-initializers publish each other's half-written file
+            tmp = ns / f"_meta.json.tmp.{os.getpid()}.{uuid.uuid4().hex}"
+            tmp.write_text(json.dumps({"partitions": n}))
+            try:
+                # atomic create-if-absent: a concurrent process may have
+                # written meta between the check and now — theirs wins
+                os.link(tmp, meta)
+            except FileExistsError:
+                pass
+            finally:
+                tmp.unlink(missing_ok=True)
+        return int(json.loads(meta.read_text())["partitions"])
+
     def _n_partitions(self, ns: Path) -> int:
         """Partition count for a namespace: the persisted value wins.
 
@@ -136,26 +156,26 @@ class PartitionedEvents(base.Events):
             with self._c.lock:
                 self._c.ns_partitions.pop(str(ns), None)
         with self._c.lock:
-            if not meta.exists():
-                ns.mkdir(parents=True, exist_ok=True)
-                # per-process-unique temp name: a shared name would let two
-                # first-initializers publish each other's half-written file
-                tmp = ns / f"_meta.json.tmp.{os.getpid()}.{uuid.uuid4().hex}"
-                tmp.write_text(
-                    json.dumps({"partitions": self._c.partitions})
-                )
-                try:
-                    # atomic create-if-absent: a concurrent process may
-                    # have written meta between the check and now — theirs
-                    # wins
-                    os.link(tmp, meta)
-                except FileExistsError:
-                    pass
-                finally:
-                    tmp.unlink(missing_ok=True)
-            n = int(json.loads(meta.read_text())["partitions"])
+            n = self._publish_meta(ns, self._c.partitions)
             self._c.ns_partitions[str(ns)] = n
             return n
+
+    def _ensure_meta_locked(self, ns: Path, n: int) -> None:
+        """Write-site guard, called under the partition lock: a remove()
+        that raced in between routing and locking left no ``_meta.json``
+        — republish it with the count THIS write routed by, so the
+        namespace's new life keeps a meta consistent with its first
+        record. If another writer republished a different count first,
+        our routing is stale: refuse rather than misroute."""
+        won = self._publish_meta(ns, n)
+        if won != n:
+            with self._c.lock:
+                self._c.ns_partitions.pop(str(ns), None)
+            raise RuntimeError(
+                f"event namespace {ns.name} was recreated with "
+                f"{won} partitions while a write routed by {n} was in "
+                "flight; retry the write"
+            )
 
     def _pdir(self, ns: Path, pp: int) -> Path:
         d = ns / f"p{pp:02x}"
@@ -366,21 +386,25 @@ class PartitionedEvents(base.Events):
 
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:
         ns = self._ns_dir(app_id, channel_id)
-        if not ns.exists():
-            return False
-        n = self._n_partitions(ns)
-        # hold every partition lock so an in-flight writer can't recreate
-        # files mid-rmtree; a writer arriving AFTER the remove recreates
-        # the namespace by design (insert auto-creates, and its
-        # _n_partitions re-publishes _meta.json first)
-        with self._locked_all(ns, n):
-            existed = ns.exists()
-            if existed:
+        # the client lock serializes in-process removers (the second one
+        # sees the namespace gone and returns False); the partition locks
+        # below serialize against writers in other processes
+        with self._c.lock:
+            if not ns.exists():
+                return False
+            n = self._n_partitions(ns)
+            # hold every partition lock so an in-flight writer can't
+            # recreate files mid-rmtree; a writer arriving AFTER the
+            # remove recreates the namespace by design (insert
+            # auto-creates, republishing _meta.json first). _locked_all
+            # itself recreates the partition dirs, so "did it exist" is
+            # answered by the meta file, not the directory.
+            with self._locked_all(ns, n):
+                had_meta = (ns / "_meta.json").exists()
                 shutil.rmtree(ns)
-            with self._c.lock:
                 self._c.clean_stat.pop(ns, None)
                 self._c.ns_partitions.pop(str(ns), None)
-        return existed
+        return had_meta
 
     def _append_locked(self, pdir: Path, blob: bytes) -> None:
         with open(pdir / "active.jsonl", "ab") as f:
@@ -388,9 +412,12 @@ class PartitionedEvents(base.Events):
             f.flush()
             os.fsync(f.fileno())
 
-    def _log_supersede_locked(self, pdir: Path, tag: str, eid: str) -> None:
+    def _log_supersede_locked(
+        self, pdir: Path, tag: str, eids: Sequence[str]
+    ) -> None:
+        """One write+fsync for the whole entry batch."""
         with open(pdir / "supersede.log", "a") as f:
-            f.write(f"{tag} {eid}\n")
+            f.write("".join(f"{tag} {eid}\n" for eid in eids))
             f.flush()
             # fsync BEFORE the data append's fsync: if the record survives
             # a crash its supersede entry must too, or a later sealed
@@ -415,8 +442,9 @@ class PartitionedEvents(base.Events):
         pdir = self._pdir(ns, pp)
         line = (json.dumps(e.to_dict(for_api=False)) + "\n").encode()
         with self._locked(pdir):
+            self._ensure_meta_locked(ns, n)
             if explicit:
-                self._log_supersede_locked(pdir, "X", event_id)
+                self._log_supersede_locked(pdir, "X", [event_id])
             self._append_locked(pdir, line)
             self._maybe_seal_locked(pdir)
         return event_id
@@ -451,17 +479,10 @@ class PartitionedEvents(base.Events):
         for pp, lines in per_part.items():
             pdir = self._pdir(ns, pp)
             with self._locked(pdir):
+                self._ensure_meta_locked(ns, n)
                 xids = per_part_x.get(pp)
                 if xids:
-                    # one write+fsync for the partition's whole entry
-                    # batch (still BEFORE the data append — see
-                    # _log_supersede_locked for the crash ordering)
-                    with open(pdir / "supersede.log", "a") as f:
-                        f.write(
-                            "".join(f"X {eid}\n" for eid in xids)
-                        )
-                        f.flush()
-                        os.fsync(f.fileno())
+                    self._log_supersede_locked(pdir, "X", xids)
                 self._append_locked(pdir, b"".join(lines))
                 self._maybe_seal_locked(pdir)
         return ids
@@ -513,6 +534,7 @@ class PartitionedEvents(base.Events):
         for pp, lines in per_part.items():
             pdir = self._pdir(ns, pp)
             with self._locked(pdir):
+                self._ensure_meta_locked(ns, n)
                 active = pdir / "active.jsonl"
                 nonempty = (
                     active.exists() and active.stat().st_size > 0
@@ -538,11 +560,13 @@ class PartitionedEvents(base.Events):
         ns = self._ns_dir(app_id, channel_id)
         if not ns.exists():
             return False
-        pdir = self._pdir(ns, self._route(event_id, self._n_partitions(ns)))
+        n = self._n_partitions(ns)
+        pdir = self._pdir(ns, self._route(event_id, n))
         with self._locked(pdir):
             if event_id not in self._replay_partition(pdir, None):
                 return False
-            self._log_supersede_locked(pdir, "D", event_id)
+            self._ensure_meta_locked(ns, n)
+            self._log_supersede_locked(pdir, "D", [event_id])
             self._append_locked(
                 pdir, (json.dumps({"$delete": event_id}) + "\n").encode()
             )
@@ -767,10 +791,13 @@ class PartitionedEvents(base.Events):
                 if needs_compact:
                     # ids route deterministically to one partition, so
                     # dirt is per-partition: rewrite only the partitions
-                    # that are themselves unclean (degraded mode can't
-                    # prove any, so it compacts all — by design)
+                    # that are themselves unclean. Degraded mode can't
+                    # prove any partition clean — skip the (whole-store)
+                    # per-partition re-scan and compact everything.
                     for pp in range(n):
-                        if prove_clean(pbufs[pp])[0]:
+                        if not native.native_available() or prove_clean(
+                            pbufs[pp]
+                        )[0]:
                             self._compact_partition_locked(
                                 self._pdir(ns, pp)
                             )

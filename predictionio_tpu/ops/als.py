@@ -274,6 +274,50 @@ def solve_bucket_implicit(
     return _psd_solve(A, b)
 
 
+def _gramian_rhs_gathered(factors_other, col_ids, w, r, dt, budget_bytes):
+    """Gather ``factors_other[col_ids]`` and reduce it to (A, b) per
+    batch row, bounding the [B, K, D] gather temp to ``budget_bytes``.
+
+    Under the budget this is exactly gather + ``_gramian_rhs`` (the XLA
+    fusion the module relies on). Over it — wide buckets at high rank,
+    where B*K*D would blow HBM (measured: ML-20M rank 128 needs a 21.7G
+    program unchunked on a 16G v5e) — the batch dim is processed in
+    ``lax.map`` chunks: each chunk's gather+gramian lives only for that
+    scan step, so the resident temp is one chunk. Shapes are static, so
+    the choice costs nothing at runtime.
+    """
+    B, K = col_ids.shape
+    D = factors_other.shape[1]
+    if B * K * D * jnp.dtype(dt).itemsize <= budget_bytes or B <= 1:
+        vg = factors_other[col_ids].astype(dt)
+        return _gramian_rhs(vg, w, r)
+    rows_per_chunk = max(1, budget_bytes // (K * D * jnp.dtype(dt).itemsize))
+    n_chunks = -(-B // rows_per_chunk)
+    pad = n_chunks * rows_per_chunk - B
+    # padded rows gather factor row 0 with zero weight -> A = 0, b = 0;
+    # sliced off below before regularization sees them
+    ci = jnp.pad(col_ids, ((0, pad), (0, 0)))
+    wp = jnp.pad(w, ((0, pad), (0, 0)))
+    rp = jnp.pad(r, ((0, pad), (0, 0)))
+
+    def one_chunk(chunk):
+        c_ids, c_w, c_r = chunk
+        return _gramian_rhs(factors_other[c_ids].astype(dt), c_w, c_r)
+
+    A, b = jax.lax.map(
+        one_chunk,
+        (
+            ci.reshape(n_chunks, rows_per_chunk, K),
+            wp.reshape(n_chunks, rows_per_chunk, K),
+            rp.reshape(n_chunks, rows_per_chunk, K),
+        ),
+    )
+    return (
+        A.reshape(n_chunks * rows_per_chunk, D, D)[:B],
+        b.reshape(n_chunks * rows_per_chunk, D)[:B],
+    )
+
+
 def _gramian_rhs(vg, w, r):
     """Fused ``A = vg^T diag(w) vg`` and ``b = vg^T r`` per batch row.
 
@@ -351,6 +395,15 @@ class ALSParams:
     seed: int = 7
     compute_dtype: str = "float32"
     bucket_widths: tuple[int, ...] = DEFAULT_BUCKETS
+    # HBM budget for one bucket's [B, K, D] factor-gather temp: buckets
+    # whose gather would exceed it are solved in lax.map chunks over the
+    # batch dim instead of one materialization (static shapes, so this is
+    # a trace-time decision; programs under the budget are unchanged).
+    # 2 GiB keeps every ML-20M rank-20 bucket on the unchunked path
+    # (largest gather there: 1.74 GiB — the measured-good north-star
+    # program is untouched) while rank-64/128 buckets (2.6-11.2 GiB
+    # unchunked, which OOM a 16-GiB v5e) get chunked.
+    gather_chunk_bytes: int = 2 << 30
 
 
 def init_factors(num: int, rank: int, key, scale: float | None = None):
@@ -416,17 +469,17 @@ def _solve_bucket_inline(
     alpha = params.alpha if alpha is None else alpha
     D = factors_other.shape[1]
     dt = jnp.dtype(params.compute_dtype)
-    vg = factors_other[col_ids].astype(dt)
     if params.implicit:
-        conf_minus_1 = (alpha * ratings * mask).astype(dt)
-        rhs_w = ((1.0 + alpha * ratings) * mask).astype(dt)
-        A, b = _gramian_rhs(vg, conf_minus_1, rhs_w)
+        w = (alpha * ratings * mask).astype(dt)
+        r = ((1.0 + alpha * ratings) * mask).astype(dt)
         weighted = params.implicit_weighted_reg
     else:
         w = mask.astype(dt)
         r = (ratings * mask).astype(dt)
-        A, b = _gramian_rhs(vg, w, r)
         weighted = params.weighted_reg
+    A, b = _gramian_rhs_gathered(
+        factors_other, col_ids, w, r, dt, params.gather_chunk_bytes
+    )
     n = mask.sum(axis=1)
     if seg_row is not None:
         R = num_solved_rows
@@ -637,6 +690,13 @@ def predict_pairs(U, V, rows: np.ndarray, cols: np.ndarray):
     return jnp.sum(U[jnp.asarray(rows)] * V[jnp.asarray(cols)], axis=-1)
 
 
-def rmse(U, V, rows, cols, vals) -> float:
-    pred = predict_pairs(U, V, rows, cols)
-    return float(jnp.sqrt(jnp.mean((pred - jnp.asarray(vals)) ** 2)))
+def rmse(U, V, rows, cols, vals, chunk: int = 4_000_000) -> float:
+    """Chunked over the pair dim: the [N, D] gathers of ``predict_pairs``
+    at N=2*10^7, D=128 would alone exceed a v5e's HBM."""
+    n = len(vals)
+    total = 0.0
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        pred = predict_pairs(U, V, rows[lo:hi], cols[lo:hi])
+        total += float(jnp.sum((pred - jnp.asarray(vals[lo:hi])) ** 2))
+    return float(np.sqrt(total / n))
