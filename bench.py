@@ -55,6 +55,7 @@ RUN_SCALES = [
 ]
 RUN_CPU_BASELINE = os.environ.get("BENCH_BASELINE", "1") == "1"
 RUN_SERVING = os.environ.get("BENCH_SERVING", "1") == "1"
+RUN_INGEST = os.environ.get("BENCH_INGEST", "1") == "1"
 E2E_EVENTS = int(os.environ.get("BENCH_E2E_EVENTS", "20000000"))
 # high-rank MFU sweep at the 20m scale (comma list; empty disables)
 RANK_SWEEP = [
@@ -431,6 +432,69 @@ def bench_serving(extras: dict) -> None:
         server.stop()
 
 
+def bench_ingest(extras: dict) -> None:
+    """Event-server HTTP ingest throughput: concurrent POST
+    /batch/events.json at the reference's 50-events/request cap
+    (EventServer.scala:70,390) into the configured event backend, plus
+    the single-event path. The reference's spray/akka server is the
+    component being matched."""
+    import concurrent.futures
+
+    from predictionio_tpu.data.storage import AccessKey, App, get_storage
+    from predictionio_tpu.server.event_server import EventServer
+
+    storage = get_storage()
+    app_id = storage.get_metadata_apps().insert(App(0, "BenchIngest"))
+    key = storage.get_metadata_access_keys().insert(AccessKey("", app_id, []))
+    storage.get_events().init(app_id)
+    server = EventServer(storage=storage, host="127.0.0.1", port=0)
+    port = server.start(background=True)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        def batch_payload(i: int) -> list[dict]:
+            return [
+                {
+                    "event": "rate", "entityType": "user",
+                    "entityId": f"u{i}_{j}", "targetEntityType": "item",
+                    "targetEntityId": f"i{j % 97}",
+                    "properties": {"rating": float(j % 5 + 1)},
+                    "eventTime": "2020-01-01T00:00:00.000Z",
+                }
+                for j in range(50)
+            ]
+
+        # warmup
+        _post_json(f"{url}/batch/events.json?accessKey={key}", batch_payload(-1))
+
+        n_batches, workers = 200, 8
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+            list(pool.map(
+                lambda i: _post_json(
+                    f"{url}/batch/events.json?accessKey={key}",
+                    batch_payload(i),
+                ),
+                range(n_batches),
+            ))
+        batch_s = time.perf_counter() - t0
+
+        n_single = 300
+        singles = [batch_payload(10_000 + j)[0] for j in range(n_single)]
+        t0 = time.perf_counter()
+        for payload in singles:
+            _post_json(f"{url}/events.json?accessKey={key}", payload)
+        single_s = time.perf_counter() - t0
+        extras["ingest"] = {
+            "batch_events_per_s": round(n_batches * 50 / batch_s),
+            "batch_workers": workers,
+            "batch_size": 50,
+            "single_events_per_s": round(n_single / single_s),
+            "event_backend": E2E_BACKEND,
+        }
+    finally:
+        server.stop()
+
+
 def bench_e2e(extras: dict) -> None:
     """import -> train through the whole framework at event-store scale:
     splice import into the jsonl log, columnar native scan, fused device
@@ -681,6 +745,13 @@ def main() -> None:
         except Exception as e:
             extras["serving"] = {"error": f"{type(e).__name__}: {e}"}
         _mark("serving")
+
+    if RUN_INGEST:
+        try:
+            bench_ingest(extras)
+        except Exception as e:
+            extras["ingest"] = {"error": f"{type(e).__name__}: {e}"}
+        _mark("ingest")
 
     if E2E_EVENTS > 0:
         try:

@@ -197,7 +197,7 @@ class TestTimePrunedScans:
         # simulate the crash: the supersede entry exists, the replacement
         # record does not
         with dao._locked(pdir):
-            dao._log_supersede_locked(pdir, "X", eid)
+            dao._log_supersede_locked(pdir, "X", [eid])
         for i in range(40):
             dao.insert(_event(901 + i, entity="hot"), APP)
         with dao._locked(pdir):
@@ -426,3 +426,61 @@ class TestRegistryIntegration:
         assert ev.get(eid, APP) is not None
         assert s.verify_all_data_objects()
         s.close()
+
+
+class TestCrossProcess:
+    def test_writer_vs_compact_and_scan_across_processes(self, tmp_path):
+        """A writer in another OS process must not lose records to
+        concurrent compaction (which rewrites segments) or columnar
+        scans (which may trigger compaction) — the flock protocol."""
+        import subprocess
+        import sys
+        import textwrap
+
+        cfg = {
+            "path": str(tmp_path / "xp"), "partitions": 4,
+            "segment_bytes": 800,
+        }
+        dao = PartitionedEvents(PartitionedStorageClient(cfg))
+        dao.init(APP)
+        n_child = 200
+        child = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                textwrap.dedent(
+                    f"""
+                    from predictionio_tpu.data.storage.partitioned import (
+                        PartitionedEvents, PartitionedStorageClient)
+                    from predictionio_tpu.data.event import Event
+                    ev = PartitionedEvents(PartitionedStorageClient({cfg!r}))
+                    for i in range({n_child}):
+                        ev.insert(Event(event="rate", entity_type="user",
+                                        entity_id=f"c{{i}}",
+                                        target_entity_type="item",
+                                        target_entity_id=f"i{{i % 7}}",
+                                        properties={{"rating": 3.0}}), {APP})
+                    """
+                ),
+            ],
+        )
+        # compact + columnar-scan continuously while the child appends;
+        # bounded so a flock-protocol deadlock fails cleanly instead of
+        # hanging the suite
+        import time as _time
+
+        deadline = _time.monotonic() + 60
+        try:
+            while child.poll() is None:
+                if _time.monotonic() > deadline:
+                    raise AssertionError("writer child hung (>60s)")
+                dao.compact(APP)
+                dao.scan_ratings(APP, event_names=["rate"])
+        finally:
+            if child.poll() is None:
+                child.kill()
+            child.wait(timeout=10)
+        assert child.returncode == 0
+        assert len(dao.find(APP)) == n_child
+        batch = dao.scan_ratings(APP, event_names=["rate"])
+        assert len(batch) == n_child
