@@ -26,9 +26,12 @@
 #include <cctype>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
+#include <vector>
 
 extern "C" {
 
@@ -202,35 +205,27 @@ bool parse_uint(const char*& p, const char* end, int digits, long* out) {
 
 extern "C" {
 
-// Scan newline-delimited JSON events. offs/lens are [capacity*PIO_N_FIELDS]
-// int64 arrays (-1 offset = field absent); flags is [capacity] bytes.
-// String field spans exclude quotes; properties spans include braces.
-// Returns the number of lines consumed (including blank/fallback lines),
-// or -1 if capacity was exceeded.
-long pio_scan_events(const char* buf, long buflen, int64_t* offs,
-                     int64_t* lens, uint8_t* flags, long capacity) {
-  long line = 0;
-  const char* p = buf;
-  const char* bufend = buf + buflen;
-  while (p < bufend) {
-    const char* nl = (const char*)memchr(p, '\n', (size_t)(bufend - p));
-    const char* line_end = nl ? nl : bufend;
-    if (line >= capacity) return -1;
+}  // extern "C"
 
-    int64_t* lo = offs + line * PIO_N_FIELDS;
-    int64_t* ll = lens + line * PIO_N_FIELDS;
+namespace {
+
+// One line's field-span extraction (shared by the serial and threaded
+// scan paths; writes exactly the [PIO_N_FIELDS] row for this line).
+void scan_one_line(const char* buf, const char* p, const char* line_end,
+                   int64_t* lo, int64_t* ll, uint8_t* flag) {
+  {
     for (int f = 0; f < PIO_N_FIELDS; ++f) {
       lo[f] = -1;
       ll[f] = 0;
     }
-    flags[line] = 0;
+    *flag = 0;
 
     Cursor c{p, line_end};
     c.skip_ws();
     if (c.done()) {
-      flags[line] = PIO_FLAG_EMPTY;
+      *flag = PIO_FLAG_EMPTY;
     } else if (c.peek() != '{') {
-      flags[line] = PIO_FLAG_FALLBACK;
+      *flag = PIO_FLAG_FALLBACK;
     } else {
       ++c.p;  // past '{'
       bool ok = true;
@@ -306,12 +301,121 @@ long pio_scan_events(const char* buf, long buflen, int64_t* offs,
       // unterminated objects or trailing bytes after '}' (concatenated
       // records, truncated lines) fall back so json.loads fails loudly
       if (!ok || line_escaped || !closed || !c.done())
-        flags[line] = PIO_FLAG_FALLBACK;
+        *flag = PIO_FLAG_FALLBACK;
     }
-    ++line;
+  }
+}
+
+int scan_thread_count(long n_lines) {
+  const char* env = std::getenv("PIO_NATIVE_THREADS");
+  long requested = env ? std::atol(env) : 0;
+  if (requested > 0) {
+    // explicit override wins outright (benchmarking / tests)
+    return (int)(requested > n_lines ? (n_lines < 1 ? 1 : n_lines)
+                                     : requested);
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  long t = (long)(hw ? (hw > 8 ? 8 : hw) : 1);
+  // one thread per >=100k lines: below that, spawn cost beats the win
+  long by_work = n_lines / 100000;
+  if (t > by_work) t = by_work;
+  return (int)(t < 1 ? 1 : t);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan newline-delimited JSON events. offs/lens are [capacity*PIO_N_FIELDS]
+// int64 arrays (-1 offset = field absent); flags is [capacity] bytes.
+// String field spans exclude quotes; properties spans include braces.
+// Returns the number of lines consumed (including blank/fallback lines),
+// or -1 if capacity was exceeded.
+//
+// Large buffers scan MULTITHREADED (std::thread over line ranges; output
+// rows are disjoint, the buffer is read-only — the caller releases the
+// GIL for the whole call via ctypes): first pass indexes newlines, second
+// pass extracts field spans in parallel. PIO_NATIVE_THREADS overrides the
+// thread count (default: min(cores, 8), scaled down for small inputs).
+long pio_scan_events(const char* buf, long buflen, int64_t* offs,
+                     int64_t* lens, uint8_t* flags, long capacity) {
+  // pass 1: line starts (cheap memchr sweep); line ends are derived —
+  // ends[i] = starts[i+1] - 1 (the newline), last line ends at bufend
+  // unless the buffer is newline-terminated
+  std::vector<const char*> starts;
+  const char* p = buf;
+  const char* bufend = buf + buflen;
+  while (p < bufend) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(bufend - p));
+    if ((long)starts.size() >= capacity) return -1;
+    starts.push_back(p);
     p = nl ? nl + 1 : bufend;
   }
-  return line;
+  long n = (long)starts.size();
+  const char* last_end =
+      (buflen > 0 && buf[buflen - 1] == '\n') ? bufend - 1 : bufend;
+  int nthreads = scan_thread_count(n);
+  auto run = [&](long lo_line, long hi_line) {
+    for (long i = lo_line; i < hi_line; ++i) {
+      const char* line_end =
+          i + 1 < n ? starts[(size_t)i + 1] - 1 : last_end;
+      scan_one_line(buf, starts[(size_t)i], line_end,
+                    offs + i * PIO_N_FIELDS, lens + i * PIO_N_FIELDS,
+                    flags + i);
+    }
+  };
+  if (nthreads <= 1) {
+    run(0, n);
+  } else {
+    std::vector<std::thread> workers;
+    workers.reserve((size_t)nthreads);
+    long chunk = (n + nthreads - 1) / nthreads;
+    for (int t = 0; t < nthreads; ++t) {
+      long lo_line = (long)t * chunk;
+      long hi_line = lo_line + chunk < n ? lo_line + chunk : n;
+      if (lo_line >= hi_line) break;
+      workers.emplace_back(run, lo_line, hi_line);
+    }
+    for (auto& w : workers) w.join();
+  }
+  return n;
+}
+
+// Partition routing for event-id spans (mirrors PartitionedEvents._route):
+// ids shaped "<2 hex>-..." with value < n_partitions route by the embedded
+// partition; everything else by FNV-1a 32 of the id bytes mod n_partitions.
+// Spans with offset -1 get -1. Used to vectorize bulk-import routing.
+void pio_route_ids(const char* buf, const int64_t* offs, const int64_t* lens,
+                   long n, int32_t n_partitions, int32_t* out) {
+  auto hexval = [](char ch) -> int {
+    if (ch >= '0' && ch <= '9') return ch - '0';
+    if (ch >= 'a' && ch <= 'f') return ch - 'a' + 10;
+    return -1;
+  };
+  for (long i = 0; i < n; ++i) {
+    if (offs[i] < 0) {
+      out[i] = -1;
+      continue;
+    }
+    const char* s = buf + offs[i];
+    long len = lens[i];
+    if (len >= 3 && s[2] == '-') {
+      int h1 = hexval(s[0]), h2 = hexval(s[1]);
+      if (h1 >= 0 && h2 >= 0) {
+        int pp = h1 * 16 + h2;
+        if (pp < n_partitions) {
+          out[i] = pp;
+          continue;
+        }
+      }
+    }
+    uint32_t h = 2166136261u;
+    for (long j = 0; j < len; ++j) {
+      h ^= (uint8_t)s[j];
+      h *= 16777619u;
+    }
+    out[i] = (int32_t)(h % (uint32_t)n_partitions);
+  }
 }
 
 // Dense-index string spans (BiMap.stringInt analog): idx[i] gets the dense

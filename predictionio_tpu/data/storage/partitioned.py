@@ -11,11 +11,13 @@ servers:
 
 - **Hash-spread writes.** Each (app, channel) namespace is split into P
   independent partition logs. Generated event ids embed their partition
-  (``<pp>-<uuid>`` with pp = MD5("entityType:entityId") % P), so an entity's
-  generated events co-locate (the HBase row-prefix rule) and every point op
-  addresses exactly one partition; ingest across entities fans out over P
-  uncontended locks. Explicit foreign ids route by MD5 of the id itself, so
-  a replacement always lands in the same partition as the original.
+  (``<pp>-<uuid>`` with pp = FNV-1a("entityType:entityId") % P), so an
+  entity's generated events co-locate (the HBase row-prefix rule) and every
+  point op addresses exactly one partition; ingest across entities fans out
+  over P uncontended locks. Explicit foreign ids route by FNV-1a of the id
+  itself (``native.route_id_bytes``; the hash is recorded in ``_meta.json``
+  and verified on open), so a replacement always lands in the same
+  partition as the original.
 - **Segment rotation + time-pruned scans.** Each partition is an append-only
   ``active.jsonl`` sealed into an immutable ``seg_NNNNNN.jsonl`` at a size
   threshold. Sealing records the segment's [min, max] event-time (native
@@ -42,7 +44,6 @@ routing must stay stable for the life of the data.
 from __future__ import annotations
 
 import contextlib
-import hashlib
 import json
 import os
 import re
@@ -71,7 +72,6 @@ from predictionio_tpu.data.storage.jsonl import (
 from predictionio_tpu.data.storage.memory import query_events
 
 _SEG_RE = re.compile(r"^seg_(\d{6})\.jsonl$")
-_PP_ID_RE = re.compile(r"^([0-9a-f]{2})-")
 MAX_PARTITIONS = 256  # two hex digits embed the partition in the event id
 
 
@@ -119,16 +119,23 @@ class PartitionedEvents(base.Events):
         )
         return self._c.base_path / name
 
+    ROUTING_HASH = "fnv1a32"  # must match native.route_id_bytes
+
     def _publish_meta(self, ns: Path, n: int) -> int:
         """Atomically create ``_meta.json`` with count ``n`` unless one
-        already exists; returns the winning count."""
+        already exists; returns the winning count. The routing hash is
+        recorded alongside the partition count and verified on read —
+        opening a store routed by a different hash must fail loudly, not
+        silently misroute point ops (export + re-import migrates)."""
         meta = ns / "_meta.json"
         if not meta.exists():
             ns.mkdir(parents=True, exist_ok=True)
             # per-process-unique temp name: a shared name would let two
             # first-initializers publish each other's half-written file
             tmp = ns / f"_meta.json.tmp.{os.getpid()}.{uuid.uuid4().hex}"
-            tmp.write_text(json.dumps({"partitions": n}))
+            tmp.write_text(
+                json.dumps({"partitions": n, "hash": self.ROUTING_HASH})
+            )
             try:
                 # atomic create-if-absent: a concurrent process may have
                 # written meta between the check and now — theirs wins
@@ -137,7 +144,16 @@ class PartitionedEvents(base.Events):
                 pass
             finally:
                 tmp.unlink(missing_ok=True)
-        return int(json.loads(meta.read_text())["partitions"])
+        side = json.loads(meta.read_text())
+        stored_hash = side.get("hash", "<none>")
+        if stored_hash != self.ROUTING_HASH:
+            raise RuntimeError(
+                f"event namespace {ns.name} was created with routing hash "
+                f"{stored_hash!r}; this build routes with "
+                f"{self.ROUTING_HASH!r} — export from a matching build and "
+                "re-import to migrate"
+            )
+        return int(side["partitions"])
 
     def _n_partitions(self, ns: Path) -> int:
         """Partition count for a namespace: the persisted value wins.
@@ -227,19 +243,19 @@ class PartitionedEvents(base.Events):
 
     @staticmethod
     def _hash_pp(key: str, n: int) -> int:
-        return int.from_bytes(
-            hashlib.md5(key.encode("utf-8")).digest()[:4], "big"
-        ) % n
+        from predictionio_tpu import native
 
-    def _route(self, event_id: str, n: int) -> int:
+        return native.fnv1a32(key.encode("utf-8")) % n
+
+    @staticmethod
+    def _route(event_id: str, n: int) -> int:
         """Partition of an event id — deterministic from the id alone, so
-        gets, deletes, and replacements always address the same log."""
-        m = _PP_ID_RE.match(event_id)
-        if m:
-            pp = int(m.group(1), 16)
-            if pp < n:
-                return pp
-        return self._hash_pp(event_id, n)
+        gets, deletes, and replacements always address the same log.
+        The rule (embedded ``<pp>-`` prefix else FNV-1a 32) is shared
+        with the native bulk router (``native.route_id_bytes``)."""
+        from predictionio_tpu import native
+
+        return native.route_id_bytes(event_id.encode("utf-8"), n)
 
     # -- sealing -----------------------------------------------------------
 
@@ -506,31 +522,42 @@ class PartitionedEvents(base.Events):
         ns = self._ns_dir(app_id, channel_id)
         n = self._n_partitions(ns)
         scanned = native.scan_events(blob)
-        line_offs = []  # (start, end) byte spans per line
-        pos = 0
-        while pos < len(blob):
-            nl = blob.index(b"\n", pos)
-            line_offs.append((pos, nl + 1))
-            pos = nl + 1
+        # line byte spans, vectorized (ends at each newline)
+        ends = (
+            np.flatnonzero(np.frombuffer(blob, np.uint8) == ord("\n")) + 1
+        )
+        starts = np.empty_like(ends)
+        starts[0] = 0
+        starts[1:] = ends[:-1]
+        # one native pass routes every id span; fallback-flagged lines
+        # (escaped ids, odd syntax) MUST take the json path — their raw
+        # span bytes differ from the decoded id, so routing by the span
+        # would diverge from get()/delete()'s routing of the decoded id
+        routes = native.route_ids(
+            blob,
+            scanned.offs[:, native.F_EVENT_ID],
+            scanned.lens[:, native.F_EVENT_ID],
+            n,
+        )
+        routes[(scanned.flags & native.FLAG_FALLBACK) != 0] = -1
+        empty = (scanned.flags & native.FLAG_EMPTY) != 0
         per_part: dict[int, list[bytes]] = {}
-        for i, (s, t) in enumerate(line_offs):
-            if i < len(scanned.flags) and (
-                scanned.flags[i] & native.FLAG_EMPTY
-            ):
-                continue
-            eid = None
-            if i < len(scanned.flags):
-                eid = scanned.field_str(i, native.F_EVENT_ID)
+        for i in np.flatnonzero((routes < 0) & ~empty):
+            rec = json.loads(blob[starts[i]:ends[i]])
+            eid = rec.get("eventId")
             if eid is None:
-                rec = json.loads(blob[s:t])
-                eid = rec.get("eventId")
-                if eid is None:
-                    raise ValueError(
-                        "append_jsonl line missing eventId "
-                        "(required for partition routing)"
-                    )
-            pp = self._route(eid, n)
-            per_part.setdefault(pp, []).append(blob[s:t])
+                raise ValueError(
+                    "append_jsonl line missing eventId "
+                    "(required for partition routing)"
+                )
+            routes[i] = self._route(eid, n)
+        for pp in np.unique(routes):
+            if pp < 0:
+                continue  # empty lines
+            idx = np.flatnonzero(routes == pp)
+            per_part[int(pp)] = [
+                blob[starts[i]:ends[i]] for i in idx
+            ]
         for pp, lines in per_part.items():
             pdir = self._pdir(ns, pp)
             with self._locked(pdir):
@@ -740,12 +767,19 @@ class PartitionedEvents(base.Events):
         default_ratings: dict[str, float] | None = None,
         override_ratings: dict[str, float] | None = None,
     ) -> base.RatingsBatch:
-        """Columnar fast path: concatenate the partition logs and run the
-        native codec once. Sound because ids route deterministically to one
-        partition and, once proven unique store-wide (native span index,
-        cached until any file changes), last-write-wins degenerates to
-        order-free; duplicate ids or delete markers trigger a compact
-        first, exactly like the jsonl backend."""
+        """Columnar fast path: scan every partition's log IN PARALLEL
+        with the native codec (the ctypes call releases the GIL, so
+        partitions parse on real threads — the TableInputFormat-split
+        analog), then merge the per-partition dense id spaces.
+
+        Soundness: each partition is proven replay-clean (unique ids, no
+        delete markers; dirty partitions are compacted first, under every
+        partition lock so no writer can race the proof), and ids route
+        deterministically to exactly one partition — enforced at the
+        write sites via the ``_meta.json`` guard — so the per-partition
+        record sets are disjoint and the merge is a plain
+        concatenation-with-remap, no cross-partition last-write-wins
+        needed."""
         from predictionio_tpu import native
 
         ns = self._ns_dir(app_id, channel_id)
@@ -778,66 +812,86 @@ class PartitionedEvents(base.Events):
         # the whole prove -> compact -> re-read sequence holds every
         # partition lock: a writer cannot slip a duplicate id or delete
         # marker between the compaction and the snapshot the cache (and
-        # this scan) trusts — which also makes recording the post-compact
-        # state clean sound in degraded no-native mode, where uniqueness
-        # is unprovable but compaction just restored it by construction
-        cross_partition_dupes = False
+        # this scan) trusts — which also makes trusting the post-compact
+        # state sound in degraded no-native mode, where uniqueness is
+        # unprovable but compaction just restored it by construction
         with self._locked_all(ns, n):
             pbufs, stat_key = read_all_locked()
-            buf = b"".join(pbufs)
-            scanned = None
-            if not (buf and self._c.clean_stat.get(ns) == stat_key):
-                needs_compact, scanned = prove_clean(buf)
-                if needs_compact:
-                    # ids route deterministically to one partition, so
-                    # dirt is per-partition: rewrite only the partitions
-                    # that are themselves unclean. Degraded mode can't
-                    # prove any partition clean — skip the (whole-store)
-                    # per-partition re-scan and compact everything.
-                    for pp in range(n):
-                        if not native.native_available() or prove_clean(
-                            pbufs[pp]
-                        )[0]:
-                            self._compact_partition_locked(
-                                self._pdir(ns, pp)
-                            )
+            if not any(pbufs):
+                return base.RatingsBatch.empty()
+            scans: list = [None] * n
+            if self._c.clean_stat.get(ns) != stat_key:
+                compacted = False
+                for pp in range(n):
+                    if not pbufs[pp]:
+                        continue
+                    needs, scans[pp] = (
+                        prove_clean(pbufs[pp])
+                        if native.native_available()
+                        else (True, None)  # unprovable: compact
+                    )
+                    if needs:
+                        self._compact_partition_locked(self._pdir(ns, pp))
+                        compacted = True
+                if compacted:
                     pbufs, stat_key = read_all_locked()
-                    buf = b"".join(pbufs)
-                    scanned = None
-                    if native.native_available():
-                        needs_compact, scanned = prove_clean(buf)
-                        # still unclean with every partition individually
-                        # clean => duplicate ids ACROSS partitions (a
-                        # broken routing invariant, e.g. a partition
-                        # count changed out from under the data):
-                        # compaction cannot fix that — serve the exact
-                        # fold-based read instead of double-counting
-                        cross_partition_dupes = needs_compact
-            if buf and not cross_partition_dupes:
-                with self._c.lock:
-                    self._c.clean_stat[ns] = stat_key
-        if cross_partition_dupes:  # pragma: no cover - invariant breach
-            return base.Events.scan_ratings(
-                self,
-                app_id,
-                channel_id,
-                event_names=event_names,
-                entity_type=entity_type,
-                target_entity_type=target_entity_type,
+                    scans = [None] * n
+            with self._c.lock:
+                self._c.clean_stat[ns] = stat_key
+        # buffers are immutable snapshots: parse outside the locks
+        live = [pp for pp in range(n) if pbufs[pp]]
+
+        def load_one(pp: int):
+            return native.load_ratings_jsonl(
+                pbufs[pp],
+                event_names=(
+                    list(event_names) if event_names is not None else None
+                ),
                 rating_key=rating_key,
                 default_ratings=default_ratings,
+                entity_type=entity_type,
+                target_entity_type=target_entity_type,
                 override_ratings=override_ratings,
+                scanned=scans[pp],
             )
-        users, items, rows, cols, vals = native.load_ratings_jsonl(
-            buf,
-            event_names=list(event_names) if event_names is not None else None,
-            rating_key=rating_key,
-            default_ratings=default_ratings,
-            entity_type=entity_type,
-            target_entity_type=target_entity_type,
-            override_ratings=override_ratings,
-            scanned=scanned,
-        )
+
+        if len(live) == 1:
+            results = [load_one(live[0])]
+        else:
+            with ThreadPoolExecutor(
+                max_workers=min(len(live), os.cpu_count() or 4)
+            ) as pool:
+                results = list(pool.map(load_one, live))
+
+        user_map: dict[str, int] = {}
+        item_map: dict[str, int] = {}
+        rows_l, cols_l, vals_l = [], [], []
+        for users_p, items_p, rows_p, cols_p, vals_p in results:
+            ulut = np.fromiter(
+                (user_map.setdefault(u, len(user_map)) for u in users_p),
+                np.int32,
+                len(users_p),
+            )
+            ilut = np.fromiter(
+                (item_map.setdefault(t, len(item_map)) for t in items_p),
+                np.int32,
+                len(items_p),
+            )
+            if len(vals_p):
+                rows_l.append(ulut[rows_p])
+                cols_l.append(ilut[cols_p])
+                vals_l.append(vals_p)
+        if not vals_l:
+            return base.RatingsBatch(
+                list(user_map), list(item_map),
+                np.empty(0, np.int32), np.empty(0, np.int32),
+                np.empty(0, np.float32),
+            )
         return base.RatingsBatch(
-            entity_ids=users, target_ids=items, rows=rows, cols=cols, vals=vals
+            entity_ids=list(user_map),
+            target_ids=list(item_map),
+            rows=np.concatenate(rows_l),
+            cols=np.concatenate(cols_l),
+            vals=np.concatenate(vals_l),
         )
+

@@ -62,7 +62,8 @@ _lib_tried = False
 def _build(src: str, out: str) -> bool:
     try:
         proc = subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-o", out, src],
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+             "-o", out, src],
             capture_output=True,
             text=True,
             timeout=120,
@@ -116,6 +117,10 @@ def _load() -> ctypes.CDLL | None:
         lib.pio_extract_number.restype = None
         lib.pio_extract_number.argtypes = [
             ctypes.c_char_p, i64p, i64p, ctypes.c_long, ctypes.c_char_p, f64p,
+        ]
+        lib.pio_route_ids.restype = None
+        lib.pio_route_ids.argtypes = [
+            ctypes.c_char_p, i64p, i64p, ctypes.c_long, ctypes.c_int32, i32p,
         ]
         _lib = lib
         return _lib
@@ -236,6 +241,55 @@ def parse_times(buf: bytes, offs: np.ndarray, lens: np.ndarray) -> np.ndarray:
             ).timestamp()
         except Exception:
             out[i] = np.nan
+    return out
+
+
+def fnv1a32(data: bytes) -> int:
+    """FNV-1a 32-bit — the partition-routing hash (kept in lockstep with
+    pio_route_ids in pio_native.cpp)."""
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def route_id_bytes(s: bytes, n_partitions: int) -> int:
+    """Partition of one event id: '<2 lowercase hex>-...' with value <
+    n_partitions routes by the embedded partition, else FNV-1a 32 mod
+    n_partitions (same rule as pio_route_ids)."""
+    hexdigits = b"0123456789abcdef"
+    if (
+        len(s) >= 3
+        and s[2:3] == b"-"
+        and s[0] in hexdigits
+        and s[1] in hexdigits
+    ):
+        pp = int(s[:2], 16)
+        if pp < n_partitions:
+            return pp
+    return fnv1a32(s) % n_partitions
+
+
+def route_ids(
+    buf: bytes, offs: np.ndarray, lens: np.ndarray, n_partitions: int
+) -> np.ndarray:
+    """Vectorized partition routing of event-id spans; -1 for absent
+    spans. The bulk-import hot loop (one native pass per blob)."""
+    n = len(offs)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    out = np.empty(n, dtype=np.int32)
+    lib = _load()
+    if lib is not None:
+        lib.pio_route_ids(buf, offs, lens, n, n_partitions, out)
+        return out
+    for i in range(n):
+        if offs[i] < 0:
+            out[i] = -1
+        else:
+            out[i] = route_id_bytes(
+                buf[offs[i] : offs[i] + lens[i]], n_partitions
+            )
     return out
 
 

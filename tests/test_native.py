@@ -280,3 +280,72 @@ class TestImportUsesCodec:
         evs = store.find("NativeApp", storage=storage)
         assert len(evs) == 4
         assert {e.entity_id for e in evs} == {"u1", "u2", 'u"quoted', "u3"}
+
+
+class TestThreadedScan:
+    def test_threaded_scan_matches_serial(self, monkeypatch):
+        """The multithreaded line scanner (std::thread over line ranges)
+        must produce byte-identical spans/flags to the serial path —
+        forced via PIO_NATIVE_THREADS so it's exercised even on 1-core
+        boxes."""
+        lines = []
+        for i in range(1200):
+            if i % 97 == 0:
+                lines.append("")  # blank lines
+            elif i % 53 == 0:
+                lines.append('{"event":"r\\u0061te","entityId":"e"}')  # esc
+            else:
+                lines.append(
+                    '{"event":"rate","entityType":"user","entityId":"u%d",'
+                    '"properties":{"rating":%d.0},"eventId":"x%d"}'
+                    % (i, i % 5, i)
+                )
+        buf = ("\n".join(lines) + "\n").encode()
+        big = buf * 200  # 240k lines: crosses the 100k-lines/thread floor
+        monkeypatch.setenv("PIO_NATIVE_THREADS", "1")
+        s1 = native.scan_events(big)
+        monkeypatch.setenv("PIO_NATIVE_THREADS", "4")
+        s4 = native.scan_events(big)
+        np.testing.assert_array_equal(s1.offs, s4.offs)
+        np.testing.assert_array_equal(s1.lens, s4.lens)
+        np.testing.assert_array_equal(s1.flags, s4.flags)
+
+
+class TestRouting:
+    def test_route_id_bytes_rule(self):
+        assert native.route_id_bytes(b"03-abcdef", 8) == 3
+        assert native.route_id_bytes(b"ff-abcdef", 8) == (
+            native.fnv1a32(b"ff-abcdef") % 8
+        )  # embedded value >= n falls back to the hash
+        assert native.route_id_bytes(b"G3-abc", 8) == (
+            native.fnv1a32(b"G3-abc") % 8
+        )  # uppercase hex is not an embedded prefix
+        assert native.route_id_bytes(b"plain", 8) == (
+            native.fnv1a32(b"plain") % 8
+        )
+
+    def test_native_route_ids_matches_python(self):
+        ids = [b"03-x", b"ff-y", b"e123", b"07-z", b"G1-q", b"a" * 40]
+        buf = b"".join(ids)
+        offs, lens = [], []
+        pos = 0
+        for s in ids:
+            offs.append(pos)
+            lens.append(len(s))
+            pos += len(s)
+        offs.append(-1)  # absent span
+        lens.append(0)
+        offs = np.asarray(offs, np.int64)
+        lens = np.asarray(lens, np.int64)
+        got = native.route_ids(buf, offs, lens, 8)
+        want = [native.route_id_bytes(s, 8) for s in ids] + [-1]
+        assert got.tolist() == want
+
+    def test_degraded_python_route_ids(self, monkeypatch):
+        monkeypatch.setattr(native, "_load", lambda: None)
+        ids = [b"03-x", b"zz", b"ff-y"]
+        buf = b"".join(ids)
+        offs = np.asarray([0, 4, 6], np.int64)
+        lens = np.asarray([4, 2, 4], np.int64)
+        got = native.route_ids(buf, offs, lens, 8)
+        assert got.tolist() == [native.route_id_bytes(s, 8) for s in ids]

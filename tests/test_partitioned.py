@@ -484,3 +484,33 @@ class TestCrossProcess:
         assert len(dao.find(APP)) == n_child
         batch = dao.scan_ratings(APP, event_names=["rate"])
         assert len(batch) == n_child
+
+
+class TestRoutingIntegrity:
+    def test_escaped_id_import_routes_like_point_ops(self, dao):
+        """An imported line whose eventId contains a JSON escape must
+        route by the DECODED id (like get/delete), not the raw span."""
+        blob = (
+            b'{"event":"rate","entityType":"user","entityId":"u1",'
+            b'"targetEntityType":"item","targetEntityId":"i1",'
+            b'"properties":{"rating":3.0},'
+            b'"eventTime":"2020-01-01T00:00:00.000Z",'
+            b'"eventId":"ab\\u0063-x"}\n'
+        )
+        dao.append_jsonl(blob, APP)
+        got = dao.get("abc-x", APP)
+        assert got is not None and got.entity_id == "u1"
+        assert dao.delete("abc-x", APP)
+        assert dao.get("abc-x", APP) is None
+
+    def test_meta_hash_mismatch_fails_loudly(self, dao, tmp_path):
+        eid = dao.insert(_event(1), APP)
+        ns = dao._ns_dir(APP, None)
+        meta = json.loads((ns / "_meta.json").read_text())
+        meta["hash"] = "md5"
+        (ns / "_meta.json").write_text(json.dumps(meta))
+        fresh = PartitionedEvents(
+            PartitionedStorageClient({"path": str(dao._c.base_path)})
+        )
+        with pytest.raises(RuntimeError, match="routing hash"):
+            fresh.get(eid, APP)
