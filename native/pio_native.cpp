@@ -201,108 +201,98 @@ bool parse_uint(const char*& p, const char* end, int digits, long* out) {
   return true;
 }
 
-}  // namespace
-
-extern "C" {
-
-}  // extern "C"
-
-namespace {
-
 // One line's field-span extraction (shared by the serial and threaded
 // scan paths; writes exactly the [PIO_N_FIELDS] row for this line).
 void scan_one_line(const char* buf, const char* p, const char* line_end,
                    int64_t* lo, int64_t* ll, uint8_t* flag) {
-  {
-    for (int f = 0; f < PIO_N_FIELDS; ++f) {
-      lo[f] = -1;
-      ll[f] = 0;
-    }
-    *flag = 0;
+  for (int f = 0; f < PIO_N_FIELDS; ++f) {
+    lo[f] = -1;
+    ll[f] = 0;
+  }
+  *flag = 0;
 
-    Cursor c{p, line_end};
-    c.skip_ws();
-    if (c.done()) {
-      *flag = PIO_FLAG_EMPTY;
-    } else if (c.peek() != '{') {
-      *flag = PIO_FLAG_FALLBACK;
-    } else {
-      ++c.p;  // past '{'
-      bool ok = true;
-      bool closed = false;
-      bool line_escaped = false;
-      while (true) {
-        c.skip_ws();
-        if (!c.done() && c.peek() == '}') {
-          ++c.p;
-          closed = true;
-          break;
-        }
-        if (c.done() || c.peek() != '"') {
-          ok = false;
-          break;
-        }
+  Cursor c{p, line_end};
+  c.skip_ws();
+  if (c.done()) {
+    *flag = PIO_FLAG_EMPTY;
+  } else if (c.peek() != '{') {
+    *flag = PIO_FLAG_FALLBACK;
+  } else {
+    ++c.p;  // past '{'
+    bool ok = true;
+    bool closed = false;
+    bool line_escaped = false;
+    while (true) {
+      c.skip_ws();
+      if (!c.done() && c.peek() == '}') {
         ++c.p;
-        const char* key;
-        long keylen;
-        bool key_escaped = false;
-        if (!scan_string(c, &key, &keylen, &key_escaped)) {
-          ok = false;
-          break;
-        }
-        c.skip_ws();
-        if (c.done() || c.peek() != ':') {
-          ok = false;
-          break;
-        }
-        ++c.p;
-        const char* val;
-        long vallen;
-        bool val_escaped = false;
-        if (!scan_value(c, &val, &vallen, &val_escaped)) {
-          ok = false;
-          break;
-        }
-        int slot = key_escaped ? -1 : field_slot({key, (size_t)keylen});
-        if (slot >= 0) {
-          bool is_null = vallen == 4 && memcmp(val, "null", 4) == 0;
-          char shape = slot_shape(slot);
-          char open = shape == 's' ? '"' : (shape == 'o' ? '{' : '[');
-          if (is_null) {
-            lo[slot] = -1;
-            ll[slot] = 0;
-          } else if (vallen >= 1 && val[0] == open) {
-            // type mismatches (numeric entityId etc.) must go through the
-            // json fallback so they are rejected like before
-            if (val_escaped && shape == 's') line_escaped = true;
-            if (shape == 's') {
-              lo[slot] = (int64_t)(val + 1 - buf);  // strip quotes
-              ll[slot] = vallen - 2;
-            } else {
-              lo[slot] = (int64_t)(val - buf);
-              ll[slot] = vallen;
-            }
-          } else {
-            line_escaped = true;
-          }
-        }
-        c.skip_ws();
-        if (!c.done() && c.peek() == ',') {
-          ++c.p;
-          continue;
-        }
-        if (!c.done() && c.peek() == '}') {
-          ++c.p;
-          closed = true;
-        }
+        closed = true;
+        break;
+      }
+      if (c.done() || c.peek() != '"') {
+        ok = false;
+        break;
+      }
+      ++c.p;
+      const char* key;
+      long keylen;
+      bool key_escaped = false;
+      if (!scan_string(c, &key, &keylen, &key_escaped)) {
+        ok = false;
         break;
       }
       c.skip_ws();
-      // unterminated objects or trailing bytes after '}' (concatenated
-      // records, truncated lines) fall back so json.loads fails loudly
-      if (!ok || line_escaped || !closed || !c.done())
-        *flag = PIO_FLAG_FALLBACK;
+      if (c.done() || c.peek() != ':') {
+        ok = false;
+        break;
+      }
+      ++c.p;
+      const char* val;
+      long vallen;
+      bool val_escaped = false;
+      if (!scan_value(c, &val, &vallen, &val_escaped)) {
+        ok = false;
+        break;
+      }
+      int slot = key_escaped ? -1 : field_slot({key, (size_t)keylen});
+      if (slot >= 0) {
+        bool is_null = vallen == 4 && memcmp(val, "null", 4) == 0;
+        char shape = slot_shape(slot);
+        char open = shape == 's' ? '"' : (shape == 'o' ? '{' : '[');
+        if (is_null) {
+          lo[slot] = -1;
+          ll[slot] = 0;
+        } else if (vallen >= 1 && val[0] == open) {
+          // type mismatches (numeric entityId etc.) must go through the
+          // json fallback so they are rejected like before
+          if (val_escaped && shape == 's') line_escaped = true;
+          if (shape == 's') {
+            lo[slot] = (int64_t)(val + 1 - buf);  // strip quotes
+            ll[slot] = vallen - 2;
+          } else {
+            lo[slot] = (int64_t)(val - buf);
+            ll[slot] = vallen;
+          }
+        } else {
+          line_escaped = true;
+        }
+      }
+      c.skip_ws();
+      if (!c.done() && c.peek() == ',') {
+        ++c.p;
+        continue;
+      }
+      if (!c.done() && c.peek() == '}') {
+        ++c.p;
+        closed = true;
+      }
+      break;
     }
+    c.skip_ws();
+    // unterminated objects or trailing bytes after '}' (concatenated
+    // records, truncated lines) fall back so json.loads fails loudly
+    if (!ok || line_escaped || !closed || !c.done())
+      *flag = PIO_FLAG_FALLBACK;
   }
 }
 

@@ -25,9 +25,12 @@ from predictionio_tpu.core.metrics import OptionAverageMetric
 
 def _ranked_ids(p: Any) -> list:
     """Extract a ranked id list from a prediction: accepts an iterable of
-    ids, of (id, score) pairs, or an object with ``item_scores``."""
+    ids, of (id, score) pairs, or an object with ``item_scores`` /
+    ``itemScores`` (the recommendation templates' PredictedResult)."""
     if hasattr(p, "item_scores"):
         p = p.item_scores
+    elif hasattr(p, "itemScores"):
+        p = p.itemScores
     ids = []
     for x in p:
         if isinstance(x, (tuple, list)) and len(x) == 2:
@@ -43,6 +46,8 @@ def _ranked_ids(p: Any) -> list:
 def _id_set(a: Any) -> set:
     if hasattr(a, "item_ids"):
         a = a.item_ids
+    elif isinstance(a, dict) and "item" in a:
+        return {a["item"]}  # single held-out rating actual (k-fold QA)
     return set(a)
 
 

@@ -551,10 +551,16 @@ class PartitionedEvents(base.Events):
                     "(required for partition routing)"
                 )
             routes[i] = self._route(eid, n)
-        for pp in np.unique(routes):
+        # group line indexes by partition with one stable sort (O(n log n);
+        # a flatnonzero per partition would rescan routes up to 256 times)
+        order = np.argsort(routes, kind="stable")
+        sorted_routes = routes[order]
+        uniq, first = np.unique(sorted_routes, return_index=True)
+        bounds = np.append(first, len(order))
+        for k, pp in enumerate(uniq):
             if pp < 0:
                 continue  # empty lines
-            idx = np.flatnonzero(routes == pp)
+            idx = order[bounds[k]:bounds[k + 1]]
             per_part[int(pp)] = [
                 blob[starts[i]:ends[i]] for i in idx
             ]
@@ -858,10 +864,23 @@ class PartitionedEvents(base.Events):
         if len(live) == 1:
             results = [load_one(live[0])]
         else:
-            with ThreadPoolExecutor(
-                max_workers=min(len(live), os.cpu_count() or 4)
-            ) as pool:
-                results = list(pool.map(load_one, live))
+            # one native-scanner thread per pooled worker: the scanner is
+            # itself multithreaded for big buffers, and cores x 8 threads
+            # would thrash the parallelism this pool provides (env-based
+            # hint; a concurrent scan racing the window merely runs
+            # single-threaded once)
+            prev = os.environ.get("PIO_NATIVE_THREADS")
+            os.environ["PIO_NATIVE_THREADS"] = "1"
+            try:
+                with ThreadPoolExecutor(
+                    max_workers=min(len(live), os.cpu_count() or 4)
+                ) as pool:
+                    results = list(pool.map(load_one, live))
+            finally:
+                if prev is None:
+                    os.environ.pop("PIO_NATIVE_THREADS", None)
+                else:
+                    os.environ["PIO_NATIVE_THREADS"] = prev
 
         user_map: dict[str, int] = {}
         item_map: dict[str, int] = {}

@@ -409,3 +409,46 @@ class TestVectorizedSweep:
             )
         finally:
             set_storage(None)
+
+
+class TestShippedRecommendationEval:
+    def test_shipped_eval_runs_end_to_end(self, tmp_path, monkeypatch):
+        """The out-of-the-box `pio eval` target: Precision@1 sweep over
+        the ALS lambda/rank grid against a real event store."""
+        from predictionio_tpu.core.workflow_eval import run_evaluation
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App, Storage
+
+        storage = Storage(env={
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "e.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        app_id = storage.get_metadata_apps().insert(App(0, "EvalApp"))
+        events = storage.get_events()
+        batch = [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{(u + j) % 9}",
+                properties={"rating": float((u * j) % 5 + 1)},
+            )
+            for u in range(12) for j in range(6)
+        ]
+        events.batch_insert(batch, app_id)
+        monkeypatch.setenv("PIO_EVAL_APP_NAME", "EvalApp")
+        from predictionio_tpu.core import workflow_eval as we
+        from predictionio_tpu.data import store as store_mod
+        monkeypatch.setattr(we, "get_storage", lambda: storage)
+        monkeypatch.setattr(store_mod, "get_storage", lambda: storage)
+
+        instance_id, result = run_evaluation(
+            "predictionio_tpu.models.recommendation_eval.evaluation",
+            storage=storage,
+        )
+        assert 0.0 <= result.best_score.score <= 1.0
+        assert len(result.engine_params_scores) == 4  # the shipped SWEEP
+        inst = storage.get_metadata_evaluation_instances().get(instance_id)
+        assert inst.status == "EVALCOMPLETED"
+        storage.close()
