@@ -1,0 +1,70 @@
+"""Shipped evaluation for the classification template — a ready `pio eval`
+target.
+
+The reference ships this with the classification template: an Accuracy
+metric over k-fold splits and an EngineParamsGenerator sweeping the
+NaiveBayes smoothing lambda (reference
+examples/scala-parallel-classification evaluation — `AccuracyEvaluation`
+with `EngineParamsList`). Run it with:
+
+    pio eval predictionio_tpu.models.classification_eval.evaluation \\
+             predictionio_tpu.models.classification_eval.param_grid
+
+The target app defaults to ``MyApp``; set ``PIO_EVAL_APP_NAME`` (shared
+with the recommendation eval target) to point elsewhere. Entry points
+are zero-arg factories — importing this module never touches storage.
+"""
+
+from __future__ import annotations
+
+import os
+
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.core.metrics import AverageMetric
+from predictionio_tpu.core.params import EngineParamsGenerator
+from predictionio_tpu.models import classification
+
+LAMBDA_SWEEP = [0.25, 1.0, 4.0, 10.0]
+
+
+class Accuracy(AverageMetric):
+    """Fraction of points whose predicted label equals the actual
+    (reference AccuracyEvaluation's `Accuracy extends AverageMetric`)."""
+
+    def calculate_point(self, q, p, a) -> float:
+        return 1.0 if float(p.label) == float(a) else 0.0
+
+
+def _app_name() -> str:
+    return os.environ.get("PIO_EVAL_APP_NAME", "MyApp")
+
+
+def _candidates(app_name: str):
+    eng = classification.engine()
+    return [
+        eng.params_from_variant({
+            "id": "eval",
+            "engineFactory": "predictionio_tpu.models.classification.engine",
+            "datasource": {"params": {"app_name": app_name}},
+            "algorithms": [{
+                "name": "naive",
+                "params": {"lambda": lam},
+            }],
+        })
+        for lam in LAMBDA_SWEEP
+    ]
+
+
+def param_grid() -> EngineParamsGenerator:
+    gen = EngineParamsGenerator()
+    gen.engine_params_list = _candidates(_app_name())
+    return gen
+
+
+def evaluation() -> Evaluation:
+    """Accuracy over the engine's k-fold eval splits."""
+    return Evaluation(
+        engine=classification.engine(),
+        metric=Accuracy(),
+        engine_params_generator=param_grid(),
+    )

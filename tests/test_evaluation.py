@@ -452,3 +452,50 @@ class TestShippedRecommendationEval:
         inst = storage.get_metadata_evaluation_instances().get(instance_id)
         assert inst.status == "EVALCOMPLETED"
         storage.close()
+
+
+class TestShippedClassificationEval:
+    def test_shipped_classification_eval(self, tmp_path, monkeypatch):
+        """The out-of-the-box classification `pio eval` target: Accuracy
+        sweep over the NaiveBayes lambda grid."""
+        from predictionio_tpu.core.workflow_eval import run_evaluation
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage import App, Storage
+
+        storage = Storage(env={
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "c.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        app_id = storage.get_metadata_apps().insert(App(0, "ClsApp"))
+        events = storage.get_events()
+        batch = []
+        for i in range(30):
+            label = float(i % 2)
+            batch.append(Event(
+                event="$set", entity_type="user", entity_id=f"u{i}",
+                properties={
+                    "attr0": label * 3 + (i % 3) * 0.1,
+                    "attr1": (1 - label) * 2 + (i % 5) * 0.1,
+                    "attr2": 1.0,
+                    "plan": label,
+                },
+            ))
+        events.batch_insert(batch, app_id)
+        monkeypatch.setenv("PIO_EVAL_APP_NAME", "ClsApp")
+        from predictionio_tpu.core import workflow_eval as we
+        from predictionio_tpu.data import store as store_mod
+        monkeypatch.setattr(we, "get_storage", lambda: storage)
+        monkeypatch.setattr(store_mod, "get_storage", lambda: storage)
+
+        instance_id, result = run_evaluation(
+            "predictionio_tpu.models.classification_eval.evaluation",
+            storage=storage,
+        )
+        assert result.best_score.score > 0.7  # separable by construction
+        assert len(result.engine_params_scores) == 4
+        inst = storage.get_metadata_evaluation_instances().get(instance_id)
+        assert inst.status == "EVALCOMPLETED"
+        storage.close()
