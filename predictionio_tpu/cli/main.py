@@ -464,6 +464,17 @@ def cmd_template(args) -> int:
     return 0
 
 
+def cmd_upgrade(args) -> int:
+    # disabled in the reference too (Console.scala: "Upgrade is not
+    # available"); storage-format migrations here go through
+    # `pio export` + `pio import`
+    print(
+        "Upgrade is not available; migrate data between storage formats "
+        "with `pio export` and `pio import`."
+    )
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="pio", description="PredictionIO-TPU console")
     sub = p.add_subparsers(dest="command")
@@ -584,6 +595,10 @@ def build_parser() -> argparse.ArgumentParser:
     tpl = sub.add_parser("template")
     tpl.add_argument("rest", nargs="*")
     tpl.set_defaults(fn=cmd_template)
+
+    up = sub.add_parser("upgrade")
+    up.add_argument("rest", nargs="*")
+    up.set_defaults(fn=cmd_upgrade)
 
     r = sub.add_parser("run")
     r.add_argument("main_class", help="dotted module path, or module:function")
