@@ -222,13 +222,18 @@ void scan_one_line(const char* buf, const char* p, const char* line_end,
     bool ok = true;
     bool closed = false;
     bool line_escaped = false;
+    bool first = true;
     while (true) {
       c.skip_ws();
-      if (!c.done() && c.peek() == '}') {
+      // '}' is only valid here for the empty object; after a comma a key
+      // must follow (json.loads rejects trailing commas — the fast path
+      // must not be more lenient than the fallback it stands in for)
+      if (first && !c.done() && c.peek() == '}') {
         ++c.p;
         closed = true;
         break;
       }
+      first = false;
       if (c.done() || c.peek() != '"') {
         ok = false;
         break;
@@ -254,6 +259,12 @@ void scan_one_line(const char* buf, const char* p, const char* line_end,
         ok = false;
         break;
       }
+      // an escaped key can be a known field in disguise (e.g.
+      // "entityId"); the span scan can't see that, so the whole
+      // line must go through the json fallback rather than silently
+      // keeping a value json.loads would overwrite (duplicate keys:
+      // last wins)
+      if (key_escaped) line_escaped = true;
       int slot = key_escaped ? -1 : field_slot({key, (size_t)keylen});
       if (slot >= 0) {
         bool is_null = vallen == 4 && memcmp(val, "null", 4) == 0;

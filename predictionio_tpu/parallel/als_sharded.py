@@ -35,6 +35,10 @@ half-iteration holds: (a) its shard of both factor matrices —
 rating / n_shards), shrinking with mesh size; and (c) the ``all_gather``
 of the FULL opposite factor matrix (``_train_fused_sharded.shard_fn``) —
 ``opposite_rows * D * 4`` bytes, which does NOT shrink with mesh size.
+Per-bucket ``[B, K, D]`` factor-gather temps are additionally bounded by
+``ALSParams.gather_chunk_bytes`` (the solves run through the same
+``_solve_bucket_inline`` as single-chip, so wide buckets at high rank
+chunk identically here — see ops/als.py).
 (c) is the design ceiling: on 16-GiB v5e chips the gathered side caps at
 roughly 10^8 rows at rank 20 or 1.6*10^7 at rank 128 (at half of HBM).
 MovieLens-20M (2.7*10^4 items, rank 20 -> 2 MiB gathered) and any

@@ -349,3 +349,96 @@ class TestRouting:
         lens = np.asarray([4, 2, 4], np.int64)
         got = native.route_ids(buf, offs, lens, 8)
         assert got.tolist() == [native.route_id_bytes(s, 8) for s in ids]
+
+
+class TestFuzzScannerVsJson:
+    """Randomized differential test: for every generated line, the native
+    span scanner must either extract spans that decode to exactly what
+    json.loads sees, or flag the line for the json fallback — never
+    silently extract a wrong value."""
+
+    FIELDS = {
+        "event": native.F_EVENT,
+        "entityType": native.F_ENTITY_TYPE,
+        "entityId": native.F_ENTITY_ID,
+        "targetEntityType": native.F_TARGET_ENTITY_TYPE,
+        "targetEntityId": native.F_TARGET_ENTITY_ID,
+        "eventTime": native.F_EVENT_TIME,
+        "prId": native.F_PR_ID,
+        "eventId": native.F_EVENT_ID,
+        "creationTime": native.F_CREATION_TIME,
+    }
+
+    def _random_string(self, rng):
+        pools = [
+            "plain-ascii_09",
+            "späce ünïcode ☃",
+            'quo"te',          # must escape -> fallback
+            "back\\slash",     # must escape -> fallback
+            "tab\tchar",       # control char -> escaped by json.dumps
+            "ライン",
+            "a" * 50,
+            "",
+        ]
+        return pools[rng.integers(0, len(pools))]
+
+    def test_random_lines_never_extract_wrong_values(self):
+        rng = np.random.default_rng(1234)
+        lines = []
+        recs = []
+        for _ in range(500):
+            rec = {}
+            for name in self.FIELDS:
+                if rng.random() < 0.7:
+                    rec[name] = self._random_string(rng)
+            if rng.random() < 0.5:
+                rec["properties"] = {
+                    "rating": float(rng.integers(1, 6)),
+                    "note": self._random_string(rng),
+                }
+            if rng.random() < 0.3:
+                rec["tags"] = [self._random_string(rng)]
+            if rng.random() < 0.2:
+                rec["extraKey"] = self._random_string(rng)
+            recs.append(rec)
+            lines.append(json.dumps(rec, ensure_ascii=rng.random() < 0.5))
+        buf = ("\n".join(lines) + "\n").encode()
+        scanned = native.scan_events(buf)
+        assert len(scanned) == len(recs)
+        for i, rec in enumerate(recs):
+            if scanned.flags[i] & native.FLAG_FALLBACK:
+                continue  # json fallback handles it — always safe
+            for name, slot in self.FIELDS.items():
+                got = scanned.field_str(i, slot)
+                assert got == rec.get(name), (
+                    f"line {i} field {name}: native {got!r} != "
+                    f"json {rec.get(name)!r} ({lines[i]!r})"
+                )
+
+    def test_malformed_lines_always_flagged(self):
+        malformed = [
+            b'{"event":"a"',                      # truncated
+            b'{"event":"a"}{"event":"b"}',        # concatenated
+            b'["not","an","object"]',
+            b'garbage',
+            b'{"event":}',
+            b'{broken',
+            b'{"a":"b",}',
+        ]
+        buf = b"\n".join(malformed) + b"\n"
+        scanned = native.scan_events(buf)
+        for i in range(len(malformed)):
+            assert scanned.flags[i] & native.FLAG_FALLBACK, malformed[i]
+
+    def test_escaped_key_forces_fallback(self):
+        """A known field name written with a JSON escape must push the
+        line to the json fallback: json.loads sees a duplicate key (last
+        wins) the span scanner cannot."""
+        line = (
+            b'{"event":"rate","entityType":"user","entityId":"x",'
+            b'"entityI\\u0064":"y"}\n'
+        )
+        scanned = native.scan_events(line)
+        assert scanned.flags[0] & native.FLAG_FALLBACK
+        (e,) = native.parse_events_jsonl(line)
+        assert e.entity_id == "y"  # json.loads semantics

@@ -4,6 +4,7 @@ SegmentIOAuthSpec, AdminAPISpec — real sockets on localhost)."""
 from __future__ import annotations
 
 import json
+import threading
 import urllib.error
 import urllib.request
 
@@ -395,3 +396,46 @@ class TestFeedbackLoop:
         finally:
             server.stop()
             es.stop()
+
+
+class TestReloadUnderLoad:
+    def test_queries_survive_concurrent_reloads(self, deployed_engine):
+        """Hot-swap must never surface a torn model to in-flight queries:
+        hammer /queries.json from worker threads while /reload swaps
+        instances; every response must be a well-formed 200."""
+        import concurrent.futures
+        from predictionio_tpu.core.workflow import run_train
+
+        base = deployed_engine["base"]
+        # a second completed instance so reload has something to swap to
+        run_train(
+            deployed_engine["engine"], deployed_engine["ep"], engine_id="serve",
+            storage=deployed_engine["storage"],
+        )
+        stop = threading.Event()
+        errors: list = []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    status, body = http(
+                        "POST", f"{base}/queries.json", {"user": "u1", "num": 2}
+                    )
+                    if status != 200 or "itemScores" not in body:
+                        errors.append((status, body))
+                except Exception as e:  # noqa: BLE001 - collect, then fail
+                    errors.append(repr(e))
+
+        with concurrent.futures.ThreadPoolExecutor(4) as pool:
+            futures = [pool.submit(hammer) for _ in range(3)]
+            try:
+                for _ in range(10):
+                    status, _ = http(
+                        "POST", f"{base}/reload?accessKey=secret"
+                    )
+                    assert status == 200
+            finally:
+                stop.set()  # or a failed assert deadlocks pool shutdown
+            for f in futures:
+                f.result(timeout=30)
+        assert not errors, errors[:3]
