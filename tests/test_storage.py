@@ -564,3 +564,65 @@ class TestScanRatings:
             for r, c, v in zip(b.rows, b.cols, b.vals)
         }
         assert got == {("u1", "i1", 5.0)}
+
+
+class TestScanRatingsFuzz:
+    def test_randomized_parity_with_fallback(self, any_storage):
+        """Differential: each backend's columnar fast path must equal the
+        find()-based fallback on a randomized store — random inserts
+        (generated + explicit ids, some escaped), replacements, deletes,
+        rating properties present/absent, and override/default rules."""
+        import numpy as np
+
+        from predictionio_tpu.data.storage import base as storage_base
+
+        rng = np.random.default_rng(777)
+        events = any_storage.get_events()
+        events.init(77)
+        live_ids: list[str] = []
+        for i in range(300):
+            op = rng.random()
+            if op < 0.08 and live_ids:
+                victim = live_ids.pop(int(rng.integers(0, len(live_ids))))
+                events.delete(victim, 77)
+                continue
+            name = ["rate", "buy", "view"][int(rng.integers(0, 3))]
+            props = {}
+            if rng.random() < 0.7:
+                props["rating"] = float(rng.integers(1, 6))
+            if rng.random() < 0.1:
+                props["note"] = 'esc"aped\tval'
+            e = Event(
+                event=name,
+                entity_type="user",
+                entity_id=f"u{rng.integers(0, 40)}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.integers(0, 25)}",
+                properties=props,
+                event_time=T0 + timedelta(minutes=int(rng.integers(0, 500))),
+            )
+            if op < 0.16 and live_ids:  # replace an existing id
+                eid = live_ids[int(rng.integers(0, len(live_ids)))]
+                events.insert(e.with_event_id(eid), 77)
+            else:
+                live_ids.append(events.insert(e, 77))
+
+        kwargs = dict(
+            event_names=["rate", "buy"],
+            entity_type="user",
+            target_entity_type="item",
+            rating_key="rating",
+            default_ratings={"rate": 2.5},
+            override_ratings={"buy": 4.0},
+        )
+        fast = events.scan_ratings(77, **kwargs)
+        slow = storage_base.Events.scan_ratings(events, 77, **kwargs)
+
+        def triples(b):
+            return sorted(
+                (u, t, float(v))
+                for (u, t), v in zip(b.iter_pairs(), b.vals)
+            )
+
+        assert triples(fast) == triples(slow)
+        assert len(fast) == len(slow)
