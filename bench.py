@@ -683,6 +683,23 @@ def main() -> None:
     if device_fallback is not None:
         os.environ["JAX_PLATFORMS"] = "cpu"
         apply_platform_env()
+        # a degraded run must still finish and produce a complete,
+        # clearly-labeled artifact: trim the device-scale sections to
+        # what a (possibly single-core) host CPU completes in bounded
+        # time, unless the operator explicitly asked for them
+        global E2E_EVENTS
+        if "BENCH_SCALES" not in os.environ:
+            # keep 20m if the operator explicitly asked for a rank sweep
+            # (it only runs inside the 20m section)
+            RUN_SCALES[:] = (
+                ["100k", "20m"]
+                if os.environ.get("BENCH_RANK_SWEEP")
+                else ["100k"]
+            )
+        if "BENCH_RANK_SWEEP" not in os.environ:
+            RANK_SWEEP.clear()
+        if "BENCH_E2E_EVENTS" not in os.environ:
+            E2E_EVENTS = 1_000_000
 
     # all storage for serving/e2e lives in one throwaway dir; configure
     # BEFORE the first get_storage() call binds the singleton

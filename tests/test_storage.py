@@ -625,4 +625,3 @@ class TestScanRatingsFuzz:
             )
 
         assert triples(fast) == triples(slow)
-        assert len(fast) == len(slow)
