@@ -16,25 +16,61 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from predictionio_tpu import __version__
 
 
+def _enter_engine_dir(args) -> None:
+    """``--engine-dir DIR`` (default: the cwd): run as if launched from
+    an engine template directory — its ``engine.json`` becomes the
+    default variant and the directory joins ``sys.path`` so a local
+    template package imports (the reference CLI's run-from-template-dir
+    workflow; Console.scala resolves engine.json relative to the working
+    directory)."""
+    engine_dir = os.path.abspath(
+        getattr(args, "engine_dir", None) or os.getcwd()
+    )
+    entered = bool(getattr(args, "engine_dir", None))
+    if not getattr(args, "variant", None):
+        candidate = os.path.join(engine_dir, "engine.json")
+        if os.path.exists(candidate):
+            args.variant = candidate
+            entered = True
+    # a console-script entry point has no cwd on sys.path, so entering an
+    # engine dir (explicitly or by picking up its engine.json) must add
+    # it for the local template package to import
+    if entered and engine_dir not in sys.path:
+        sys.path.insert(0, engine_dir)
+
+
+def _variant_label(args) -> str:
+    """The engine-instance variant label: the variant FILE NAME, so
+    `pio train --engine-dir d` and `cd d && pio deploy` agree on the
+    label regardless of how the path was spelled."""
+    return (
+        os.path.basename(getattr(args, "variant", None) or "") or "default"
+    )
+
+
 def _engine_from_args(args) -> tuple:
     """Resolve (engine, variant dict, factory name) from --engine-factory /
-    --variant (engine.json)."""
+    --variant (engine.json; defaults to ./engine.json like the
+    reference) / --engine-dir."""
     from predictionio_tpu.core.engine import resolve_engine_factory
     from predictionio_tpu.core.workflow import load_variant
 
+    _enter_engine_dir(args)
     variant: dict = {}
     if getattr(args, "variant", None):
         variant = load_variant(args.variant)
     factory = getattr(args, "engine_factory", None) or variant.get("engineFactory")
     if not factory:
         raise SystemExit(
-            "error: specify --engine-factory dotted.path or a --variant JSON "
-            "with an engineFactory field"
+            "error: specify --engine-factory dotted.path, a --variant JSON "
+            "with an engineFactory field, or run from an engine directory "
+            "containing engine.json (see --engine-dir)"
         )
     engine = resolve_engine_factory(factory)
     return engine, variant, factory
@@ -56,6 +92,7 @@ def cmd_status(args) -> int:
 
 def cmd_build(args) -> int:
     """Python engines need no assembly; verify the factory imports."""
+    _enter_engine_dir(args)
     if getattr(args, "engine_factory", None) or getattr(args, "variant", None):
         _engine_from_args(args)
         print("Engine factory resolves; build OK.")
@@ -172,7 +209,7 @@ def cmd_train(args) -> int:
         engine_params,
         engine_id=variant.get("id", "default"),
         engine_version=variant.get("version", "0"),
-        engine_variant=args.variant or "default",
+        engine_variant=_variant_label(args),
         engine_factory=factory,
         workflow_params=wp,
     )
@@ -225,7 +262,7 @@ def cmd_deploy(args) -> int:
         instance = instances.get_latest_completed(
             variant.get("id", "default"),
             variant.get("version", "0"),
-            args.variant or "default",
+            _variant_label(args),
         )
         if instance is None:
             print(
@@ -485,6 +522,7 @@ def build_parser() -> argparse.ArgumentParser:
     b = sub.add_parser("build")
     b.add_argument("--engine-factory")
     b.add_argument("--variant")
+    b.add_argument("--engine-dir")
     b.set_defaults(fn=cmd_build)
 
     a = sub.add_parser("app")
@@ -519,6 +557,7 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train")
     t.add_argument("--engine-factory")
     t.add_argument("--variant")
+    t.add_argument("--engine-dir")
     t.add_argument("--batch", default="")
     t.add_argument("--verbose", action="count", default=0)
     t.add_argument("--skip-sanity-check", action="store_true")
@@ -541,6 +580,7 @@ def build_parser() -> argparse.ArgumentParser:
     d = sub.add_parser("deploy")
     d.add_argument("--engine-factory")
     d.add_argument("--variant")
+    d.add_argument("--engine-dir")
     d.add_argument("--engine-instance-id")
     d.add_argument("--ip", default="0.0.0.0")
     d.add_argument("--port", type=int, default=8000)

@@ -18,14 +18,14 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def pio(args, env, timeout=180, check=True):
+def pio(args, env, timeout=180, check=True, cwd=REPO):
     proc = subprocess.run(
         [sys.executable, "-m", "predictionio_tpu.cli.main", *args],
         capture_output=True,
         text=True,
         env=env,
         timeout=timeout,
-        cwd=REPO,
+        cwd=cwd,
     )
     if check and proc.returncode != 0:
         raise AssertionError(
@@ -276,3 +276,61 @@ class TestStartStopAll:
         else:
             raise AssertionError("event server port still open after stop-all")
         assert "Nothing to stop" in pio(["stop-all"], env).stdout
+
+
+class TestEngineDir:
+    def test_train_from_engine_directory(self, cli_env, tmp_path):
+        """The reference workflow: an engine template directory with its
+        own package and engine.json, driven by `pio train --engine-dir`
+        (and bare `pio train` run inside it)."""
+        out = pio(["app", "new", "DirApp"], cli_env).stdout
+        assert "Access Key:" in out
+        events_file = tmp_path / "ev.jsonl"
+        with open(events_file, "w") as f:
+            for u in range(8):
+                for i in range(5):
+                    f.write(json.dumps({
+                        "event": "rate", "entityType": "user",
+                        "entityId": f"u{u}", "targetEntityType": "item",
+                        "targetEntityId": f"i{(u + i) % 6}",
+                        "properties": {"rating": float((u * i) % 5 + 1)},
+                        "eventTime": "2020-01-01T00:00:00.000Z",
+                    }) + "\n")
+        pio(["import", "--appid-or-name", "DirApp",
+             "--input", str(events_file)], cli_env)
+
+        engine_dir = tmp_path / "myengine"
+        pkg = engine_dir / "dirtemplate"
+        pkg.mkdir(parents=True)
+        (pkg / "__init__.py").write_text(
+            "from predictionio_tpu.models import recommendation\n"
+            "def engine():\n"
+            "    return recommendation.engine()\n"
+        )
+        (engine_dir / "engine.json").write_text(json.dumps({
+            "id": "dir",
+            "engineFactory": "dirtemplate.engine",
+            "datasource": {"params": {"app_name": "DirApp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "num_iterations": 2}}
+            ],
+        }))
+        out = pio(
+            ["train", "--engine-dir", str(engine_dir)], cli_env
+        ).stdout
+        assert "Training completed" in out
+        # reference style: bare `pio train` from inside the engine dir
+        out = pio(["train"], cli_env, cwd=str(engine_dir)).stdout
+        assert "Training completed" in out
+        # both spellings must record the SAME variant label, so deploy
+        # finds the instances no matter where it runs from
+        from predictionio_tpu.data.storage import Storage
+
+        s = Storage(env={
+            k: v for k, v in cli_env.items() if k.startswith("PIO_")
+        })
+        insts = s.get_metadata_engine_instances().get_completed(
+            "dir", "0", "engine.json"
+        )
+        assert len(insts) == 2
+        s.close()
