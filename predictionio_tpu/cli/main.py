@@ -25,24 +25,34 @@ from predictionio_tpu import __version__
 def _enter_engine_dir(args) -> None:
     """``--engine-dir DIR`` (default: the cwd): run as if launched from
     an engine template directory — its ``engine.json`` becomes the
-    default variant and the directory joins ``sys.path`` so a local
-    template package imports (the reference CLI's run-from-template-dir
-    workflow; Console.scala resolves engine.json relative to the working
-    directory)."""
+    default variant, and the directory holding the variant file joins
+    ``sys.path`` so a local template package imports (the reference
+    CLI's run-from-template-dir workflow; Console.scala resolves
+    engine.json relative to the working directory). Idempotent: safe to
+    call from any command prologue."""
+    if getattr(args, "_engine_dir_entered", False):
+        return
+    args._engine_dir_entered = True
     engine_dir = os.path.abspath(
         getattr(args, "engine_dir", None) or os.getcwd()
     )
-    entered = bool(getattr(args, "engine_dir", None))
     if not getattr(args, "variant", None):
         candidate = os.path.join(engine_dir, "engine.json")
         if os.path.exists(candidate):
             args.variant = candidate
-            entered = True
-    # a console-script entry point has no cwd on sys.path, so entering an
-    # engine dir (explicitly or by picking up its engine.json) must add
-    # it for the local template package to import
-    if entered and engine_dir not in sys.path:
-        sys.path.insert(0, engine_dir)
+    # a console-script entry point has no cwd on sys.path: the directory
+    # holding the variant file IS the engine dir, and its local template
+    # package must import no matter how the variant was named (bare cwd
+    # pickup, --engine-dir, or explicit --variant — including daemon
+    # children that only receive --variant)
+    dirs = []
+    if getattr(args, "engine_dir", None):
+        dirs.append(engine_dir)
+    if getattr(args, "variant", None):
+        dirs.append(os.path.dirname(os.path.abspath(args.variant)))
+    for d in dirs:
+        if d not in sys.path:
+            sys.path.insert(0, d)
 
 
 def _variant_label(args) -> str:
@@ -52,6 +62,22 @@ def _variant_label(args) -> str:
     return (
         os.path.basename(getattr(args, "variant", None) or "") or "default"
     )
+
+
+def _engine_identity(args, variant: dict) -> tuple[str, str, str]:
+    """(engine_id, version, variant label) — the instance lookup key.
+
+    A variant without an ``id`` field falls back to the real path of its
+    directory, so two different id-less engines never collide on the
+    (default, 0, engine.json) key while the same engine resolves
+    identically from every invocation style."""
+    engine_id = variant.get("id")
+    if not engine_id:
+        v = getattr(args, "variant", None)
+        engine_id = (
+            os.path.dirname(os.path.realpath(v)) if v else "default"
+        )
+    return engine_id, variant.get("version", "0"), _variant_label(args)
 
 
 def _engine_from_args(args) -> tuple:
@@ -92,7 +118,7 @@ def cmd_status(args) -> int:
 
 def cmd_build(args) -> int:
     """Python engines need no assembly; verify the factory imports."""
-    _enter_engine_dir(args)
+    _enter_engine_dir(args)  # idempotent; resolves ./engine.json pickup
     if getattr(args, "engine_factory", None) or getattr(args, "variant", None):
         _engine_from_args(args)
         print("Engine factory resolves; build OK.")
@@ -204,12 +230,13 @@ def cmd_train(args) -> int:
         profile_dir=args.profile_dir,
         mesh_axes=_parse_mesh(getattr(args, "mesh", None)),
     )
+    engine_id, engine_version, variant_label = _engine_identity(args, variant)
     instance_id = run_train(
         engine,
         engine_params,
-        engine_id=variant.get("id", "default"),
-        engine_version=variant.get("version", "0"),
-        engine_variant=_variant_label(args),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=variant_label,
         engine_factory=factory,
         workflow_params=wp,
     )
@@ -259,11 +286,21 @@ def cmd_deploy(args) -> int:
             print(f"engine instance {args.engine_instance_id} not found", file=sys.stderr)
             return 1
     else:
-        instance = instances.get_latest_completed(
-            variant.get("id", "default"),
-            variant.get("version", "0"),
-            _variant_label(args),
+        engine_id, engine_version, variant_label = _engine_identity(
+            args, variant
         )
+        instance = instances.get_latest_completed(
+            engine_id, engine_version, variant_label
+        )
+        if instance is None and getattr(args, "variant", None):
+            # instances trained before the basename-label change carry
+            # the as-typed path as their label; fall back so they stay
+            # deployable without a retrain
+            instance = instances.get_latest_completed(
+                variant.get("id", "default"),
+                engine_version,
+                args.variant,
+            )
         if instance is None:
             print(
                 "No valid engine instance found for this engine; "
@@ -424,14 +461,17 @@ def cmd_start_all(args) -> int:
                 args.admin_port,
             )
         )
-    if args.variant or args.engine_factory:
+    if args.variant or args.engine_factory or args.engine_dir:
         # beyond the reference's script: also deploy the latest trained
-        # engine so one verb yields a fully queryable stack
+        # engine so one verb yields a fully queryable stack. Paths go
+        # absolute — the daemon child's cwd is not this shell's.
         deploy = ["deploy", "--ip", args.ip, "--port", str(args.engine_port)]
         if args.variant:
-            deploy += ["--variant", args.variant]
+            deploy += ["--variant", os.path.abspath(args.variant)]
         if args.engine_factory:
             deploy += ["--engine-factory", args.engine_factory]
+        if args.engine_dir:
+            deploy += ["--engine-dir", os.path.abspath(args.engine_dir)]
         plan.append(("engine", deploy, args.engine_port))
 
     started: list[str] = []
@@ -656,6 +696,7 @@ def build_parser() -> argparse.ArgumentParser:
     sa.add_argument("--no-adminserver", action="store_true")
     sa.add_argument("--variant", help="also deploy this engine variant")
     sa.add_argument("--engine-factory", help="also deploy this engine factory")
+    sa.add_argument("--engine-dir", help="also deploy the engine in this dir")
     sa.set_defaults(fn=cmd_start_all)
 
     sub.add_parser("stop-all").set_defaults(fn=cmd_stop_all)
