@@ -638,6 +638,7 @@ def als_train_sweep(
     static_fields = (
         "rank", "iterations", "implicit", "weighted_reg",
         "implicit_weighted_reg", "compute_dtype", "bucket_widths",
+        "gather_chunk_bytes",
     )
     for p in params_list[1:]:
         diffs = [f for f in static_fields if getattr(p, f) != getattr(base, f)]

@@ -472,3 +472,30 @@ class TestChunkedGather:
         full = als.rmse(U, V, rows, cols, vals)
         chunked = als.rmse(U, V, rows, cols, vals, chunk=97)
         assert abs(full - chunked) < 1e-6
+
+
+class TestSweepWithChunkedGathers:
+    def test_vmapped_sweep_matches_serial_under_chunking(self):
+        """als_train_sweep vmaps candidates over the fused program; with
+        a tiny gather budget the bucket solves run through lax.map chunks
+        INSIDE the vmap — must still match serial training per candidate."""
+        rng = np.random.default_rng(21)
+        rows = rng.integers(0, 40, 500).astype(np.int32)
+        cols = rng.integers(0, 30, 500).astype(np.int32)
+        vals = (1 + 4 * rng.random(500)).astype(np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 40, 30)
+        cands = [
+            als.ALSParams(rank=5, iterations=2, reg=r,
+                          gather_chunk_bytes=512)
+            for r in (0.05, 0.2, 1.0)
+        ]
+        swept = als.als_train_sweep(data, cands)
+        assert len(swept) == 3
+        for p, (U, V) in zip(cands, swept):
+            U_s, V_s = als.als_train(data, p)
+            np.testing.assert_allclose(
+                np.asarray(U), np.asarray(U_s), rtol=2e-4, atol=2e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(V), np.asarray(V_s), rtol=2e-4, atol=2e-5
+            )
