@@ -402,3 +402,60 @@ class TestRemoteSearch:
         events.init(2)
         with pytest.raises(HTTPStorageError, match="does not implement"):
             events.search(2, "anything")
+
+
+class TestRemotePartitioned:
+    def test_partitioned_store_behind_storage_service(self, tmp_path):
+        """The full production topology: the storage service fronting the
+        scalable partitioned event store, with point ops, windowed finds
+        (time-pruned server-side), and the columnar scan over the wire."""
+        from datetime import datetime, timedelta, timezone
+
+        backing = Storage(env={
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "m.db"),
+            "PIO_STORAGE_SOURCES_PART_TYPE": "partitioned",
+            "PIO_STORAGE_SOURCES_PART_PATH": str(tmp_path / "ev"),
+            "PIO_STORAGE_SOURCES_PART_PARTITIONS": "4",
+            "PIO_STORAGE_SOURCES_PART_SEGMENT_BYTES": "1500",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PART",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        server = StorageServer(storage=backing, host="127.0.0.1", port=0,
+                               auth_key="sekret")
+        port = server.start(background=True)
+        remote = Storage(env={
+            "PIO_STORAGE_SOURCES_REMOTE_TYPE": "http",
+            "PIO_STORAGE_SOURCES_REMOTE_URL": f"http://127.0.0.1:{port}",
+            "PIO_STORAGE_SOURCES_REMOTE_AUTH_KEY": "sekret",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "REMOTE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "REMOTE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "REMOTE",
+        })
+        try:
+            t0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+            events = remote.get_events()
+            ids = []
+            for i in range(40):
+                ids.append(events.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{i % 6}",
+                    target_entity_type="item", target_entity_id=f"i{i % 5}",
+                    properties={"rating": float(i % 5 + 1)},
+                    event_time=t0 + timedelta(minutes=i),
+                ), 5))
+            assert events.get(ids[3], 5).entity_id == "u3"
+            assert events.delete(ids[3], 5)
+            windowed = events.find(
+                5,
+                start_time=t0 + timedelta(minutes=10),
+                until_time=t0 + timedelta(minutes=20),
+            )
+            assert len(windowed) == 10  # deleted event is at minute 3
+            batch = events.scan_ratings(5, event_names=["rate"])
+            assert len(batch) == 39
+            assert sorted(batch.entity_ids) == [f"u{k}" for k in range(6)]
+        finally:
+            remote.close()
+            server.stop()
+            backing.close()
