@@ -12,7 +12,7 @@ import subprocess
 import sys
 import time
 import urllib.request
-from datetime import datetime, timezone
+from datetime import datetime, timedelta, timezone
 
 import numpy as np
 import pytest
@@ -409,8 +409,6 @@ class TestRemotePartitioned:
         """The full production topology: the storage service fronting the
         scalable partitioned event store, with point ops, windowed finds
         (time-pruned server-side), and the columnar scan over the wire."""
-        from datetime import datetime, timedelta, timezone
-
         backing = Storage(env={
             "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
             "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "m.db"),
