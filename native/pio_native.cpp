@@ -307,9 +307,15 @@ void scan_one_line(const char* buf, const char* p, const char* line_end,
   }
 }
 
-int scan_thread_count(long n_lines) {
-  const char* env = std::getenv("PIO_NATIVE_THREADS");
-  long requested = env ? std::atol(env) : 0;
+int scan_thread_count(long n_lines, long requested) {
+  if (requested <= 0) {
+    // env override for benchmarking/tests; read only when the caller
+    // didn't pass an explicit count (callers that run concurrently with
+    // other threads pass it explicitly — getenv racing a putenv from
+    // another thread is UB in glibc)
+    const char* env = std::getenv("PIO_NATIVE_THREADS");
+    requested = env ? std::atol(env) : 0;
+  }
   if (requested > 0) {
     // explicit override wins outright (benchmarking / tests)
     return (int)(requested > n_lines ? (n_lines < 1 ? 1 : n_lines)
@@ -336,10 +342,12 @@ extern "C" {
 // Large buffers scan MULTITHREADED (std::thread over line ranges; output
 // rows are disjoint, the buffer is read-only — the caller releases the
 // GIL for the whole call via ctypes): first pass indexes newlines, second
-// pass extracts field spans in parallel. PIO_NATIVE_THREADS overrides the
-// thread count (default: min(cores, 8), scaled down for small inputs).
+// pass extracts field spans in parallel. n_threads > 0 pins the thread
+// count; 0 = auto (PIO_NATIVE_THREADS env override, else min(cores, 8),
+// scaled down for small inputs).
 long pio_scan_events(const char* buf, long buflen, int64_t* offs,
-                     int64_t* lens, uint8_t* flags, long capacity) {
+                     int64_t* lens, uint8_t* flags, long capacity,
+                     long n_threads) {
   // pass 1: line starts (cheap memchr sweep); line ends are derived —
   // ends[i] = starts[i+1] - 1 (the newline), last line ends at bufend
   // unless the buffer is newline-terminated
@@ -355,7 +363,7 @@ long pio_scan_events(const char* buf, long buflen, int64_t* offs,
   long n = (long)starts.size();
   const char* last_end =
       (buflen > 0 && buf[buflen - 1] == '\n') ? bufend - 1 : bufend;
-  int nthreads = scan_thread_count(n);
+  int nthreads = scan_thread_count(n, n_threads);
   auto run = [&](long lo_line, long hi_line) {
     for (long i = lo_line; i < hi_line; ++i) {
       const char* line_end =

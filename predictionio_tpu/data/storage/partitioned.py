@@ -75,6 +75,21 @@ _SEG_RE = re.compile(r"^seg_(\d{6})\.jsonl$")
 MAX_PARTITIONS = 256  # two hex digits embed the partition in the event id
 
 
+def _mkdir_racing(d: Path) -> None:
+    """mkdir -p that tolerates a concurrent remove(): pathlib's exist_ok
+    check itself races (os.mkdir raises FileExistsError, then is_dir()
+    sees the dir already deleted again); retry until one state sticks."""
+    for _ in range(20):
+        try:
+            d.mkdir(parents=True, exist_ok=True)
+            return
+        except (FileExistsError, FileNotFoundError):
+            continue
+    raise RuntimeError(  # pragma: no cover - pathological remove() storm
+        f"could not create {d}: concurrent removals kept deleting it"
+    )
+
+
 class PartitionedStorageClient:
     def __init__(self, config: dict | None = None):
         self.config = dict(config or {})
@@ -95,9 +110,10 @@ class PartitionedStorageClient:
         # per-partition-dir thread locks (cross-process safety comes from
         # the flock; a global lock here would serialize the parallel scans)
         self.path_locks: dict[str, threading.RLock] = {}
-        # namespace dir -> partition count (immutable once created, so a
-        # plain cache; invalidated on remove())
-        self.ns_partitions: dict[str, int] = {}
+        # namespace dir -> (partition count, meta-file (inode, mtime_ns))
+        # — the count is immutable for one life of the namespace; the
+        # identity pair detects a remove()+recreate by another process
+        self.ns_partitions: dict[str, tuple[int, tuple[int, int]]] = {}
         # namespace dir -> tuple of (path, mtime_ns, size) last proven
         # replay-clean (unique ids, no delete markers): lets scan_ratings
         # skip the uniqueness pass until any file changes
@@ -121,30 +137,57 @@ class PartitionedEvents(base.Events):
 
     ROUTING_HASH = "fnv1a32"  # must match native.route_id_bytes
 
-    def _publish_meta(self, ns: Path, n: int) -> int:
+    def _publish_meta(self, ns: Path, n: int) -> tuple[int, tuple[int, int]]:
         """Atomically create ``_meta.json`` with count ``n`` unless one
-        already exists; returns the winning count. The routing hash is
+        already exists; returns (winning count, meta-file identity). The
+        identity pair (inode, mtime_ns) is fstat'ed from the same open
+        fd the count is read from, so it describes exactly the file that
+        produced the count — a caller caching (count, identity) can't
+        pair a stale count with a newer file. The routing hash is
         recorded alongside the partition count and verified on read —
         opening a store routed by a different hash must fail loudly, not
         silently misroute point ops (export + re-import migrates)."""
         meta = ns / "_meta.json"
-        if not meta.exists():
-            ns.mkdir(parents=True, exist_ok=True)
-            # per-process-unique temp name: a shared name would let two
-            # first-initializers publish each other's half-written file
-            tmp = ns / f"_meta.json.tmp.{os.getpid()}.{uuid.uuid4().hex}"
-            tmp.write_text(
-                json.dumps({"partitions": n, "hash": self.ROUTING_HASH})
-            )
+        for _ in range(20):
+            if not meta.exists():
+                _mkdir_racing(ns)
+                # per-process-unique temp name: a shared name would let
+                # two first-initializers publish each other's
+                # half-written file
+                tmp = ns / f"_meta.json.tmp.{os.getpid()}.{uuid.uuid4().hex}"
+                try:
+                    tmp.write_text(
+                        json.dumps(
+                            {"partitions": n, "hash": self.ROUTING_HASH}
+                        )
+                    )
+                    # atomic create-if-absent: a concurrent process may
+                    # have written meta between the check and now —
+                    # theirs wins
+                    os.link(tmp, meta)
+                except FileExistsError:
+                    pass
+                except FileNotFoundError:
+                    # a concurrent remove() rmtree'd the dir (and our
+                    # tmp with it) mid-publish; recreate and retry
+                    continue
+                finally:
+                    tmp.unlink(missing_ok=True)
             try:
-                # atomic create-if-absent: a concurrent process may have
-                # written meta between the check and now — theirs wins
-                os.link(tmp, meta)
-            except FileExistsError:
-                pass
-            finally:
-                tmp.unlink(missing_ok=True)
-        side = json.loads(meta.read_text())
+                with open(meta, "rb") as f:
+                    st = os.fstat(f.fileno())
+                    side = json.loads(f.read())
+                ident = (st.st_ino, st.st_mtime_ns)
+                break
+            except FileNotFoundError:
+                # a concurrent remove() deleted the namespace between
+                # publish and read; republish for its new life
+                continue
+        else:  # pragma: no cover - pathological remove() storm
+            raise RuntimeError(
+                f"could not publish _meta.json for {ns.name}: "
+                "concurrent removals kept deleting it"
+            )
         stored_hash = side.get("hash", "<none>")
         if stored_hash != self.ROUTING_HASH:
             raise RuntimeError(
@@ -153,27 +196,35 @@ class PartitionedEvents(base.Events):
                 f"{self.ROUTING_HASH!r} — export from a matching build and "
                 "re-import to migrate"
             )
-        return int(side["partitions"])
+        return int(side["partitions"]), ident
 
     def _n_partitions(self, ns: Path) -> int:
         """Partition count for a namespace: the persisted value wins.
 
-        Cached per client (the count is immutable once created), so the
-        hot write/read paths don't take the client lock or touch disk."""
+        Cached per client keyed by the meta file's identity (inode +
+        mtime), so the hot write/read paths cost one stat and no client
+        lock — and a cross-process remove()+recreate with a DIFFERENT
+        count is detected (new meta file = new inode) instead of routing
+        by the stale cached count."""
         meta = ns / "_meta.json"
-        n = self._c.ns_partitions.get(str(ns))
-        if n is not None:
-            # one stat per op: if another process removed the namespace,
-            # the cached count must not let writes recreate data dirs
-            # without a meta file (the slow path re-publishes meta first,
-            # so the first-writer-wins invariant holds for the new life)
-            if meta.exists():
+        cached = self._c.ns_partitions.get(str(ns))
+        if cached is not None:
+            n, ident = cached
+            try:
+                st = meta.stat()
+            except OSError:
+                # namespace removed: the cached count must not let writes
+                # recreate data dirs without a meta file (the slow path
+                # re-publishes meta first, so first-writer-wins holds for
+                # the new life)
+                st = None
+            if st is not None and (st.st_ino, st.st_mtime_ns) == ident:
                 return n
             with self._c.lock:
                 self._c.ns_partitions.pop(str(ns), None)
         with self._c.lock:
-            n = self._publish_meta(ns, self._c.partitions)
-            self._c.ns_partitions[str(ns)] = n
+            n, ident = self._publish_meta(ns, self._c.partitions)
+            self._c.ns_partitions[str(ns)] = (n, ident)
             return n
 
     def _ensure_meta_locked(self, ns: Path, n: int) -> None:
@@ -183,7 +234,7 @@ class PartitionedEvents(base.Events):
         namespace's new life keeps a meta consistent with its first
         record. If another writer republished a different count first,
         our routing is stale: refuse rather than misroute."""
-        won = self._publish_meta(ns, n)
+        won, _ = self._publish_meta(ns, n)
         if won != n:
             with self._c.lock:
                 self._c.ns_partitions.pop(str(ns), None)
@@ -195,7 +246,7 @@ class PartitionedEvents(base.Events):
 
     def _pdir(self, ns: Path, pp: int) -> Path:
         d = ns / f"p{pp:02x}"
-        d.mkdir(parents=True, exist_ok=True)
+        _mkdir_racing(d)
         return d
 
     def _tlock(self, pdir: Path) -> threading.RLock:
@@ -215,12 +266,46 @@ class PartitionedEvents(base.Events):
             if fcntl is None:  # pragma: no cover - non-POSIX
                 yield
                 return
-            with open(pdir / ".lock", "w") as lf:
+            lock_path = pdir / ".lock"
+            for _ in range(100):
+                # a remove() may have rmtree'd the dir between our _pdir
+                # mkdir and this open (we were blocked on the thread lock
+                # it held, or a cross-process remover's); recreate and
+                # retry — the namespace's new life starts with whoever
+                # acquires the lock next
+                try:
+                    lf = open(lock_path, "w")
+                except FileNotFoundError:
+                    _mkdir_racing(pdir)
+                    continue
                 fcntl.flock(lf, fcntl.LOCK_EX)
+                # a cross-process remove() can unlink the lock file while
+                # we block in flock: our lock is then on a dead inode and
+                # a later writer flocking the RECREATED file would run
+                # concurrently with us — verify the path still names our
+                # inode before trusting the lock
+                try:
+                    st_path = os.stat(lock_path)
+                except FileNotFoundError:
+                    st_path = None
+                st_fd = os.fstat(lf.fileno())
+                if st_path is None or (
+                    (st_path.st_dev, st_path.st_ino)
+                    != (st_fd.st_dev, st_fd.st_ino)
+                ):
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+                    lf.close()
+                    continue
                 try:
                     yield
+                    return
                 finally:
                     fcntl.flock(lf, fcntl.LOCK_UN)
+                    lf.close()
+            raise RuntimeError(  # pragma: no cover - remove() storm
+                f"could not acquire partition lock {lock_path}: "
+                "concurrent removals kept deleting it"
+            )
 
     @contextlib.contextmanager
     def _locked_all(self, ns: Path, n: int):
@@ -348,7 +433,11 @@ class PartitionedEvents(base.Events):
             "opaque": opaque,
         }
         active.rename(seg)
-        (pdir / f"seg_{n:06d}.meta.json").write_text(json.dumps(side))
+        # atomic: a torn sidecar would otherwise poison every windowed
+        # find of this partition (replay parses it)
+        self._write_atomic(
+            pdir / f"seg_{n:06d}.meta.json", json.dumps(side).encode()
+        )
         (pdir / "supersede.log").unlink(missing_ok=True)
         (pdir / "active.opaque").unlink(missing_ok=True)
 
@@ -375,15 +464,27 @@ class PartitionedEvents(base.Events):
             pruned = False
             if window is not None:
                 side_path = pdir / (seg.stem + ".meta.json")
+                side = None
                 if side_path.exists():
-                    side = json.loads(side_path.read_text())
-                    if not side.get("opaque") and side["min_ts"] is not None:
+                    try:
+                        side = json.loads(side_path.read_text())
+                    except ValueError:
+                        # torn sidecar (pre-atomic-write data, or a torn
+                        # filesystem): degrade to folding the segment —
+                        # correct, just unpruned
+                        side = None
+                if side is not None:
+                    if (
+                        not side.get("opaque")
+                        and side.get("min_ts") is not None
+                        and side.get("max_ts") is not None
+                    ):
                         qs, qu = window
                         disjoint = (
                             qu is not None and side["min_ts"] >= qu
                         ) or (qs is not None and side["max_ts"] < qs)
                         if disjoint:
-                            for sid in side["supersedes"]:
+                            for sid in side.get("supersedes", ()):
                                 table.pop(sid, None)
                             pruned = True
             if not pruned:
@@ -402,22 +503,44 @@ class PartitionedEvents(base.Events):
 
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:
         ns = self._ns_dir(app_id, channel_id)
-        # the client lock serializes in-process removers (the second one
-        # sees the namespace gone and returns False); the partition locks
-        # below serialize against writers in other processes
-        with self._c.lock:
-            if not ns.exists():
-                return False
-            n = self._n_partitions(ns)
-            # hold every partition lock so an in-flight writer can't
-            # recreate files mid-rmtree; a writer arriving AFTER the
-            # remove recreates the namespace by design (insert
-            # auto-creates, republishing _meta.json first). _locked_all
-            # itself recreates the partition dirs, so "did it exist" is
-            # answered by the meta file, not the directory.
-            with self._locked_all(ns, n):
-                had_meta = (ns / "_meta.json").exists()
-                shutil.rmtree(ns)
+        # resolve the partition count READ-ONLY, without holding the
+        # client lock across the partition-lock acquisition below: every
+        # other path orders partition-lock -> client-lock (_tlock between
+        # partitions in _locked_all, the clean_stat update in
+        # scan_ratings), so a remover holding the client lock while
+        # acquiring partition locks would invert the order and deadlock
+        # against a concurrent scan. And a remover must never go through
+        # _n_partitions/_publish_meta — that would RECREATE the meta a
+        # concurrent remover just deleted, making both return True and
+        # leaving a phantom namespace behind.
+        try:
+            n = int(json.loads((ns / "_meta.json").read_text())["partitions"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return False  # no (readable) meta: nothing to remove
+        # hold every partition lock so an in-flight writer can't recreate
+        # files mid-rmtree; a writer arriving AFTER the remove recreates
+        # the namespace by design (insert auto-creates, republishing
+        # _meta.json first). _locked_all itself recreates the partition
+        # dirs, so "did it exist" is answered by the meta file, not the
+        # directory — which also serializes concurrent removers: the
+        # second one finds the meta gone and returns False.
+        with self._locked_all(ns, n):
+            had_meta = (ns / "_meta.json").exists()
+            # writers mkdir their partition dir BEFORE blocking on its
+            # lock (_pdir then _locked), so a racing insert can recreate
+            # an (empty — the locks keep data out) dir mid-rmtree; retry
+            # until the walk completes
+            for _ in range(20):
+                try:
+                    shutil.rmtree(ns)
+                    break
+                except FileNotFoundError:
+                    break
+                except OSError:
+                    continue
+            else:
+                shutil.rmtree(ns, ignore_errors=True)
+            with self._c.lock:
                 self._c.clean_stat.pop(ns, None)
                 self._c.ns_partitions.pop(str(ns), None)
         return had_meta
@@ -723,13 +846,14 @@ class PartitionedEvents(base.Events):
             seg = pdir / f"seg_{seg_n:06d}.jsonl"
             self._write_atomic(seg, b"".join(lines[eid] for eid in chunk))
             ts = [times[eid] for eid in chunk]
-            (pdir / f"seg_{seg_n:06d}.meta.json").write_text(
+            self._write_atomic(
+                pdir / f"seg_{seg_n:06d}.meta.json",
                 json.dumps({
                     "min_ts": min(ts),
                     "max_ts": max(ts),
                     "supersedes": [],
                     "opaque": False,
-                })
+                }).encode(),
             )
             chunk, size = [], 0
 
@@ -847,7 +971,7 @@ class PartitionedEvents(base.Events):
         # buffers are immutable snapshots: parse outside the locks
         live = [pp for pp in range(n) if pbufs[pp]]
 
-        def load_one(pp: int):
+        def load_one(pp: int, n_threads: int = 0):
             return native.load_ratings_jsonl(
                 pbufs[pp],
                 event_names=(
@@ -859,6 +983,7 @@ class PartitionedEvents(base.Events):
                 target_entity_type=target_entity_type,
                 override_ratings=override_ratings,
                 scanned=scans[pp],
+                n_threads=n_threads,
             )
 
         if len(live) == 1:
@@ -866,21 +991,16 @@ class PartitionedEvents(base.Events):
         else:
             # one native-scanner thread per pooled worker: the scanner is
             # itself multithreaded for big buffers, and cores x 8 threads
-            # would thrash the parallelism this pool provides (env-based
-            # hint; a concurrent scan racing the window merely runs
-            # single-threaded once)
-            prev = os.environ.get("PIO_NATIVE_THREADS")
-            os.environ["PIO_NATIVE_THREADS"] = "1"
-            try:
-                with ThreadPoolExecutor(
-                    max_workers=min(len(live), os.cpu_count() or 4)
-                ) as pool:
-                    results = list(pool.map(load_one, live))
-            finally:
-                if prev is None:
-                    os.environ.pop("PIO_NATIVE_THREADS", None)
-                else:
-                    os.environ["PIO_NATIVE_THREADS"] = prev
+            # would thrash the parallelism this pool provides (passed as
+            # an explicit argument — mutating the process environment from
+            # here would race getenv in concurrent native scans, which is
+            # undefined behavior in glibc)
+            with ThreadPoolExecutor(
+                max_workers=min(len(live), os.cpu_count() or 4)
+            ) as pool:
+                results = list(
+                    pool.map(lambda pp: load_one(pp, n_threads=1), live)
+                )
 
         user_map: dict[str, int] = {}
         item_map: dict[str, int] = {}

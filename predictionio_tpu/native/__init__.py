@@ -105,6 +105,7 @@ def _load() -> ctypes.CDLL | None:
         lib.pio_scan_events.restype = ctypes.c_long
         lib.pio_scan_events.argtypes = [
             ctypes.c_char_p, ctypes.c_long, i64p, i64p, u8p, ctypes.c_long,
+            ctypes.c_long,
         ]
         lib.pio_index_spans.restype = ctypes.c_long
         lib.pio_index_spans.argtypes = [
@@ -155,9 +156,11 @@ class ScannedEvents:
         return None if b is None else b.decode("utf-8")
 
 
-def scan_events(buf: bytes) -> ScannedEvents:
+def scan_events(buf: bytes, n_threads: int = 0) -> ScannedEvents:
     """Scan a newline-delimited JSON event buffer into field spans.
-    Lines needing the full json parser carry FLAG_FALLBACK."""
+    Lines needing the full json parser carry FLAG_FALLBACK.
+    ``n_threads`` > 0 pins the native scanner's thread count (callers
+    that already parallelize across buffers pass 1); 0 = auto."""
     n_lines = buf.count(b"\n") + (0 if buf.endswith(b"\n") or not buf else 1)
     n_lines = max(n_lines, 1)
     offs = np.empty((n_lines, N_FIELDS), dtype=np.int64)
@@ -166,7 +169,8 @@ def scan_events(buf: bytes) -> ScannedEvents:
     lib = _load()
     if lib is not None:
         n = lib.pio_scan_events(
-            buf, len(buf), offs.reshape(-1), lens.reshape(-1), flags, n_lines
+            buf, len(buf), offs.reshape(-1), lens.reshape(-1), flags,
+            n_lines, n_threads,
         )
         if n >= 0:
             return ScannedEvents(buf, offs[:n], lens[:n], flags[:n])
@@ -388,6 +392,7 @@ def load_ratings_jsonl(
     target_entity_type: str | None = None,
     override_ratings: dict[str, float] | None = None,
     scanned: "ScannedEvents | None" = None,
+    n_threads: int = 0,
 ) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray]:
     """One call from a JSONL event buffer to ALS training arrays:
     (user_ids, item_ids, rows, cols, ratings) with dense indices — the
@@ -404,7 +409,7 @@ def load_ratings_jsonl(
     a prior :func:`scan_events` of the same ``data`` (single-pass reads).
     """
     if scanned is None:
-        scanned = scan_events(data)
+        scanned = scan_events(data, n_threads=n_threads)
     n = len(scanned)
     keep = np.ones(n, dtype=bool)
     keep &= (scanned.flags == 0) & (scanned.offs[:, F_ENTITY_ID] >= 0) & (

@@ -514,3 +514,94 @@ class TestRoutingIntegrity:
         )
         with pytest.raises(RuntimeError, match="routing hash"):
             fresh.get(eid, APP)
+
+
+class TestConcurrencyAndRecovery:
+    """Regression tests for the round-3 advisor findings: lock ordering,
+    torn sidecars, and stale partition-count caches."""
+
+    def test_remove_concurrent_with_scan_ratings_no_deadlock(self, dao):
+        """remove() must not hold the client lock while acquiring
+        partition locks: scan_ratings orders partition-lock ->
+        client-lock, and the inverted order deadlocked."""
+        import threading
+
+        for i in range(20):
+            dao.insert(
+                _event(i, entity=f"u{i % 5}", target=f"it{i % 7}",
+                       rating=1.0),
+                APP,
+            )
+        stop = threading.Event()
+        errors: list[Exception] = []
+
+        def scanner():
+            while not stop.is_set():
+                try:
+                    dao.scan_ratings(APP, event_names=["rate"])
+                    dao.find(APP, limit=5)
+                except Exception as e:  # pragma: no cover - fail the test
+                    errors.append(e)
+                    return
+
+        def remover():
+            while not stop.is_set():
+                try:
+                    dao.remove(APP)
+                    dao.insert(_event(1, entity="u1", target="it1"), APP)
+                except Exception as e:  # pragma: no cover - fail the test
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=scanner) for _ in range(2)] + [
+            threading.Thread(target=remover)
+        ]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        alive = [t for t in threads if t.is_alive()]
+        assert not alive, "deadlock: scan/remove threads never finished"
+        assert not errors
+
+    def test_torn_sidecar_folds_segment_instead_of_crashing(self, dao):
+        """A torn (unparsable) segment sidecar must degrade to folding
+        the segment — correct results, no pruning — not raise on every
+        windowed find."""
+        for i in range(30):
+            dao.insert(_event(i), APP)
+        ns = dao._ns_dir(APP, None)
+        sidecars = sorted(ns.glob("p*/seg_*.meta.json"))
+        assert sidecars, "expected sealed segments at 600-byte rotation"
+        sidecars[0].write_text('{"min_ts": 123, "max')  # torn mid-write
+        got = dao.find(
+            APP,
+            start_time=T0,
+            until_time=T0 + timedelta(minutes=30),
+        )
+        assert len(got) == 30
+
+    def test_cross_client_recreate_with_new_count_is_detected(self, tmp_path):
+        """A client that cached the partition count must notice a
+        remove()+recreate by another client (new meta inode) and route
+        by the NEW count instead of the stale one."""
+        path = str(tmp_path / "parts")
+        a = PartitionedEvents(
+            PartitionedStorageClient({"path": path, "partitions": 8})
+        )
+        b = PartitionedEvents(
+            PartitionedStorageClient({"path": path, "partitions": 2})
+        )
+        a.insert(_event(1), APP)  # a caches count=8; b would adopt 8 too
+        assert b.get("zz", APP) is None  # b caches the persisted 8
+        assert b.remove(APP)
+        # b recreates with ITS configured count (2)
+        eid = b.insert(_event(2, entity="u2"), APP)
+        # a must route point ops by the new count, not the cached 8
+        got = a.get(eid, APP)
+        assert got is not None and got.entity_id == "u2"
+        assert a._n_partitions(a._ns_dir(APP, None)) == 2
