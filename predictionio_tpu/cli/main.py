@@ -322,6 +322,8 @@ def cmd_deploy(args) -> int:
         ),
         access_key=args.accesskey,
         server_config=_load_server_config(args),
+        log_url=args.log_url,
+        log_prefix=args.log_prefix,
     )
     # foreground, like the reference: backgrounding is the caller's job
     # (shell &, supervisor); a daemon thread would die with this process
@@ -629,6 +631,13 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--event-server-port", type=int, default=7070)
     d.add_argument("--accesskey")
     d.add_argument("--server-config", help="server.conf path (key auth / SSL)")
+    d.add_argument(
+        "--log-url",
+        help="POST serving errors to this URL (reference --log-url)",
+    )
+    d.add_argument(
+        "--log-prefix", help="prefix prepended to remote log payloads"
+    )
     d.set_defaults(fn=cmd_deploy)
 
     u = sub.add_parser("undeploy")

@@ -47,7 +47,8 @@ class Dashboard:
         return KeyAuthentication(self.server_config).authorized(request.query)
 
     def _router(self) -> Router:
-        router = Router()
+        # CORS on all dashboard routes (reference dashboard CORSSupport)
+        router = Router(cors=True)
         server = self
 
         @router.route("GET", "/")

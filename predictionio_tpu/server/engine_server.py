@@ -7,7 +7,10 @@ Capability parity with the reference CreateServer
   class, ``serving.supplement``, score every algorithm, ``serving.serve``,
   JSON response (:470-500). Per-request bookkeeping: requestCount,
   avgServingSec, lastServingSec (:399-403).
-- ``GET /`` — status page with engine info and serving stats.
+- ``GET /`` — status page with engine info and serving stats; browsers
+  (Accept: text/html) get the HTML render (:443-467), API clients JSON.
+- Serving errors POST ``logPrefix + {engineInstance, message}`` to
+  ``--log-url`` when configured (:422-433, :596-618).
 - ``POST /reload`` — hot-swap to the newest COMPLETED engine instance
   (:316-342); key-authenticated.
 - ``POST /stop`` — key-authenticated shutdown (:260-285).
@@ -71,6 +74,8 @@ class EngineServer:
         event_server_url: str | None = None,
         access_key: str | None = None,
         server_config=None,
+        log_url: str | None = None,
+        log_prefix: str | None = None,
     ):
         self.engine = engine
         self.storage = storage or get_storage()
@@ -82,6 +87,10 @@ class EngineServer:
         self.feedback = feedback
         self.event_server_url = event_server_url
         self.access_key = access_key
+        # serving errors POST to this URL when set (reference
+        # CreateServer.scala remoteLog, :422-433 + :596-618)
+        self.log_url = log_url
+        self.log_prefix = log_prefix or ""
         self._lock = threading.RLock()
         self._load(instance)
 
@@ -154,36 +163,72 @@ class EngineServer:
             self.last_serving_sec = dt
         return response
 
+    @staticmethod
+    def _post_async(
+        url: str,
+        payload: bytes,
+        what: str,
+        headers: dict[str, str] | None = None,
+    ) -> None:
+        """Fire-and-forget POST on a daemon thread — failures are logged,
+        never raised (feedback + remote-log transport)."""
+
+        def post():
+            try:
+                req = urllib.request.Request(
+                    url, data=payload, headers=headers or {}
+                )
+                urllib.request.urlopen(req, timeout=10).read()
+            except Exception:
+                logger.exception("%s POST failed", what)
+
+        threading.Thread(target=post, daemon=True).start()
+
     def _send_feedback(self, query: dict, prediction: Any, pr_id: str) -> None:
         """Async predict-event POST back to the event server
         (CreateServer.scala:514-577)."""
         if not (self.event_server_url and self.access_key):
             logger.warning("feedback enabled but event server/access key missing")
             return
+        payload = json.dumps(
+            {
+                "event": "predict",
+                "entityType": "pio_pr",
+                "entityId": pr_id,
+                "properties": {"query": query, "prediction": prediction},
+                "prId": pr_id,
+            }
+        ).encode()
+        url = (
+            f"{self.event_server_url.rstrip('/')}/events.json"
+            f"?accessKey={self.access_key}"
+        )
+        self._post_async(
+            url, payload, "feedback event",
+            headers={"Content-Type": "application/json"},
+        )
 
-        def post():
-            payload = json.dumps(
+    def _remote_log(self, message: str) -> None:
+        """Best-effort POST of a serving error to ``log_url`` (reference
+        CreateServer.scala:422-433 remoteLog; fired on query failures at
+        :596-618). Body is ``log_prefix`` + JSON {engineInstance,
+        message}, like the reference's logPrefix + write(...)."""
+        if not self.log_url:
+            return
+        payload = (
+            self.log_prefix
+            + json.dumps(
                 {
-                    "event": "predict",
-                    "entityType": "pio_pr",
-                    "entityId": pr_id,
-                    "properties": {"query": query, "prediction": prediction},
-                    "prId": pr_id,
+                    "engineInstance": {
+                        "id": self.instance.id,
+                        "engineFactory": self.instance.engine_factory,
+                        "engineVariant": self.instance.engine_variant,
+                    },
+                    "message": message,
                 }
-            ).encode()
-            url = (
-                f"{self.event_server_url.rstrip('/')}/events.json"
-                f"?accessKey={self.access_key}"
             )
-            try:
-                req = urllib.request.Request(
-                    url, data=payload, headers={"Content-Type": "application/json"}
-                )
-                urllib.request.urlopen(req, timeout=10).read()
-            except Exception:
-                logger.exception("feedback event POST failed")
-
-        threading.Thread(target=post, daemon=True).start()
+        ).encode()
+        self._post_async(self.log_url, payload, "remote log")
 
     # -- control -----------------------------------------------------------
     def reload(self) -> bool:
@@ -217,6 +262,39 @@ class EngineServer:
                 "plugins": [p.plugin_name for p in self.plugins],
             }
 
+    def _status_html(self) -> str:
+        """Minimal render of the reference's HTML status page
+        (CreateServer.scala:443-467, templates html.index): engine info,
+        component params, serving stats."""
+        import html as html_mod
+
+        s = self.status()
+        with self._lock:
+            algo_rows = "".join(
+                f"<tr><td>{html_mod.escape(type(a).__name__)}</td>"
+                f"<td><pre>{html_mod.escape(str(p))}</pre></td></tr>"
+                for a, (_, p) in zip(
+                    self.algorithms, self.engine_params.algorithms
+                )
+            )
+            serving_name = type(self.serving).__name__
+        rows = "".join(
+            f"<tr><th>{html_mod.escape(str(k))}</th>"
+            f"<td>{html_mod.escape(str(v))}</td></tr>"
+            for k, v in s.items()
+        )
+        return (
+            "<!DOCTYPE html><html><head>"
+            "<title>Engine Server at "
+            f"{html_mod.escape(self.host)}</title></head><body>"
+            f"<h1>Engine: {html_mod.escape(s['engineFactory'])}</h1>"
+            f"<table border='1'>{rows}</table>"
+            f"<h2>Algorithms</h2><table border='1'>"
+            f"<tr><th>Class</th><th>Params</th></tr>{algo_rows}</table>"
+            f"<h2>Serving</h2><p>{html_mod.escape(serving_name)}</p>"
+            "</body></html>"
+        )
+
     # -- routes ------------------------------------------------------------
     def _router(self) -> Router:
         router = Router()
@@ -224,6 +302,11 @@ class EngineServer:
 
         @router.route("GET", "/")
         def status(request: Request) -> Response:
+            # browsers get the reference's HTML status page
+            # (CreateServer.scala:443-467 renders html.index); API
+            # clients keep the JSON body
+            if "text/html" in request.headers.get("accept", ""):
+                return Response.html(server._status_html())
             return Response.json(server.status())
 
         @router.route("POST", "/queries.json")
@@ -234,7 +317,21 @@ class EngineServer:
             try:
                 return Response.json(server.handle_query(body))
             except (TypeError, KeyError, ValueError) as e:
+                # reference: MappingException -> 400 + remote log
+                # (CreateServer.scala:596-604)
+                server._remote_log(
+                    f"Query:\n{request.body.decode(errors='replace')}\n\n"
+                    f"Error:\n{e}\n\n"
+                )
                 return Response.error(f"Your query is not valid. {e}", 400)
+            except Exception as e:
+                # reference: Throwable -> 500 + remote log (:605-618)
+                logger.exception("serving failed")
+                server._remote_log(
+                    f"Query:\n{request.body.decode(errors='replace')}\n\n"
+                    f"Error:\n{e}\n\n"
+                )
+                return Response.error(f"serving failed: {e}", 500)
 
         @router.route("POST", "/reload")
         def reload(request: Request) -> Response:

@@ -12,6 +12,9 @@ Capability parity with the reference Event Server
 - ``POST /batch/events.json``     at most **50** events per request
                                   (:376-390), per-event status list
 - ``GET /stats.json``             ingestion stats (when enabled)
+- ``GET /plugins.json``           loaded plugin inventory (:156-177)
+- ``GET|POST /plugins/<type>/<name>/<args...>`` plugin REST dispatch
+                                  (:178-196, PluginsActor.scala)
 - ``POST /webhooks/<name>.json``  JSON webhooks; ``.form`` form flavor
 - ``GET /webhooks/<name>.json``   connector presence check
 
@@ -230,6 +233,40 @@ class EventServer:
                 )
             return Response.json(server.stats.get(auth.app_id))
 
+        @router.route("GET", "/plugins.json")
+        def plugins_json(request: Request) -> Response:
+            """Loaded plugin inventory grouped by interception type
+            (reference EventServer.scala:156-177)."""
+            def group(ptype: str) -> dict:
+                return {
+                    p.plugin_name: {
+                        "name": p.plugin_name,
+                        "description": p.plugin_description,
+                        "class": type(p).__module__ + "." + type(p).__qualname__,
+                    }
+                    for p in server.plugins
+                    if p.plugin_type == ptype
+                }
+
+            return Response.json(
+                {
+                    "plugins": {
+                        "inputblockers": group(plugin_mod.INPUT_BLOCKER),
+                        "inputsniffers": group(plugin_mod.INPUT_SNIFFER),
+                    }
+                }
+            )
+
+        @router.route("GET", "/plugins/<ptype>/<name>")
+        @router.route("POST", "/plugins/<ptype>/<name>")
+        @router.route("GET", "/plugins/<ptype>/<name>/<rest:path>")
+        @router.route("POST", "/plugins/<ptype>/<name>/<rest:path>")
+        def plugin_rest(request: Request) -> Response:
+            """Dispatch ``/plugins/<type>/<name>/<args...>`` to the named
+            plugin's ``handle_rest`` behind access-key auth (reference
+            EventServer.scala:178-196 + PluginsActor.scala)."""
+            return server._plugin_rest(request)
+
         @router.route("POST", "/webhooks/<name>.json")
         def webhook_json(request: Request) -> Response:
             return server._webhook(request, form=False)
@@ -266,6 +303,35 @@ class EventServer:
         except ConnectorError as e:
             return Response.error(str(e), 400)
         return Response.json(payload, status=status)
+
+    def _plugin_rest(self, request: Request) -> Response:
+        auth = self._auth(request)
+        if isinstance(auth, Response):
+            return auth
+        ptype = request.path_params["ptype"]
+        name = request.path_params["name"]
+        if ptype not in (plugin_mod.INPUT_BLOCKER, plugin_mod.INPUT_SNIFFER):
+            return Response.error(f"invalid plugin type {ptype}", 404)
+        for p in self.plugins:
+            if p.plugin_name == name and p.plugin_type == ptype:
+                # the reference hands handleREST the authenticated app +
+                # channel along with the path args; params carries them —
+                # ALWAYS overwritten from auth so a client can't spoof
+                # the authenticated context via query params
+                params = dict(request.query)
+                params["appId"] = str(auth.app_id)
+                params.pop("channelId", None)
+                if auth.channel_id is not None:
+                    params["channelId"] = str(auth.channel_id)
+                try:
+                    result = p.handle_rest(
+                        request.path_params.get("rest", ""), params
+                    )
+                except Exception as e:  # plugin bug must not kill the server
+                    logger.exception("plugin %s handle_rest failed", name)
+                    return Response.error(str(e), 500)
+                return Response.json(result)
+        return Response.error(f"plugin {name} not found", 404)
 
     def _webhook_check(self, request: Request, want: type) -> Response:
         auth = self._auth(request)

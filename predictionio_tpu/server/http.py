@@ -79,14 +79,30 @@ class Response:
 Handler = Callable[[Request], Response]
 
 
-class Router:
-    """Method+path-pattern routing. Patterns use ``<name>`` segments."""
+_CORS_ALLOW_HEADERS = (
+    "Origin, X-Requested-With, Content-Type, Accept, Accept-Encoding, "
+    "Accept-Language, Host, Referer, User-Agent"
+)
 
-    def __init__(self) -> None:
+
+class Router:
+    """Method+path-pattern routing. Patterns use ``<name>`` segments.
+
+    ``cors=True`` answers OPTIONS preflights and stamps
+    ``Access-Control-Allow-Origin: *`` on every response (reference
+    tools/.../dashboard/CorsSupport.scala — AllOrigins)."""
+
+    def __init__(self, cors: bool = False) -> None:
         self._routes: list[tuple[str, re.Pattern, Handler]] = []
+        self.cors = cors
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
-        regex = re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern)
+        # <name> matches one segment; <name:path> greedily matches the
+        # rest of the path (plugin REST dispatch forwards sub-paths)
+        regex = re.sub(r"<([a-zA-Z_]+):path>", r"(?P<\1>.+)", pattern)
+        # the lookbehind keeps this from rewriting the <name> inside the
+        # (?P<name>...) groups the first pass just emitted
+        regex = re.sub(r"(?<!\(\?P)<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", regex)
         self._routes.append((method.upper(), re.compile(f"^{regex}$"), handler))
 
     def route(self, method: str, pattern: str):
@@ -97,17 +113,38 @@ class Router:
         return deco
 
     def dispatch(self, request: Request) -> Response:
+        response = self._dispatch(request)
+        if self.cors:
+            response.headers.setdefault("Access-Control-Allow-Origin", "*")
+        return response
+
+    def _dispatch(self, request: Request) -> Response:
         path_matched = False
+        allowed: list[str] = []
         for method, regex, handler in self._routes:
             m = regex.match(request.path)
             if not m:
                 continue
             path_matched = True
+            allowed.append(method)
             if method != request.method:
                 continue
             request.path_params = m.groupdict()
             return handler(request)
         if path_matched:
+            if self.cors and request.method == "OPTIONS":
+                # preflight for a resource that responds to other methods
+                return Response(
+                    200,
+                    body=("text/plain", b""),
+                    headers={
+                        "Access-Control-Allow-Methods": ", ".join(
+                            ["OPTIONS", *dict.fromkeys(allowed)]
+                        ),
+                        "Access-Control-Allow-Headers": _CORS_ALLOW_HEADERS,
+                        "Access-Control-Max-Age": "1728000",
+                    },
+                )
             return Response.error("method not allowed", 405)
         return Response.error("not found", 404)
 
@@ -186,7 +223,7 @@ class HTTPApp:
                         target=response.after_send, daemon=True
                     ).start()
 
-            do_GET = do_POST = do_DELETE = do_PUT = _handle
+            do_GET = do_POST = do_DELETE = do_PUT = do_OPTIONS = _handle
 
         if self.ssl_context is not None:
             ssl_context = self.ssl_context

@@ -204,6 +204,91 @@ class TestEventServer:
         status, _ = http("POST", f"{base}/webhooks/unknown.json?accessKey={key}", {})
         assert status == 404
 
+    def test_plugins_json_inventory(self, event_server):
+        """GET /plugins.json groups loaded plugins by interception type
+        (reference EventServer.scala:156-177)."""
+        base = event_server["base"]
+        server = event_server["server"]
+        from predictionio_tpu.server import plugins as plugin_mod
+
+        class Sniffy(plugin_mod.EventServerPlugin):
+            plugin_name = "sniffy"
+            plugin_description = "records things"
+            plugin_type = plugin_mod.INPUT_SNIFFER
+
+        server.plugins.append(Sniffy())
+        status, body = http("GET", f"{base}/plugins.json")
+        assert status == 200
+        entry = body["plugins"]["inputsniffers"]["sniffy"]
+        assert entry["description"] == "records things"
+        assert entry["class"].endswith("Sniffy")
+        assert body["plugins"]["inputblockers"] == {}
+
+    def test_plugin_rest_dispatch(self, event_server):
+        """/plugins/<type>/<name>/<args...> authenticates, then hands the
+        sub-path + app context to the plugin's handle_rest (reference
+        EventServer.scala:178-196)."""
+        base, key = event_server["base"], event_server["key"]
+        server = event_server["server"]
+        from predictionio_tpu.server import plugins as plugin_mod
+
+        class Echo(plugin_mod.EventServerPlugin):
+            plugin_name = "echo"
+            plugin_type = plugin_mod.INPUT_SNIFFER
+
+            def handle_rest(self, path, params):
+                return {"path": path, "appId": params.get("appId"),
+                        "q": params.get("q")}
+
+        server.plugins.append(Echo())
+        # auth required
+        status, _ = http("GET", f"{base}/plugins/inputsniffer/echo/a/b")
+        assert status == 401
+        status, body = http(
+            "GET",
+            f"{base}/plugins/inputsniffer/echo/a/b?accessKey={key}&q=7",
+        )
+        assert status == 200
+        assert body == {
+            "path": "a/b",
+            "appId": str(event_server["app_id"]),
+            "q": "7",
+        }
+        # POST dispatches too, with or without trailing args
+        status, body = http(
+            "POST", f"{base}/plugins/inputsniffer/echo?accessKey={key}", {}
+        )
+        assert status == 200 and body["path"] == ""
+        # wrong type or unknown name -> 404
+        status, _ = http(
+            "GET", f"{base}/plugins/inputblocker/echo?accessKey={key}"
+        )
+        assert status == 404
+        status, _ = http(
+            "GET", f"{base}/plugins/bogus/echo?accessKey={key}"
+        )
+        assert status == 404
+
+    def test_plugin_rest_error_does_not_kill_server(self, event_server):
+        base, key = event_server["base"], event_server["key"]
+        server = event_server["server"]
+        from predictionio_tpu.server import plugins as plugin_mod
+
+        class Boom(plugin_mod.EventServerPlugin):
+            plugin_name = "boom"
+            plugin_type = plugin_mod.INPUT_BLOCKER
+
+            def handle_rest(self, path, params):
+                raise RuntimeError("kapow")
+
+        server.plugins.append(Boom())
+        status, body = http(
+            "GET", f"{base}/plugins/inputblocker/boom?accessKey={key}"
+        )
+        assert status == 500 and "kapow" in body["message"]
+        status, _ = http("GET", f"{base}/")
+        assert status == 200
+
 
 @pytest.fixture()
 def deployed_engine(storage):
@@ -302,6 +387,84 @@ class TestEngineServer:
     def test_plugins_endpoint(self, deployed_engine):
         status, body = http("GET", deployed_engine["base"] + "/plugins.json")
         assert status == 200 and "plugins" in body
+
+    def test_status_page_html_for_browsers(self, deployed_engine):
+        """Accept: text/html gets the reference's HTML status render
+        (CreateServer.scala:443-467); API clients keep JSON."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            deployed_engine["base"] + "/",
+            headers={"Accept": "text/html,application/xhtml+xml"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/html")
+            page = resp.read().decode()
+        assert "Engine:" in page and "Algorithms" in page
+        assert "ALSAlgorithm" in page or "als" in page
+
+    def test_serving_error_posts_remote_log(self, storage, deployed_engine):
+        """A failing query POSTs logPrefix + {engineInstance, message} to
+        log_url (CreateServer.scala:422-433, :596-618)."""
+        import threading
+
+        from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+        received: list[bytes] = []
+        got_one = threading.Event()
+        catcher_router = Router()
+
+        @catcher_router.route("POST", "/log")
+        def catch(request):
+            received.append(request.body)
+            got_one.set()
+            return Response.json({})
+
+        catcher = HTTPApp(catcher_router, host="127.0.0.1", port=0)
+        log_port = catcher.start()
+        server = deployed_engine["server"]
+        server.log_url = f"http://127.0.0.1:{log_port}/log"
+        server.log_prefix = "PIO: "
+        try:
+            status, _ = http(
+                "POST",
+                deployed_engine["base"] + "/queries.json",
+                {"user": "u1", "num": "not-a-number"},
+            )
+            assert status in (400, 500)
+            assert got_one.wait(timeout=10), "remote log never arrived"
+            body = received[0].decode()
+            assert body.startswith("PIO: ")
+            payload = json.loads(body[len("PIO: "):])
+            assert payload["engineInstance"]["id"] == server.instance.id
+            assert "Query" in payload["message"]
+        finally:
+            server.log_url = None
+            catcher.stop()
+
+
+class TestDashboardCors:
+    def test_allow_origin_and_preflight(self, storage):
+        """Dashboard responses carry Access-Control-Allow-Origin: * and
+        OPTIONS preflights are answered (reference CorsSupport.scala)."""
+        import urllib.request
+
+        from predictionio_tpu.server.dashboard import Dashboard
+
+        dash = Dashboard(storage=storage, host="127.0.0.1", port=0)
+        port = dash.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            with urllib.request.urlopen(base + "/", timeout=10) as resp:
+                assert resp.headers["Access-Control-Allow-Origin"] == "*"
+            req = urllib.request.Request(base + "/", method="OPTIONS")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert "GET" in resp.headers["Access-Control-Allow-Methods"]
+                assert resp.headers["Access-Control-Allow-Origin"] == "*"
+        finally:
+            dash.stop()
 
 
 class TestAdminServer:
