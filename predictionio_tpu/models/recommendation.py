@@ -173,6 +173,10 @@ class ALSAlgorithmParams(Params):
     lambda_: float = 0.01
     seed: int = 3
     compute_dtype: str = "float32"
+    # dtype the factors are stored in between solves — "bfloat16" halves
+    # the HBM gather / ICI all_gather traffic of this HBM-bound op at
+    # parity RMSE (solves still accumulate float32; ops/als.py)
+    storage_dtype: str = "float32"
     # serve with item factors sharded over the device mesh (ring top-k) —
     # the TPU answer to the reference's PAlgorithm "model bigger than one
     # host" case, which issues a Spark job per query instead
@@ -255,6 +259,7 @@ class ALSAlgorithm(Algorithm):
             reg=self.params.lambda_,
             seed=self.params.seed,
             compute_dtype=self.params.compute_dtype,
+            storage_dtype=self.params.storage_dtype,
         )
         from predictionio_tpu.parallel.als_sharded import train_for_context
 
@@ -291,6 +296,7 @@ class ALSAlgorithm(Algorithm):
                 p.rank != base.rank
                 or p.num_iterations != base.num_iterations
                 or p.compute_dtype != base.compute_dtype
+                or p.storage_dtype != base.storage_dtype
                 or tuple(p.bucket_widths) != tuple(base.bucket_widths)
                 or p.sharded_train
             ):
@@ -312,6 +318,7 @@ class ALSAlgorithm(Algorithm):
                 reg=p.lambda_,
                 seed=p.seed,
                 compute_dtype=p.compute_dtype,
+                storage_dtype=p.storage_dtype,
             )
             for p in params_list
         ]

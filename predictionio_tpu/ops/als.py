@@ -394,6 +394,15 @@ class ALSParams:
     implicit_weighted_reg: bool = False  # implicit path default: plain reg*I
     seed: int = 7
     compute_dtype: str = "float32"
+    # dtype the factor matrices are STORED in between solves. The
+    # rank-20 north star is HBM-bound (the per-bucket factor gathers and
+    # the sharded trainer's all_gathers dominate, not the MXU), so
+    # bfloat16 storage halves the dominant traffic; every solve still
+    # accumulates its normal equations in float32
+    # (preferred_element_type) and the Cholesky solves run in float32,
+    # so the quantization acts as per-iteration noise on the factors —
+    # the ALX trade (PAPERS.md), measured at parity RMSE.
+    storage_dtype: str = "float32"
     bucket_widths: tuple[int, ...] = DEFAULT_BUCKETS
     # HBM budget for one bucket's [B, K, D] factor-gather temp: buckets
     # whose gather would exceed it are solved in lax.map chunks over the
@@ -438,7 +447,9 @@ def _half_step(factors_self, factors_other, buckets, params: ALSParams, gram):
             params,
             len(bucket.row_ids),
         )
-        factors_self = factors_self.at[bucket.row_ids].set(x)
+        factors_self = factors_self.at[bucket.row_ids].set(
+            x.astype(factors_self.dtype)
+        )
     return factors_self
 
 
@@ -519,7 +530,8 @@ def _train_fused(U, V, row_arrays, col_arrays, params: ALSParams, iterations):
                 seg_row=seg_row,
                 num_solved_rows=row_ids.shape[0],
             )
-            target = target.at[row_ids].set(x)
+            # solves come back float32; factors persist in storage_dtype
+            target = target.at[row_ids].set(x.astype(target.dtype))
         return target
 
     def step(_, carry):
@@ -552,8 +564,9 @@ def als_train(data: RatingsData, params: ALSParams):
     compile per unique set of bucket shapes; see _train_fused).
     """
     key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
-    U = init_factors(data.num_rows, params.rank, key_u)
-    V = init_factors(data.num_cols, params.rank, key_v)
+    sd = jnp.dtype(params.storage_dtype)
+    U = init_factors(data.num_rows, params.rank, key_u).astype(sd)
+    V = init_factors(data.num_cols, params.rank, key_v).astype(sd)
     # iterations rides as a dynamic loop bound; normalize it out of the
     # static params key so runs differing only in iteration count share
     # one compiled program
@@ -599,7 +612,7 @@ def _train_fused_sweep(
                     reg=reg,
                     alpha=alpha,
                 )
-                target = target.at[row_ids].set(x)
+                target = target.at[row_ids].set(x.astype(target.dtype))
             return target
 
         def step(_, carry):
@@ -637,8 +650,8 @@ def als_train_sweep(
     base = params_list[0]
     static_fields = (
         "rank", "iterations", "implicit", "weighted_reg",
-        "implicit_weighted_reg", "compute_dtype", "bucket_widths",
-        "gather_chunk_bytes",
+        "implicit_weighted_reg", "compute_dtype", "storage_dtype",
+        "bucket_widths", "gather_chunk_bytes",
     )
     for p in params_list[1:]:
         diffs = [f for f in static_fields if getattr(p, f) != getattr(base, f)]
@@ -650,10 +663,11 @@ def als_train_sweep(
             )
     U0 = []
     V0 = []
+    sd = jnp.dtype(base.storage_dtype)
     for p in params_list:
         key_u, key_v = jax.random.split(jax.random.PRNGKey(p.seed))
-        U0.append(init_factors(data.num_rows, p.rank, key_u))
-        V0.append(init_factors(data.num_cols, p.rank, key_v))
+        U0.append(init_factors(data.num_rows, p.rank, key_u).astype(sd))
+        V0.append(init_factors(data.num_cols, p.rank, key_v).astype(sd))
     regs = jnp.asarray([p.reg for p in params_list], jnp.float32)
     alphas = jnp.asarray([p.alpha for p in params_list], jnp.float32)
     static_params = dataclasses.replace(base, iterations=0, reg=0.0, alpha=0.0)
@@ -674,8 +688,9 @@ def als_train_stepwise(data: RatingsData, params: ALSParams):
     """Step-by-step variant (one jitted call per bucket solve): same math
     as als_train, useful for debugging / profiling individual solves."""
     key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
-    U = init_factors(data.num_rows, params.rank, key_u)
-    V = init_factors(data.num_cols, params.rank, key_v)
+    sd = jnp.dtype(params.storage_dtype)
+    U = init_factors(data.num_rows, params.rank, key_u).astype(sd)
+    V = init_factors(data.num_cols, params.rank, key_v).astype(sd)
 
     for it in range(params.iterations):
         gram_v = compute_gram(V, params.compute_dtype) if params.implicit else None
@@ -687,8 +702,12 @@ def als_train_stepwise(data: RatingsData, params: ALSParams):
 
 
 def predict_pairs(U, V, rows: np.ndarray, cols: np.ndarray):
-    """Scores for explicit (row, col) pairs: sum(U[r] * V[c], -1)."""
-    return jnp.sum(U[jnp.asarray(rows)] * V[jnp.asarray(cols)], axis=-1)
+    """Scores for explicit (row, col) pairs: sum(U[r] * V[c], -1).
+    Gathers cast to float32 so bf16-stored factors score/evaluate at
+    full accumulation precision."""
+    u = U[jnp.asarray(rows)].astype(jnp.float32)
+    v = V[jnp.asarray(cols)].astype(jnp.float32)
+    return jnp.sum(u * v, axis=-1)
 
 
 def rmse(U, V, rows, cols, vals, chunk: int = 4_000_000) -> float:

@@ -26,7 +26,11 @@ def top_k_items(user_vector, item_factors, k: int, exclude_mask=None):
     ``exclude_mask``: optional [num_items] bool/0-1 array; masked items
     can never appear in the result.
     """
-    scores = item_factors @ user_vector  # [I]
+    # f32 scores regardless of factor storage dtype (bf16-stored factors
+    # still rank and report at full accumulation precision)
+    scores = jnp.matmul(
+        item_factors, user_vector, preferred_element_type=jnp.float32
+    )  # [I]
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask.astype(bool), NEG_INF, scores)
     k = min(k, item_factors.shape[0])
@@ -36,7 +40,9 @@ def top_k_items(user_vector, item_factors, k: int, exclude_mask=None):
 @functools.partial(jax.jit, static_argnames=("k",))
 def top_k_items_batch(user_vectors, item_factors, k: int, exclude_mask=None):
     """Batched variant: [B, D] user vectors -> ([B, k] scores, [B, k] ids)."""
-    scores = user_vectors @ item_factors.T  # [B, I]
+    scores = jnp.matmul(
+        user_vectors, item_factors.T, preferred_element_type=jnp.float32
+    )  # [B, I]
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask.astype(bool)[None, :], NEG_INF, scores)
     k = min(k, item_factors.shape[0])
@@ -48,8 +54,10 @@ def top_k_similar(item_vector, item_factors, k: int, exclude_mask=None):
     """Cosine item-item similarity top-k (similarproduct template's scoring,
     examples/scala-parallel-similarproduct/multi/src/main/scala/
     ALSAlgorithm.scala:147,193,244)."""
-    norms = jnp.linalg.norm(item_factors, axis=1) * jnp.linalg.norm(item_vector)
-    scores = (item_factors @ item_vector) / jnp.maximum(norms, 1e-12)
+    f32 = item_factors.astype(jnp.float32)
+    v32 = item_vector.astype(jnp.float32)
+    norms = jnp.linalg.norm(f32, axis=1) * jnp.linalg.norm(v32)
+    scores = (f32 @ v32) / jnp.maximum(norms, 1e-12)
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask.astype(bool), NEG_INF, scores)
     k = min(k, item_factors.shape[0])

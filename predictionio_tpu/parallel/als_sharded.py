@@ -223,11 +223,23 @@ def init_sharded_factors(
         als_ops.init_factors(data.num_cols, params.rank, key_v)
     )
     sharding = NamedSharding(mesh, P(axis))
+    # factors persist (and all_gather) in storage_dtype: bf16 halves the
+    # per-half-iteration ICI traffic and the gathered working set — the
+    # (c) term of the memory model above — while solves still accumulate
+    # float32 (ops/als.py ALSParams.storage_dtype)
+    U_dev = jax.device_put(U, sharding)
+    V_dev = jax.device_put(V, sharding)
+    if params.storage_dtype != "float32":
+        import jax.numpy as jnp
+
+        sd = jnp.dtype(params.storage_dtype)
+        U_dev = U_dev.astype(sd)  # elementwise: sharding preserved
+        V_dev = V_dev.astype(sd)
     return ShardedALSState(
         mesh=mesh,
         axis=axis,
-        U=jax.device_put(U, sharding),
-        V=jax.device_put(V, sharding),
+        U=U_dev,
+        V=V_dev,
         num_rows=data.num_rows,
         num_cols=data.num_cols,
     )
@@ -288,7 +300,7 @@ def _train_fused_sharded(
             out_specs=(P(axis),) * len(buckets),
         )(other, *flat)
         for x, (row_ids, *_rest) in zip(xs, buckets):
-            target = target.at[row_ids].set(x)
+            target = target.at[row_ids].set(x.astype(target.dtype))
         return jax.lax.with_sharding_constraint(target, factor_spec)
 
     def step(_, carry):

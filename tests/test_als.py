@@ -307,6 +307,46 @@ class TestTraining:
         e16 = als.rmse(*bf16, rows, cols, vals)
         assert e16 < max(2.5 * e32, 0.15)
 
+    def test_bf16_storage_close_to_f32(self):
+        """Factors STORED in bf16 (the HBM-traffic halving mode) converge
+        to near-f32 train RMSE: solves re-derive each factor from f32
+        normal equations, so per-iteration quantization doesn't
+        accumulate (ALX, PAPERS.md)."""
+        rows, cols, vals = synthetic_ratings(
+            num_u=60, num_i=40, rank=3, density=0.4, noise=0.05
+        )
+        data = als.build_ratings_data(rows, cols, vals, 60, 40, bucket_widths=(8, 32))
+        base = als.ALSParams(rank=6, iterations=10, reg=0.01)
+        f32 = als.als_train(data, base)
+        bf16 = als.als_train(
+            data,
+            als.ALSParams(
+                rank=6, iterations=10, reg=0.01,
+                compute_dtype="bfloat16", storage_dtype="bfloat16",
+            ),
+        )
+        assert bf16[0].dtype == jnp.bfloat16 and bf16[1].dtype == jnp.bfloat16
+        e32 = als.rmse(*f32, rows, cols, vals)
+        e16 = als.rmse(*bf16, rows, cols, vals)
+        # parity bar mirroring the north-star gate (RMSE within ~2%)
+        assert e16 < e32 * 1.05 + 0.01, (e32, e16)
+
+    def test_bf16_storage_sweep_matches_single_trainings(self):
+        rows, cols, vals = synthetic_ratings(num_u=30, num_i=20, rank=2, density=0.5)
+        data = als.build_ratings_data(rows, cols, vals, 30, 20, bucket_widths=(16,))
+        cands = [
+            als.ALSParams(rank=4, iterations=4, reg=r,
+                          storage_dtype="bfloat16", compute_dtype="bfloat16")
+            for r in (0.01, 0.1)
+        ]
+        swept = als.als_train_sweep(data, cands)
+        for p, (U, V) in zip(cands, swept):
+            U1, V1 = als.als_train(data, p)
+            np.testing.assert_allclose(
+                np.asarray(U, np.float32), np.asarray(U1, np.float32),
+                rtol=0.05, atol=0.02,
+            )
+
 
 class TestTopK:
     def test_topk_correct(self):
@@ -396,6 +436,26 @@ class TestShardedALS:
         assert U.shape == (48, 6) and V.shape == (32, 6)
         err = als.rmse(U, V, rows, cols, vals)
         assert err < 0.08, f"sharded train RMSE {err}"
+
+    def test_sharded_bf16_storage_converges(self, mesh):
+        """bf16-stored factors shard and all_gather at half the ICI
+        bytes; convergence must stay near f32 (same bar as single-chip
+        bf16 storage)."""
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+
+        rows, cols, vals = synthetic_ratings(num_u=48, num_i=32, rank=3, density=0.5)
+        data = als.build_ratings_data(rows, cols, vals, 48, 32, bucket_widths=(8, 32))
+        f32 = als.ALSParams(rank=6, iterations=8, reg=0.005)
+        bf16 = als.ALSParams(
+            rank=6, iterations=8, reg=0.005,
+            compute_dtype="bfloat16", storage_dtype="bfloat16",
+        )
+        U32, V32 = sharded_als_train(data, f32, mesh)
+        U16, V16 = sharded_als_train(data, bf16, mesh)
+        assert U16.dtype == jnp.bfloat16 and V16.dtype == jnp.bfloat16
+        e32 = als.rmse(U32, V32, rows, cols, vals)
+        e16 = als.rmse(U16, V16, rows, cols, vals)
+        assert e16 < e32 * 1.05 + 0.01, (e32, e16)
 
     def test_sharded_implicit_matches_single_chip(self, mesh):
         from predictionio_tpu.parallel.als_sharded import sharded_als_train
