@@ -101,15 +101,28 @@ def _ring_topk_device(
     )(queries, item_factors, item_ids, keep_mask)
 
 
+@functools.partial(jax.jit, static_argnames=("sharding",))
+def _exclude_on_device(keep_all, exclude_ids, sharding):
+    """Scatter excluded ids into the resident keep vector ON DEVICE:
+    per-query exclusion without a full-catalog host->device copy.
+    Out-of-range padding ids are dropped by the scatter."""
+    keep = keep_all.at[exclude_ids].set(0.0, mode="drop")
+    return jax.lax.with_sharding_constraint(keep, sharding)
+
+
 class RingCatalog:
     """An item catalog staged sharded on the mesh, reusable across queries.
 
     The [I, D] factor matrix (the big, query-independent array) is padded,
     sharded, and transferred to the mesh ONCE at construction; per-query
-    work only ships the [B, D] query batch and an optional [I] exclusion
-    mask over PCIe. This is what "factors resident and sharded" means for
-    a deployed server — without it every request would re-stage the whole
-    catalog host-to-device.
+    work only ships the [B, D] query batch and (with ``exclude_ids``) a
+    small padded id list over PCIe — the exclusion mask is built ON
+    DEVICE by scattering those ids into the resident keep vector, so a
+    10^7-item catalog never moves a [I] vector per query. This is what
+    "factors resident and sharded" means for a deployed server — without
+    it every request would re-stage catalog-sized data host-to-device.
+    (``exclude_mask`` remains for callers that already hold a full mask;
+    it pays the full [I] transfer.)
     """
 
     def __init__(self, item_factors, mesh: Mesh, axis: str = "data"):
@@ -141,7 +154,14 @@ class RingCatalog:
         self._base_keep = base_keep
         self._keep_all = jax.device_put(base_keep, self._sharding)
 
-    def top_k(self, user_vectors, k: int, exclude_mask=None, normalize=False):
+    def top_k(
+        self,
+        user_vectors,
+        k: int,
+        exclude_mask=None,
+        exclude_ids=None,
+        normalize=False,
+    ):
         """Top-k over the staged catalog. See :func:`ring_top_k`.
 
         ``B`` and ``k`` are compile-time shapes in the device program, and
@@ -149,6 +169,12 @@ class RingCatalog:
         Both are padded up to power-of-two buckets so arbitrary traffic
         reuses a handful of compiled programs instead of accumulating one
         per distinct (B, k); results are sliced back before returning.
+
+        ``exclude_ids`` (preferred for serving): a SMALL int array of
+        item indices to exclude — scattered into the device-resident keep
+        vector inside the jitted program (padded to power-of-two length
+        for compile reuse), shipping O(len) bytes instead of the O(I)
+        full-mask copy ``exclude_mask`` costs.
         """
         user_vectors = np.asarray(user_vectors, dtype=np.float32)
         B = user_vectors.shape[0]
@@ -161,7 +187,22 @@ class RingCatalog:
         q = np.concatenate(
             [user_vectors, np.zeros((pad_b, self.dim), np.float32)]
         )
-        if exclude_mask is None:
+        if exclude_ids is not None:
+            if exclude_mask is not None:
+                raise ValueError(
+                    "pass exclude_ids or exclude_mask, not both"
+                )
+            eids = np.asarray(exclude_ids, dtype=np.int32).ravel()
+            total = self._keep_all.shape[0]
+            # power-of-two padding with an out-of-range index the
+            # scatter drops (mode="drop")
+            cap = 1 << max(0, len(eids) - 1).bit_length() if len(eids) else 1
+            padded = np.full(cap, total, np.int32)
+            padded[: len(eids)] = eids
+            keep = _exclude_on_device(
+                self._keep_all, jnp.asarray(padded), self._sharding
+            )
+        elif exclude_mask is None:
             keep = self._keep_all
         else:
             host_keep = self._base_keep.copy()
@@ -191,6 +232,7 @@ def ring_top_k(
     mesh: Mesh,
     axis: str = "data",
     exclude_mask=None,
+    exclude_ids=None,
     normalize: bool = False,
 ):
     """Top-k items for a query batch with mesh-sharded item factors.
@@ -206,6 +248,8 @@ def ring_top_k(
       mesh: the device mesh; ``axis`` names the ring dimension.
       exclude_mask: optional [I] bool/0-1 array; 1/True = never return
         this item (seen/unavailable filters of the e-commerce template).
+      exclude_ids: optional small int array of item indices to exclude —
+        the cheap path (on-device scatter; see RingCatalog.top_k).
       normalize: score by cosine similarity instead of dot product
         (similar-product template).
 
@@ -216,5 +260,9 @@ def ring_top_k(
     """
     catalog = RingCatalog(item_factors, mesh, axis)
     return catalog.top_k(
-        user_vectors, k, exclude_mask=exclude_mask, normalize=normalize
+        user_vectors,
+        k,
+        exclude_mask=exclude_mask,
+        exclude_ids=exclude_ids,
+        normalize=normalize,
     )

@@ -50,6 +50,48 @@ class TestRingTopK:
         ref_s, ref_i = top_k_items_batch(q, v, 5, exclude_mask=excl)
         np.testing.assert_array_equal(ids, np.asarray(ref_i))
 
+    def test_exclusion_ids_matches_mask_path(self, mesh):
+        """exclude_ids (on-device scatter, no full-mask transfer) must
+        equal the exclude_mask path for the same exclusion set."""
+        q, v = _rand(8, 64, 6, seed=4)
+        excl_ids = np.array([0, 3, 17, 40, 63], np.int32)
+        excl = np.zeros(64, bool)
+        excl[excl_ids] = True
+        s_ids, i_ids = ring_top_k(q, v, 5, mesh, exclude_ids=excl_ids)
+        s_msk, i_msk = ring_top_k(q, v, 5, mesh, exclude_mask=excl)
+        np.testing.assert_array_equal(i_ids, i_msk)
+        np.testing.assert_allclose(s_ids, s_msk, rtol=1e-6)
+        assert not np.isin(i_ids, excl_ids).any()
+
+    def test_exclusion_ids_empty_and_catalog_reuse(self, mesh):
+        from predictionio_tpu.parallel.ring_topk import RingCatalog
+
+        q, v = _rand(4, 40, 6, seed=5)
+        cat = RingCatalog(v, mesh)
+        s0, i0 = cat.top_k(q, 5, exclude_ids=np.empty(0, np.int32))
+        s1, i1 = cat.top_k(q, 5)
+        np.testing.assert_array_equal(i0, i1)
+        # resident keep vector is untouched by prior exclusions
+        s2, i2 = cat.top_k(q, 5, exclude_ids=np.array([int(i1[0, 0])]))
+        assert int(i1[0, 0]) not in i2[0]
+        s3, i3 = cat.top_k(q, 5)
+        np.testing.assert_array_equal(i3, i1)
+
+    def test_exclusion_ids_varied_counts_bucket_compiles(self, mesh):
+        """Distinct exclusion-list lengths bucket to powers of two so
+        serving traffic reuses a handful of compiled scatter programs."""
+        from predictionio_tpu.parallel.ring_topk import (
+            RingCatalog,
+            _exclude_on_device,
+        )
+
+        q, v = _rand(4, 48, 6, seed=6)
+        cat = RingCatalog(v, mesh)
+        before = _exclude_on_device._cache_size()
+        for n_excl in (3, 4, 5, 7, 8):  # lengths pad to 4, 4, 8, 8, 8
+            cat.top_k(q, 5, exclude_ids=np.arange(n_excl, dtype=np.int32))
+        assert _exclude_on_device._cache_size() <= before + 2
+
     def test_cosine_matches_similarproduct_scoring(self, mesh):
         q, v = _rand(4, 96, 10, seed=3)
         scores, ids = ring_top_k(q, v, 6, mesh, normalize=True)
