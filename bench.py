@@ -12,6 +12,7 @@ One `python bench.py` run emits ONE JSON line:
 with extras covering the whole story:
   - "20m":     MovieLens-20M-shaped core train (seconds, RMSE)
   - "bf16":    same workload at compute_dtype=bfloat16 vs float32
+  - "bf16_storage": bf16 factor STORAGE (halved HBM gather bytes)
   - "mfu":     achieved FLOP/s and model-FLOPs-utilization of the 20M run
   - "serving": POST /queries.json p50/p99 through a real EngineServer —
                dense top-k, RingCatalog (mesh-sharded), and the
@@ -185,9 +186,14 @@ def core_child(scale: str, dtype: str, rank: int = RANK) -> None:
 
     rows, cols, vals, num_u, num_i = make_ml_shaped(scale)
     data = als.build_ratings_data(rows, cols, vals, num_u, num_i)
+    # dtype tokens: float32 | bfloat16 (compute only) | bf16_store
+    # (bf16 compute AND bf16 factor storage — halves the HBM bytes of
+    # the dominant gathers; f32 normal-equation accumulation throughout)
+    compute = "bfloat16" if dtype in ("bfloat16", "bf16_store") else "float32"
+    storage = "bfloat16" if dtype == "bf16_store" else "float32"
     params = als.ALSParams(
         rank=rank, iterations=ITERATIONS, reg=REG, seed=SEED,
-        compute_dtype=dtype,
+        compute_dtype=compute, storage_dtype=storage,
     )
     repeats = 5 if scale == "100k" else 3
     tpu_s, U, V = time_train(als, data, params, repeats)
@@ -247,6 +253,18 @@ def bench_core(scale: str, extras: dict, result: dict) -> None:
         extras["bf16"] = {
             "train_s": bf["train_s"],
             "rmse": bf["rmse"],
+            "f32_train_s": tpu_s,
+            "f32_rmse": rmse,
+        }
+        # bf16 factor STORAGE: halves the gather-side HBM traffic the
+        # rank-20 north star is bound by (VERDICT r3 item 2)
+        bs = _run_core_child(scale, "bf16_store")
+        entry["bf16_storage_train_s"] = bs["train_s"]
+        entry["bf16_storage_rmse"] = bs["rmse"]
+        extras["bf16_storage"] = {
+            "train_s": bs["train_s"],
+            "rmse": bs["rmse"],
+            "speedup_vs_f32": round(tpu_s / bs["train_s"], 2),
             "f32_train_s": tpu_s,
             "f32_rmse": rmse,
         }
@@ -478,17 +496,68 @@ def bench_ingest(extras: dict) -> None:
             ))
         batch_s = time.perf_counter() - t0
 
+        # sequential singles: per-request latency (each request pays its
+        # own commit wait — the floor, no coalescing possible)
         n_single = 300
         singles = [batch_payload(10_000 + j)[0] for j in range(n_single)]
         t0 = time.perf_counter()
         for payload in singles:
             _post_json(f"{url}/events.json?accessKey={key}", payload)
         single_s = time.perf_counter() - t0
+
+        # concurrent singles: production shape — many independent client
+        # PROCESSES, one event per request; fsync group commit coalesces
+        # their commits. Client subprocesses keep the measurement off
+        # this process's GIL (in-process client threads serialize JSON
+        # work against the server and understate the server's capacity).
+        import subprocess
+        import sys as _sys
+
+        n_conc, conc_procs, per_proc = 600, 8, 75
+        client_src = (
+            "import json,sys,http.client\n"
+            "host,port,path,n,off=(sys.argv[1],int(sys.argv[2]),sys.argv[3],"
+            "int(sys.argv[4]),int(sys.argv[5]))\n"
+            "sys.stdin.readline()  # start gate: excludes interpreter spawn\n"
+            "c=http.client.HTTPConnection(host,port,timeout=30)\n"
+            "for j in range(n):\n"
+            "    p={'event':'rate','entityType':'user',\n"
+            "       'entityId':f'cu{off}_{j}','targetEntityType':'item',\n"
+            "       'targetEntityId':f'i{j%97}',\n"
+            "       'properties':{'rating':float(j%5+1)},\n"
+            "       'eventTime':'2020-01-01T00:00:00.000Z'}\n"
+            "    c.request('POST',path,body=json.dumps(p),\n"
+            "              headers={'Content-Type':'application/json'})\n"
+            "    r=c.getresponse(); r.read()\n"
+            "    assert r.status==201, r.status\n"
+        )
+        procs = [
+            subprocess.Popen(
+                # -S: stdlib-only client, skips site hooks (the ambient
+                # accelerator plugin boot would cost seconds per client);
+                # persistent connection per client — the SDK shape
+                [_sys.executable, "-S", "-c", client_src,
+                 "127.0.0.1", str(port),
+                 f"/events.json?accessKey={key}", str(per_proc), str(w)],
+                stdin=subprocess.PIPE,
+            )
+            for w in range(conc_procs)
+        ]
+        t0 = time.perf_counter()
+        for p in procs:
+            p.stdin.write(b"\n")
+            p.stdin.flush()
+        for p in procs:
+            if p.wait() != 0:
+                raise RuntimeError("ingest client subprocess failed")
+        conc_s = time.perf_counter() - t0
         extras["ingest"] = {
             "batch_events_per_s": round(n_batches * 50 / batch_s),
             "batch_workers": workers,
             "batch_size": 50,
             "single_events_per_s": round(n_single / single_s),
+            "single_concurrent_events_per_s": round(n_conc / conc_s),
+            "single_concurrent_clients": conc_procs,
             "event_backend": E2E_BACKEND,
         }
     finally:
@@ -625,8 +694,18 @@ def sharded_child() -> None:
     out["all_gather_working_set"] = {
         "ml20m_items_gather_mb": round(SCALES["20m"][1] * d * 4 / 2**20, 2),
         "ml20m_users_gather_mb": round(SCALES["20m"][0] * d * 4 / 2**20, 2),
+        "ml20m_items_gather_mb_bf16_storage": round(
+            SCALES["20m"][1] * d * 2 / 2**20, 2
+        ),
+        "ml20m_users_gather_mb_bf16_storage": round(
+            SCALES["20m"][0] * d * 2 / 2**20, 2
+        ),
         "ceiling_rows_at_rank20_half_hbm_v5e": int(8 * 2**30 / (20 * 4)),
+        "ceiling_rows_at_rank20_half_hbm_v5e_bf16_storage": int(
+            8 * 2**30 / (20 * 2)
+        ),
         "note": "gathered opposite factors do not shrink with mesh size; "
+        "bf16 storage_dtype halves both the gather and the ICI bytes — "
         "see parallel/als_sharded.py docstring",
     }
     print(json.dumps(out))

@@ -119,6 +119,9 @@ HTTPEvaluationInstances = _make_dao_class(
     "evaluation_instances", base.EvaluationInstances
 )
 HTTPEvents = _make_dao_class("events", base.Events)
+# filters evaluate server-side: a per-entity read transfers only that
+# entity's events, so serving caches should NOT bulk-scan through this
+HTTPEvents.entity_indexed = True
 HTTPModels = _make_dao_class("models", base.Models)
 
 _REPO_TO_CLASS = {

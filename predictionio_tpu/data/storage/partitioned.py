@@ -110,6 +110,10 @@ class PartitionedStorageClient:
         # per-partition-dir thread locks (cross-process safety comes from
         # the flock; a global lock here would serialize the parallel scans)
         self.path_locks: dict[str, threading.RLock] = {}
+        # per-active-log fsync group commit (see groupcommit.py)
+        from predictionio_tpu.data.storage.groupcommit import CoalescerMap
+
+        self.committers = CoalescerMap()
         # namespace dir -> (partition count, meta-file (inode, mtime_ns))
         # — the count is immutable for one life of the namespace; the
         # identity pair detects a remove()+recreate by another process
@@ -432,7 +436,13 @@ class PartitionedEvents(base.Events):
             "supersedes": supersedes,
             "opaque": opaque,
         }
+        # make the sealed bytes durable BEFORE the rename: group-committed
+        # appends may still be awaiting their fsync, and once renamed
+        # their coalescer would fsync a different (fresh) active file
+        with open(active, "rb") as f:
+            os.fsync(f.fileno())
         active.rename(seg)
+        self._c.committers.get(active).mark_all_durable()
         # atomic: a torn sidecar would otherwise poison every windowed
         # find of this partition (replay parses it)
         self._write_atomic(
@@ -580,13 +590,42 @@ class PartitionedEvents(base.Events):
         e = event.with_event_id(event_id)
         pdir = self._pdir(ns, pp)
         line = (json.dumps(e.to_dict(for_api=False)) + "\n").encode()
+        if explicit:
+            # strict path: the supersede entry must be durable BEFORE the
+            # record (ordering across two files — a coalesced fsync of
+            # the data log could otherwise land first)
+            with self._locked(pdir):
+                self._ensure_meta_locked(ns, n)
+                self._log_supersede_locked(pdir, "X", [event_id])
+                self._append_locked(pdir, line)
+                self._maybe_seal_locked(pdir)
+            return event_id
+        # generated-id hot path (the event server's single-event ingest):
+        # append+flush under the lock, fsync via group commit outside it
         with self._locked(pdir):
             self._ensure_meta_locked(ns, n)
-            if explicit:
-                self._log_supersede_locked(pdir, "X", [event_id])
-            self._append_locked(pdir, line)
+            committer, seq, active = self._append_group_committed_locked(
+                pdir, line
+            )
             self._maybe_seal_locked(pdir)
+        committer.wait_durable(seq, active)
         return event_id
+
+    def _append_group_committed_locked(
+        self, pdir: Path, blob: bytes
+    ) -> tuple:
+        """Append + flush to the partition's active log under the (held)
+        partition lock and take a commit sequence; returns (committer,
+        seq, path) for the caller to ``wait_durable`` OUTSIDE the lock.
+        The flush-before-note_write ordering and the outside-the-lock
+        wait are the group-commit protocol's invariants (groupcommit.py);
+        every group-committed append must go through here."""
+        active = pdir / "active.jsonl"
+        with open(active, "ab") as f:
+            f.write(blob)
+            f.flush()
+        committer = self._c.committers.get(active)
+        return committer, committer.note_write(), active
 
     def batch_insert(
         self, events, app_id: int, channel_id: int | None = None
@@ -615,15 +654,26 @@ class PartitionedEvents(base.Events):
                     event.with_event_id(event_id).to_dict(for_api=False)
                 ) + "\n").encode()
             )
+        waits = []
         for pp, lines in per_part.items():
             pdir = self._pdir(ns, pp)
+            xids = per_part_x.get(pp)
+            if xids:
+                # explicit ids: strict ordered fsyncs (see insert)
+                with self._locked(pdir):
+                    self._ensure_meta_locked(ns, n)
+                    self._log_supersede_locked(pdir, "X", xids)
+                    self._append_locked(pdir, b"".join(lines))
+                    self._maybe_seal_locked(pdir)
+                continue
             with self._locked(pdir):
                 self._ensure_meta_locked(ns, n)
-                xids = per_part_x.get(pp)
-                if xids:
-                    self._log_supersede_locked(pdir, "X", xids)
-                self._append_locked(pdir, b"".join(lines))
+                waits.append(
+                    self._append_group_committed_locked(pdir, b"".join(lines))
+                )
                 self._maybe_seal_locked(pdir)
+        for committer, seq, active in waits:
+            committer.wait_durable(seq, active)
         return ids
 
     def append_jsonl(
@@ -699,6 +749,33 @@ class PartitionedEvents(base.Events):
                     (pdir / "active.opaque").touch()
                 self._append_locked(pdir, b"".join(lines))
                 self._maybe_seal_locked(pdir)
+
+    def change_token(
+        self, app_id: int, channel_id: int | None = None
+    ) -> object | None:
+        """Two stats per partition, no directory listings: the active
+        log's (mtime_ns, size) sees appends, the partition dir's mtime
+        sees seals/compactions/imports (they create or rename files)."""
+        ns = self._ns_dir(app_id, channel_id)
+        try:
+            n = int(json.loads((ns / "_meta.json").read_text())["partitions"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return ("absent",)
+        toks: list = []
+        for pp in range(n):
+            pdir = ns / f"p{pp:02x}"
+            try:
+                st_d = pdir.stat()
+                toks.append(st_d.st_mtime_ns)
+            except OSError:
+                toks.append(None)
+                continue
+            try:
+                st_a = (pdir / "active.jsonl").stat()
+                toks.append((st_a.st_mtime_ns, st_a.st_size))
+            except OSError:
+                toks.append(None)
+        return tuple(toks)
 
     def get(
         self, event_id: str, app_id: int, channel_id: int | None = None
@@ -865,6 +942,9 @@ class PartitionedEvents(base.Events):
         self._write_atomic(
             active, b"".join(lines[eid] for eid in chunk)
         )
+        # every live record is now in a fsync'ed file (segments + active
+        # via _write_atomic): release any group-commit waiters
+        self._c.committers.get(active).mark_all_durable()
         return len(table)
 
     def compact(self, app_id: int, channel_id: int | None = None) -> int:

@@ -482,6 +482,8 @@ class SQLiteEvents(base.Events):
     """Per-(app, channel) event tables named ``pio_event_<appId>[_<ch>]``
     (reference JDBCLEvents.scala:37)."""
 
+    entity_indexed = True  # (entitytype, entityid) btree index per table
+
     def __init__(self, client: SQLiteStorageClient):
         self._c = client
 
@@ -519,6 +521,18 @@ class SQLiteEvents(base.Events):
         with self._c.lock, self._c.conn:
             self._c.conn.execute(f"DROP TABLE IF EXISTS {t}")
         return True
+
+    def change_token(
+        self, app_id: int, channel_id: int | None = None
+    ) -> object | None:
+        """(data_version, total_changes): ``PRAGMA data_version`` bumps
+        when ANOTHER connection commits, ``total_changes`` counts this
+        connection's writes — together any write to the database changes
+        the pair. Database-wide, so it may over-invalidate across apps
+        (allowed by the contract)."""
+        with self._c.lock:
+            dv = self._c.conn.execute("PRAGMA data_version").fetchone()[0]
+            return (dv, self._c.conn.total_changes)
 
     @staticmethod
     def _tz_offset_seconds(dt: datetime) -> int:

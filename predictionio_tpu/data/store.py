@@ -77,6 +77,19 @@ def find(
     )
 
 
+def change_token(
+    app_name: str,
+    channel_name: str | None = None,
+    storage: Storage | None = None,
+) -> object | None:
+    """Cheap change token for an app's event set (``None`` = backend
+    can't provide one; see ``base.Events.change_token``). Serving-time
+    caches key on this to skip re-reading a store that hasn't changed."""
+    storage = storage or get_storage()
+    app_id, channel_id = app_name_to_id(app_name, channel_name, storage)
+    return storage.get_events().change_token(app_id, channel_id)
+
+
 def find_by_entity(
     app_name: str,
     entity_type: str,

@@ -157,6 +157,10 @@ class ECommModel:
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_device"] = None
+        # derived serving caches (device arrays / index maps) rebuild
+        # lazily after unpickle
+        state.pop("_weighted_V", None)
+        state.pop("_cat_members", None)
         return state
 
 
@@ -196,8 +200,75 @@ class ECommAlgorithm(Algorithm):
         )
 
     # -- live business rules (host-side, before the device call) ----------
+    #
+    # Live semantics with cached cost: every filter read goes through a
+    # per-algorithm cache keyed by the event store's change_token — a
+    # static store serves seen/unavailable sets from memory (the reads
+    # that made live-filter serving ~100x the dense path replayed the
+    # event store per request), while ANY write to the store changes the
+    # token and drops the whole cache, so a just-ingested
+    # ``$set unavailableItems`` or view event takes effect on the next
+    # query. Backends that can't produce a token (change_token -> None,
+    # e.g. the http client backend) disable caching and keep the
+    # reference's read-per-request behavior.
+
+    def _filter_cache(self) -> tuple[dict | None, object]:
+        """(cache dict or None if caching disabled, current token)."""
+        try:
+            token = store.change_token(self.params.app_name)
+        except Exception:
+            token = None
+        if token is None:
+            return None, None
+        cache = getattr(self, "_filters", None)
+        if cache is None or cache["token"] != token:
+            cache = {"token": token, "seen": {}, "unavail": None}
+            self._filters = cache
+        return cache, token
+
     def _seen_items(self, user: str) -> set[str]:
-        """Live read of the user's seen events (reference :234-249)."""
+        """Live read of the user's seen events (reference :234-249),
+        cached until the event store changes.
+
+        On replay-style backends (jsonl, partitioned, memory — where a
+        filtered read costs a full scan anyway) the first miss builds the
+        seen sets of EVERY user in one scan, so 40 distinct users cost
+        one replay, not 40. Indexed backends (sqlite, http) keep cheap
+        per-user point reads."""
+        cache, _ = self._filter_cache()
+        if cache is not None:
+            if user in cache["seen"]:
+                return cache["seen"][user]
+            if cache.get("seen_all") is not None:
+                return cache["seen_all"].get(user, frozenset())
+        try:
+            from predictionio_tpu.data.storage import get_storage
+
+            indexed = get_storage().get_events().entity_indexed
+        except Exception:
+            indexed = True
+        if cache is not None and not indexed:
+            try:
+                events = store.find(
+                    app_name=self.params.app_name,
+                    entity_type="user",
+                    event_names=list(self.params.seen_events),
+                    target_entity_type="item",
+                    limit=None,
+                )
+            except Exception:
+                logger.exception(
+                    "seen-items scan failed; serving without filter"
+                )
+                return set()
+            seen_all: dict[str, set[str]] = {}
+            for e in events:
+                if e.target_entity_id:
+                    seen_all.setdefault(e.entity_id, set()).add(
+                        e.target_entity_id
+                    )
+            cache["seen_all"] = seen_all
+            return seen_all.get(user, frozenset())
         try:
             events = store.find_by_entity(
                 app_name=self.params.app_name,
@@ -210,11 +281,17 @@ class ECommAlgorithm(Algorithm):
         except Exception:
             logger.exception("seen-items read failed; serving without filter")
             return set()
-        return {e.target_entity_id for e in events if e.target_entity_id}
+        seen = {e.target_entity_id for e in events if e.target_entity_id}
+        if cache is not None:
+            cache["seen"][user] = seen
+        return seen
 
     def _unavailable_items(self) -> set[str]:
         """Live read of the latest unavailableItems constraint
-        (reference :250-265)."""
+        (reference :250-265), cached until the event store changes."""
+        cache, _ = self._filter_cache()
+        if cache is not None and cache["unavail"] is not None:
+            return cache["unavail"]
         try:
             events = store.find_by_entity(
                 app_name=self.params.app_name,
@@ -227,9 +304,14 @@ class ECommAlgorithm(Algorithm):
         except Exception:
             logger.exception("constraint read failed; serving without filter")
             return set()
-        if not events:
-            return set()
-        return set(events[0].properties.get_opt("items", default=[]) or [])
+        unavail = (
+            set(events[0].properties.get_opt("items", default=[]) or [])
+            if events
+            else set()
+        )
+        if cache is not None:
+            cache["unavail"] = unavail
+        return unavail
 
     def _recent_item_vector(self, model: ECommModel, user: str):
         """Cold-start: mean factor vector of recently viewed items
@@ -255,9 +337,29 @@ class ECommAlgorithm(Algorithm):
             return None
         return model.item_factors[ixs].mean(axis=0)
 
-    def _mask_and_weights(
-        self, model: ECommModel, query: Query
-    ) -> tuple[np.ndarray, np.ndarray]:
+    def _category_members(self, model: ECommModel, category: str) -> np.ndarray:
+        """Item indices carrying ``category`` — built once per (model,
+        category), replacing the per-query full-catalog Python loop."""
+        index = getattr(model, "_cat_members", None)
+        if index is None:
+            index = {}
+            model._cat_members = index
+        got = index.get(category)
+        if got is None:
+            got = np.fromiter(
+                (
+                    ix
+                    for iid, ix in model.item_index.items()
+                    if category in model.categories.get(iid, ())
+                ),
+                np.int64,
+            )
+            index[category] = got
+        return got
+
+    def _exclusions(self, model: ECommModel, query: Query) -> np.ndarray:
+        """Per-query exclusion mask: white/black lists, categories,
+        unavailable items, seen items (reference :234-295)."""
         from predictionio_tpu.models.filters import entity_exclusion_mask
 
         n = len(model.item_index)
@@ -265,10 +367,10 @@ class ECommAlgorithm(Algorithm):
             model.item_index, (), query.whiteList, query.blackList
         )
         if query.categories is not None:
-            wanted = set(query.categories)
-            for iid, ix in model.item_index.items():
-                if not wanted.intersection(model.categories.get(iid, ())):
-                    mask[ix] = True
+            in_any = np.zeros(n, bool)
+            for cat in query.categories:
+                in_any[self._category_members(model, cat)] = True
+            mask |= ~in_any
         for iid in self._unavailable_items():
             if iid in model.item_index:
                 mask[model.item_index[iid]] = True
@@ -276,14 +378,37 @@ class ECommAlgorithm(Algorithm):
             for iid in self._seen_items(query.user):
                 if iid in model.item_index:
                     mask[model.item_index[iid]] = True
+        return mask
 
-        weights = np.ones(n, dtype=np.float32)
-        for group in self.params.weights:
-            w = float(group.get("weight", 1.0))
-            for iid in group.get("items", []):
-                if iid in model.item_index:
-                    weights[model.item_index[iid]] = w
-        return mask, weights
+    def _weighted_item_factors(self, model: ECommModel):
+        """Device-resident ``V * weights`` — weights are static per
+        deployment (params), so the [I, D] multiply runs once, not per
+        query. Keyed by the weight CONTENT: two algorithms with
+        different weight groups may serve the same model object, and an
+        instance-identity key would both defeat that sharing and go
+        stale when ids are recycled."""
+        import json as json_mod
+
+        key = json_mod.dumps(self.params.weights, sort_keys=True)
+        cached = getattr(model, "_weighted_V", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        import jax.numpy as jnp
+
+        _, V = model.device_factors()
+        if self.params.weights:
+            n = len(model.item_index)
+            weights = np.ones(n, dtype=np.float32)
+            for group in self.params.weights:
+                w = float(group.get("weight", 1.0))
+                for iid in group.get("items", []):
+                    if iid in model.item_index:
+                        weights[model.item_index[iid]] = w
+            weighted = V * jnp.asarray(weights)[:, None]
+        else:
+            weighted = V
+        model._weighted_V = (key, weighted)
+        return weighted
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         import jax.numpy as jnp
@@ -304,10 +429,10 @@ class ECommAlgorithm(Algorithm):
                 return PredictedResult(itemScores=[])
             user_vec = jnp.asarray(recent)
 
-        mask, weights = self._mask_and_weights(model, query)
+        mask = self._exclusions(model, query)
         scores, ids = top_k_items(
             user_vec,
-            V * jnp.asarray(weights)[:, None],
+            self._weighted_item_factors(model),
             k=int(query.num),
             exclude_mask=jnp.asarray(mask),
         )

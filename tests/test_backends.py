@@ -617,3 +617,151 @@ class TestWebHDFSModels:
         assert isinstance(dao, DFSModels)
         dao.insert(Model("m", b"x"))
         assert dao.get("m").models == b"x"
+
+
+class TestChangeToken:
+    """Events.change_token: any write must change it (serving-filter
+    caches key on it); a quiet store must keep it stable."""
+
+    def _daos(self, tmp_path):
+        from predictionio_tpu.data.storage.memory import (
+            MemoryEvents,
+            MemoryStorageClient,
+        )
+        from predictionio_tpu.data.storage.partitioned import (
+            PartitionedEvents,
+            PartitionedStorageClient,
+        )
+        from predictionio_tpu.data.storage.sqlite import (
+            SQLiteEvents,
+            SQLiteStorageClient,
+        )
+
+        return {
+            "memory": MemoryEvents(MemoryStorageClient()),
+            "jsonl": JSONLEvents(
+                JSONLStorageClient({"path": str(tmp_path / "jl")})
+            ),
+            "sqlite": SQLiteEvents(
+                SQLiteStorageClient({"path": str(tmp_path / "ev.db")})
+            ),
+            "partitioned": PartitionedEvents(
+                PartitionedStorageClient(
+                    {"path": str(tmp_path / "parts"), "partitions": 2}
+                )
+            ),
+        }
+
+    def test_writes_change_token_quiet_store_keeps_it(self, tmp_path):
+        import time
+
+        for name, dao in self._daos(tmp_path).items():
+            t0 = dao.change_token(1)
+            assert t0 is not None, name
+            eid = dao.insert(_event(1), 1)
+            t1 = dao.change_token(1)
+            assert t1 != t0, f"{name}: insert did not change the token"
+            # mtime-based tokens need a tick between writes on coarse fs
+            time.sleep(0.002)
+            dao.delete(eid, 1)
+            t2 = dao.change_token(1)
+            assert t2 != t1, f"{name}: delete did not change the token"
+            assert dao.change_token(1) == t2, f"{name}: quiet store moved"
+
+    def test_base_default_is_none(self):
+        from predictionio_tpu.data.storage import base
+
+        class Minimal(base.Events):
+            def init(self, *a, **k): return True
+            def remove(self, *a, **k): return False
+            def insert(self, *a, **k): return ""
+            def get(self, *a, **k): return None
+            def delete(self, *a, **k): return False
+            def find(self, *a, **k): return []
+
+        assert Minimal().change_token(1) is None
+
+    def test_store_helper_resolves_app_name(self, tmp_path):
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.storage import App, set_storage, test_storage
+
+        s = test_storage()
+        set_storage(s)
+        try:
+            app_id = s.get_metadata_apps().insert(App(0, "TokApp"))
+            t0 = store.change_token("TokApp")
+            s.get_events().insert(_event(1), app_id)
+            assert store.change_token("TokApp") != t0
+        finally:
+            set_storage(None)
+
+
+class TestGroupCommit:
+    """Fsync group commit (groupcommit.py): concurrent single-event
+    writers must coalesce onto fewer fsyncs while every acked event
+    stays durable-ordered (ack strictly after a covering fsync)."""
+
+    def test_concurrent_inserts_coalesce_fsyncs(self, tmp_path, monkeypatch):
+        import os as os_mod
+        from concurrent.futures import ThreadPoolExecutor
+
+        from predictionio_tpu.data.storage import groupcommit
+
+        dao = JSONLEvents(JSONLStorageClient({"path": str(tmp_path)}))
+        dao.insert(_event(0), 1)  # create the file outside the count
+        calls = []
+        real_fsync = os_mod.fsync
+
+        def counting_fsync(fd):
+            calls.append(fd)
+            return real_fsync(fd)
+
+        monkeypatch.setattr(groupcommit.os, "fsync", counting_fsync)
+        n = 64
+        with ThreadPoolExecutor(16) as pool:
+            ids = list(pool.map(
+                lambda i: dao.insert(_event(i + 1), 1), range(n)
+            ))
+        assert len(set(ids)) == n
+        assert len(calls) < n, (
+            f"no coalescing: {len(calls)} fsyncs for {n} concurrent inserts"
+        )
+        got = {e.event_id for e in dao.find(1, limit=None)}
+        assert set(ids) <= got
+
+    def test_partitioned_rotation_during_group_commit(self, tmp_path):
+        """Seals triggered mid-stream fsync the active log BEFORE the
+        rename and release waiters — no event may be lost across
+        rotations under concurrent generated-id ingest."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from predictionio_tpu.data.storage.partitioned import (
+            PartitionedEvents,
+            PartitionedStorageClient,
+        )
+
+        dao = PartitionedEvents(PartitionedStorageClient(
+            {"path": str(tmp_path / "p"), "partitions": 2,
+             "segment_bytes": 400}  # rotate every couple of events
+        ))
+        n = 120
+        with ThreadPoolExecutor(12) as pool:
+            ids = list(pool.map(lambda i: dao.insert(_event(i), 7), range(n)))
+        assert len(set(ids)) == n
+        got = {e.event_id for e in dao.find(7, limit=None)}
+        assert set(ids) == got
+        # rotations actually happened
+        assert list((tmp_path / "p").glob("events_7/p*/seg_*.jsonl"))
+
+    def test_syncer_error_propagates_and_recovers(self, tmp_path):
+        from predictionio_tpu.data.storage.groupcommit import FsyncCoalescer
+
+        c = FsyncCoalescer()
+        seq = c.note_write()
+        # missing file = rotated/removed: treated as moot, returns
+        c.wait_durable(seq, tmp_path / "never-existed")
+        # later writes against a real file still work
+        f = tmp_path / "log"
+        f.write_bytes(b"x")
+        seq2 = c.note_write()
+        c.wait_durable(seq2, f)

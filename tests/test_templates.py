@@ -440,6 +440,49 @@ class TestECommerce:
         boosted = boosted_algo.predict(model, ecom.Query(user="u0", num=10))
         assert boosted.itemScores[0].item == target
 
+    def test_live_filter_cache_hits_without_store_reads(self, seeded, monkeypatch):
+        """On a static store, repeat queries serve the seen/unavailable
+        filters from the change-token cache — zero event-store reads —
+        and any write drops the cache (the fix for live-filter serving
+        running ~100x the dense path)."""
+        from predictionio_tpu.data import store as store_mod
+        from predictionio_tpu.models import ecommerce as ecom
+
+        storage, app_id = seeded
+        algo = ecom.ECommAlgorithm(
+            ecom.ECommAlgorithmParams(
+                app_name="EcomApp", rank=4, num_iterations=4, unseen_only=True
+            )
+        )
+        td = ecom.ECommerceDataSource(
+            ecom.DataSourceParams(app_name="EcomApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        algo.predict(model, ecom.Query(user="u0", num=5))  # warm the cache
+
+        calls = []
+        real = store_mod.find_by_entity
+
+        def counting(*a, **kw):
+            calls.append(kw.get("entity_type"))
+            return real(*a, **kw)
+
+        monkeypatch.setattr(store_mod, "find_by_entity", counting)
+        r1 = algo.predict(model, ecom.Query(user="u0", num=5))
+        assert calls == [], f"cached serving still read the store: {calls}"
+        # a write (any event) invalidates: the next query re-reads
+        ban = [r1.itemScores[0].item]
+        storage.get_events().insert(
+            Event(
+                event="$set", entity_type="constraint",
+                entity_id="unavailableItems", properties={"items": ban},
+            ),
+            app_id,
+        )
+        r2 = algo.predict(model, ecom.Query(user="u0", num=5))
+        assert calls, "post-write serving must re-read the live filters"
+        assert ban[0] not in {s.item for s in r2.itemScores}
+
     def test_cold_start_user_via_recent_views(self, seeded):
         from predictionio_tpu.models import ecommerce as ecom
 
