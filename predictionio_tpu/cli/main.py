@@ -219,6 +219,18 @@ def cmd_train(args) -> int:
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.core.workflow import run_train
 
+    if getattr(args, "multihost", False):
+        # join the global mesh BEFORE anything touches JAX: afterwards
+        # jax.devices() is the pod-wide set and --mesh axes span hosts
+        # (the cluster-submission analog of the reference's spark-submit
+        # master flags, tools/.../Runner.scala:193-244)
+        from predictionio_tpu.parallel.mesh import initialize_multihost
+
+        initialize_multihost(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     engine, variant, factory = _engine_from_args(args)
     engine_params = engine.params_from_variant(variant)
     wp = WorkflowParams(
@@ -611,6 +623,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="device-mesh axes for the training run, e.g. 'data=8' or "
         "'data=4,model=2' (-1 once absorbs remaining devices)",
     )
+    t.add_argument(
+        "--multihost", action="store_true",
+        help="join a multi-host JAX runtime before training: run the "
+        "same command on every host (TPU pod slices auto-detect; "
+        "elsewhere pass --coordinator/--num-processes/--process-id or "
+        "the PIO_COORDINATOR_ADDRESS/PIO_NUM_PROCESSES/PIO_PROCESS_ID "
+        "env vars); --mesh axes then span the global device set",
+    )
+    t.add_argument("--coordinator", help="host:port of process 0")
+    t.add_argument("--num-processes", type=int)
+    t.add_argument("--process-id", type=int)
     t.set_defaults(fn=cmd_train)
 
     ev = sub.add_parser("eval")

@@ -41,6 +41,17 @@ def _now() -> datetime:
     return datetime.now(tz=timezone.utc)
 
 
+def _is_primary_process() -> bool:
+    """True unless this is a non-zero process of a multi-host runtime
+    (parallel/mesh.py initialize_multihost)."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # pragma: no cover - pre-backend-init edge
+        return True
+
+
 def run_train(
     engine: Engine,
     engine_params: EngineParams,
@@ -63,6 +74,10 @@ def run_train(
         runtime_conf=wp.runtime_conf,
         mesh_axes=wp.mesh_axes,
     )
+    # multi-host runs execute this driver on EVERY host (the collectives
+    # need all of them); only process 0 touches metadata/model storage,
+    # or a pod would record one instance per host
+    primary = _is_primary_process()
 
     instances = storage.get_metadata_engine_instances()
     instance = EngineInstance(
@@ -87,11 +102,12 @@ def run_train(
         ),
         serving_params=_params_json(engine_params.serving),
     )
-    instance_id = instances.insert(instance)
+    instance_id = instances.insert(instance) if primary else ""
     # adopt the generated id locally: remote backends (http) can't mutate
     # our copy server-side, and the later update() keys on instance.id
     instance.id = instance_id
-    logger.info("engine instance %s created (INIT)", instance_id)
+    if primary:
+        logger.info("engine instance %s created (INIT)", instance_id)
 
     try:
         algorithms = engine.make_algorithms(engine_params)
@@ -102,25 +118,28 @@ def run_train(
                 models = engine.train(ctx, engine_params, wp, algorithms=algorithms)
         else:
             models = engine.train(ctx, engine_params, wp, algorithms=algorithms)
-        if wp.save_model:
+        if wp.save_model and primary:
             blob = persistence.serialize_models(algorithms, models, instance_id)
             storage.get_model_data_models().insert(Model(instance_id, blob))
         instance.status = EngineInstanceStatus.COMPLETED
         instance.end_time = _now()
-        instances.update(instance)
-        logger.info("engine instance %s COMPLETED", instance_id)
+        if primary:
+            instances.update(instance)
+            logger.info("engine instance %s COMPLETED", instance_id)
         return instance_id
     except (StopAfterReadInterruption, StopAfterPrepareInterruption) as stop:
         # debug stop requested via WorkflowParams — not a failure
         # (reference CoreWorkflow.scala:91-97)
         instance.end_time = _now()
-        instances.update(instance)
+        if primary:
+            instances.update(instance)
         logger.info("training of %s interrupted by %s", instance_id, type(stop).__name__)
         return instance_id
     except Exception:
         instance.status = EngineInstanceStatus.FAILED
         instance.end_time = _now()
-        instances.update(instance)
+        if primary:
+            instances.update(instance)
         logger.error(
             "engine instance %s FAILED:\n%s", instance_id, traceback.format_exc()
         )

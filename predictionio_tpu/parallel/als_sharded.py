@@ -386,4 +386,13 @@ def train_for_context(
             f"shardedTrain needs a 'data' axis on the mesh; got axes "
             f"{tuple(mesh.axis_names)}"
         )
-    return sharded_als_train(data, params, mesh, axis)
+    U, V = sharded_als_train(data, params, mesh, axis)
+    if jax.process_count() > 1:
+        # multi-host: shards live on other hosts' devices; templates
+        # np.asarray the factors for persistence, so gather them to
+        # host-replicated arrays (every host gets the full model)
+        from jax.experimental import multihost_utils
+
+        U = multihost_utils.process_allgather(U, tiled=True)
+        V = multihost_utils.process_allgather(V, tiled=True)
+    return U, V

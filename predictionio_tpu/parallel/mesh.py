@@ -1,10 +1,72 @@
-"""Mesh construction helpers."""
+"""Mesh construction helpers and multi-host runtime initialization.
+
+Single-host: ``make_mesh`` arranges this process's devices. Multi-host
+(a TPU pod, or several CPU hosts): call :func:`initialize_multihost`
+FIRST — it brings up JAX's distributed runtime so ``jax.devices()``
+returns the GLOBAL device set and every collective in the sharded
+trainers (all_gather/psum over the mesh axis) spans hosts via ICI/DCN.
+This replaces the reference's cluster-submission path (spark-submit to
+YARN/Mesos masters, tools/.../Runner.scala:193-244): instead of
+shipping jars to executors, every host runs the same ``pio train
+--multihost`` and the runtime stitches their chips into one mesh.
+"""
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Sequence
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids: Sequence[int] | None = None,
+) -> bool:
+    """Join this process into a multi-host JAX runtime (idempotent).
+
+    Arguments default to the ``PIO_COORDINATOR_ADDRESS`` /
+    ``PIO_NUM_PROCESSES`` / ``PIO_PROCESS_ID`` environment variables;
+    with everything unset, ``jax.distributed.initialize()`` auto-detects
+    on TPU pod slices (its own env/metadata discovery). Call before any
+    other JAX API — backend initialization pins the device set.
+
+    Returns True if the distributed runtime was (already) initialized.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "PIO_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("PIO_NUM_PROCESSES"):
+        num_processes = int(os.environ["PIO_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("PIO_PROCESS_ID"):
+        process_id = int(os.environ["PIO_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    logger.info(
+        "multi-host runtime up: process %d/%d, %d global / %d local devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+        len(jax.local_devices()),
+    )
+    return True
 
 
 def device_count() -> int:
