@@ -285,20 +285,22 @@ class ALSAlgorithm(Algorithm):
     ) -> list[ALSModel] | None:
         """Stacked candidate trainings for evaluation sweeps: ONE bucket
         layout build and ONE vmapped device program train every
-        reg/seed candidate (ops.als.als_train_sweep). Falls back (None)
-        when candidates differ in program shape (rank, iterations,
-        dtype, bucket widths) or in non-ALS knobs."""
+        reg/seed/RANK candidate (ops.als.als_train_sweep — differing
+        ranks ride the candidate axis via exact zero-padding). Falls
+        back (None) when candidates differ in program shape
+        (iterations, dtype, bucket widths) or in non-ALS knobs."""
         if len(td.ratings) == 0 or len(params_list) < 2:
             return None
         base = params_list[0]
+        ranks_differ = len({p.rank for p in params_list}) > 1
         for p in params_list:
             if (
-                p.rank != base.rank
-                or p.num_iterations != base.num_iterations
+                p.num_iterations != base.num_iterations
                 or p.compute_dtype != base.compute_dtype
                 or p.storage_dtype != base.storage_dtype
                 or tuple(p.bucket_widths) != tuple(base.bucket_widths)
                 or p.sharded_train
+                or (ranks_differ and p.lambda_ <= 0)
             ):
                 return None
         user_index = BiMap.from_dense(td.user_ids)

@@ -636,20 +636,32 @@ def als_train_sweep(
     independent small trainings stack on the candidate axis (vmap), so a
     lambda/seed sweep costs roughly one training's dispatch overhead.
 
-    Candidates must share the static program shape — rank, iterations,
-    bucket layout, compute dtype, implicit flag, and reg-weighting flags;
-    ``reg``, ``alpha``, and ``seed`` may vary per candidate (they ride as
-    traced inputs / stacked inits). Raises ValueError otherwise.
+    Candidates must share the static program shape — iterations, bucket
+    layout, compute dtype, implicit flag, and reg-weighting flags;
+    ``reg``, ``alpha``, ``seed`` AND ``rank`` may vary per candidate.
+    Raises ValueError otherwise.
 
-    Returns a list of per-candidate (U, V), matching ``als_train`` for
-    the same params bit-for-bit in program structure (same bucket math;
-    tiny float differences can arise from batched-op scheduling).
+    **Rank rides the candidate axis via zero-padding.** A candidate of
+    rank r trains inside the max-rank program with its factor columns
+    >= r initialized to exactly zero — and they STAY exactly zero: the
+    Gramian of zero-padded factors is block-diagonal ``[[A_rr, 0], [0,
+    0]]``, regularization lifts the dead block to ``lam*I``, and the
+    solve returns exact zeros for the padded columns (0*x and sums of
+    zeros are exact in floating point, any dtype). So each candidate's
+    trajectory equals its standalone rank-r training for the same seed
+    — the common rank-tuning sweep (MetricEvaluator.scala:185-260 runs
+    those serially on Spark) compiles and dispatches ONCE.
+
+    Returns a list of per-candidate (U, V) at each candidate's own rank
+    (padded columns sliced off), matching ``als_train`` per candidate
+    (same bucket math; tiny float differences can arise from batched-op
+    scheduling).
     """
     if not params_list:
         raise ValueError("params_list must not be empty")
     base = params_list[0]
     static_fields = (
-        "rank", "iterations", "implicit", "weighted_reg",
+        "iterations", "implicit", "weighted_reg",
         "implicit_weighted_reg", "compute_dtype", "storage_dtype",
         "bucket_widths", "gather_chunk_bytes",
     )
@@ -658,19 +670,51 @@ def als_train_sweep(
         if diffs:
             raise ValueError(
                 "als_train_sweep candidates must share the static program "
-                f"shape; differing fields: {diffs} (sweep reg/alpha/seed "
-                "instead, or run separate trainings)"
+                f"shape; differing fields: {diffs} (sweep reg/alpha/seed/"
+                "rank instead, or run separate trainings)"
             )
+    rank_max = max(p.rank for p in params_list)
+    ranks = [p.rank for p in params_list]
+    if len(set(ranks)) > 1 and any(p.reg <= 0 for p in params_list):
+        # the padded columns' dead block is lifted to lam*I by the
+        # regularizer; reg == 0 would leave it singular
+        raise ValueError(
+            "rank-sweep candidates need reg > 0 (the zero-padded factor "
+            "block is kept solvable by the regularizer)"
+        )
+    # cost model: padding every candidate to rank_max multiplies the
+    # dominant Gramian term by (rank_max/r)^2. When the pad waste beats
+    # ~1.5x the exact work, split into per-rank groups instead — each
+    # group still vmaps its lambda/seed candidates; the price is one
+    # compile per distinct rank (a rank x lambda grid keeps full
+    # batching within each rank)
+    exact = sum(r * r for r in ranks)
+    if len(set(ranks)) > 1 and len(ranks) * rank_max**2 > 1.5 * exact:
+        out: list = [None] * len(params_list)
+        for r in sorted(set(ranks)):
+            idx = [i for i, p in enumerate(params_list) if p.rank == r]
+            for i, res in zip(
+                idx, als_train_sweep(data, [params_list[i] for i in idx])
+            ):
+                out[i] = res
+        return out
     U0 = []
     V0 = []
     sd = jnp.dtype(base.storage_dtype)
     for p in params_list:
         key_u, key_v = jax.random.split(jax.random.PRNGKey(p.seed))
-        U0.append(init_factors(data.num_rows, p.rank, key_u).astype(sd))
-        V0.append(init_factors(data.num_cols, p.rank, key_v).astype(sd))
+        pad = ((0, 0), (0, rank_max - p.rank))
+        U0.append(
+            jnp.pad(init_factors(data.num_rows, p.rank, key_u), pad).astype(sd)
+        )
+        V0.append(
+            jnp.pad(init_factors(data.num_cols, p.rank, key_v), pad).astype(sd)
+        )
     regs = jnp.asarray([p.reg for p in params_list], jnp.float32)
     alphas = jnp.asarray([p.alpha for p in params_list], jnp.float32)
-    static_params = dataclasses.replace(base, iterations=0, reg=0.0, alpha=0.0)
+    static_params = dataclasses.replace(
+        base, iterations=0, reg=0.0, alpha=0.0, rank=rank_max
+    )
     U, V = _train_fused_sweep(
         jnp.stack(U0),
         jnp.stack(V0),
@@ -681,7 +725,10 @@ def als_train_sweep(
         static_params,
         base.iterations,
     )
-    return [(U[c], V[c]) for c in range(len(params_list))]
+    return [
+        (U[c, :, : p.rank], V[c, :, : p.rank])
+        for c, p in enumerate(params_list)
+    ]
 
 
 def als_train_stepwise(data: RatingsData, params: ALSParams):

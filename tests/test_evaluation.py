@@ -325,10 +325,67 @@ class TestVectorizedSweep:
         with pytest.raises(ValueError, match="static program shape"):
             als.als_train_sweep(
                 data,
-                [als.ALSParams(rank=4), als.ALSParams(rank=8)],
+                [als.ALSParams(iterations=3), als.ALSParams(iterations=5)],
+            )
+        with pytest.raises(ValueError, match="reg > 0"):
+            als.als_train_sweep(
+                data,
+                [als.ALSParams(rank=4, reg=0.0), als.ALSParams(rank=8, reg=0.0)],
             )
         with pytest.raises(ValueError, match="must not be empty"):
             als.als_train_sweep(data, [])
+
+    def test_ops_sweep_mixed_ranks_match_standalone(self):
+        """Differing ranks ride the candidate axis via exact
+        zero-padding: each candidate's factors must equal its OWN
+        standalone rank-r training (the padded columns solve to exact
+        zeros and are sliced off)."""
+        import numpy as np
+
+        from predictionio_tpu.ops import als
+
+        rng = np.random.default_rng(5)
+        rows = rng.integers(0, 40, 1200).astype(np.int32)
+        cols = rng.integers(0, 25, 1200).astype(np.int32)
+        vals = rng.integers(1, 6, 1200).astype(np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 40, 25,
+                                      bucket_widths=(16, 64))
+        cands = [
+            als.ALSParams(rank=r, iterations=4, reg=reg, seed=s)
+            for r, reg, s in [(3, 0.05, 1), (6, 0.05, 1), (6, 0.2, 2)]
+        ]
+        swept = als.als_train_sweep(data, cands)
+        for p, (U, V) in zip(cands, swept):
+            assert U.shape == (40, p.rank) and V.shape == (25, p.rank)
+            Us, Vs = als.als_train(data, p)
+            np.testing.assert_allclose(
+                np.asarray(U), np.asarray(Us), rtol=1e-4, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(V), np.asarray(Vs), rtol=1e-4, atol=1e-5
+            )
+
+    def test_ops_sweep_mixed_ranks_implicit(self):
+        import numpy as np
+
+        from predictionio_tpu.ops import als
+
+        rng = np.random.default_rng(7)
+        rows = rng.integers(0, 24, 600).astype(np.int32)
+        cols = rng.integers(0, 18, 600).astype(np.int32)
+        vals = np.ones(600, np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 24, 18,
+                                      bucket_widths=(32,))
+        cands = [
+            als.ALSParams(rank=r, iterations=3, reg=0.05, implicit=True,
+                          alpha=2.0, seed=3)
+            for r in (2, 5)
+        ]
+        for p, (U, V) in zip(cands, als.als_train_sweep(data, cands)):
+            Us, Vs = als.als_train(data, p)
+            np.testing.assert_allclose(
+                np.asarray(U), np.asarray(Us), rtol=1e-4, atol=1e-5
+            )
 
     def test_fast_eval_sweep_path_matches_serial(self, storage):
         """A lambda sweep through FastEvalEngine must produce the same
