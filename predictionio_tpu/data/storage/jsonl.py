@@ -22,6 +22,8 @@ from datetime import datetime
 from pathlib import Path
 from typing import Sequence
 
+import numpy as np
+
 try:  # advisory cross-process locks; Unix-only (this framework targets Linux)
     import fcntl
 except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
@@ -30,6 +32,12 @@ except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.memory import query_events
+
+# chunk size for bounded-RSS bulk reads: past this buffer size the
+# columnar read proves cleanliness and extracts ratings in line-aligned
+# chunks so peak RSS stays O(buffer + chunk), not O(buffer + spans).
+# Defined once in native (span tables cost ~176 bytes/line).
+from predictionio_tpu.native import SCAN_CHUNK_BYTES  # noqa: E402
 
 
 def fold_jsonl_file(
@@ -67,15 +75,29 @@ def has_delete_markers(buf: bytes) -> bool:
     return buf.startswith(b'{"$delete"') or b'\n{"$delete"' in buf
 
 
+def _clean_scan_check(scanned) -> tuple[bool, list[str], int]:
+    """Shared cleanliness predicate over one span scan: returns (dirty,
+    unique ids, count of lines with a scanned id). Dirty when any id
+    repeats or any line's id wasn't scannable (degraded pure-Python mode
+    flags ALL lines, escaped ids flag a few) — either could hide a
+    replacement. Both prove_clean paths apply exactly this check."""
+    from predictionio_tpu import native
+
+    ids = scanned.offs[:, native.F_EVENT_ID]
+    _, uniq = native.index_spans(
+        scanned.buf, ids, scanned.lens[:, native.F_EVENT_ID]
+    )
+    n_with_id = int((ids >= 0).sum())
+    n_lines = int((scanned.flags & native.FLAG_EMPTY == 0).sum())
+    return (len(uniq) < n_with_id or n_with_id < n_lines), uniq, n_with_id
+
+
 def prove_clean(buf: bytes):
     """Prove an event-log buffer replay-clean (no delete markers, unique
     event ids) so a columnar scan can treat it as a plain record set.
 
     Returns ``(needs_compact, scanned)`` where ``scanned`` is the native
-    span scan (reusable by the ratings extraction) or None. Uniqueness is
-    only provable for lines whose event-id span was scanned; any
-    unscannable line (degraded pure-Python mode flags ALL lines, escaped
-    ids flag a few) could hide a replacement -> needs_compact.
+    span scan (reusable by the ratings extraction) or None.
     """
     from predictionio_tpu import native
 
@@ -84,13 +106,40 @@ def prove_clean(buf: bytes):
     if has_delete_markers(buf):
         return True, None
     scanned = native.scan_events(buf)
-    ids = scanned.offs[:, native.F_EVENT_ID]
-    _, uniq = native.index_spans(
-        scanned.buf, ids, scanned.lens[:, native.F_EVENT_ID]
-    )
-    n_with_id = int((ids >= 0).sum())
-    n_lines = int((scanned.flags & native.FLAG_EMPTY == 0).sum())
-    return (len(uniq) < n_with_id or n_with_id < n_lines), scanned
+    dirty, _, _ = _clean_scan_check(scanned)
+    return dirty, scanned
+
+
+def prove_clean_chunked(buf: bytes, chunk_bytes: int | None = None):
+    """Chunked :func:`prove_clean` for multi-GB logs: per-chunk span
+    scans (O(chunk) memory) plus a global uniqueness check over 64-bit
+    id hashes. A hash collision can only FALSELY flag dirty (forcing a
+    harmless compaction) — two equal ids always collide, so a true
+    duplicate is never missed. Returns ``(needs_compact, None)``; the
+    span scan is not reusable by design (it never exists whole).
+    """
+    from predictionio_tpu import native
+
+    if chunk_bytes is None:
+        chunk_bytes = SCAN_CHUNK_BYTES
+    if not buf:
+        return False, None
+    if has_delete_markers(buf):
+        return True, None
+    hashes: list = []
+    total_ids = 0
+    for chunk in native._line_aligned_chunks(buf, chunk_bytes):
+        dirty, uniq, n_with_id = _clean_scan_check(native.scan_events(chunk))
+        if dirty:
+            return True, None  # intra-chunk duplicate / unscannable line
+        total_ids += n_with_id
+        hashes.append(
+            np.fromiter((hash(u) for u in uniq), np.int64, len(uniq))
+        )
+    if not total_ids:
+        return False, None
+    all_hashes = np.concatenate(hashes)
+    return len(np.unique(all_hashes)) < total_ids, None
 
 
 class JSONLStorageClient:
@@ -323,8 +372,14 @@ class JSONLEvents(base.Events):
         with self._locked(app_id, channel_id) as path:
             buf = path.read_bytes() if path.exists() else b""
             scanned = None
+            # multi-GB logs prove cleanliness and extract in line-aligned
+            # chunks: whole-buffer span tables (~176 B/line) would rival
+            # the 20M-event e2e's entire RSS budget
+            big = len(buf) > SCAN_CHUNK_BYTES
             if buf and self._c.clean_stat.get(path) == _stat(path):
                 needs_compact = False  # unchanged since last proven clean
+            elif big:
+                needs_compact, scanned = prove_clean_chunked(buf)
             else:
                 needs_compact, scanned = prove_clean(buf)
             if needs_compact:
@@ -338,16 +393,24 @@ class JSONLEvents(base.Events):
                 # until the file changes; record the stat so the next
                 # read skips the uniqueness pass / re-compaction
                 self._c.clean_stat[path] = _stat(path)
-        users, items, rows, cols, vals = native.load_ratings_jsonl(
-            buf,
+        filters = dict(
             event_names=list(event_names) if event_names is not None else None,
             rating_key=rating_key,
             default_ratings=default_ratings,
             entity_type=entity_type,
             target_entity_type=target_entity_type,
             override_ratings=override_ratings,
-            scanned=scanned,
         )
+        if scanned is None and len(buf) > SCAN_CHUNK_BYTES:
+            users, items, rows, cols, vals = (
+                native.load_ratings_jsonl_chunked(
+                    buf, chunk_bytes=SCAN_CHUNK_BYTES, **filters
+                )
+            )
+        else:
+            users, items, rows, cols, vals = native.load_ratings_jsonl(
+                buf, scanned=scanned, **filters
+            )
         return base.RatingsBatch(
             entity_ids=users, target_ids=items, rows=rows, cols=cols, vals=vals
         )

@@ -46,6 +46,9 @@ class SQLiteStorageClient:
         self.lock = threading.RLock()
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
+        # counts writes total_changes can't see (DROP TABLE in remove());
+        # part of the events change_token
+        self.ddl_bump = 0
         self._init_meta_tables()
 
     def query(self, sql: str, params: tuple | list = ()) -> list:
@@ -520,19 +523,24 @@ class SQLiteEvents(base.Events):
         t = self._table(app_id, channel_id)
         with self._c.lock, self._c.conn:
             self._c.conn.execute(f"DROP TABLE IF EXISTS {t}")
+            # DROP TABLE bumps neither total_changes nor our own
+            # connection's data_version; the token must still change
+            self._c.ddl_bump += 1
         return True
 
     def change_token(
         self, app_id: int, channel_id: int | None = None
     ) -> object | None:
-        """(data_version, total_changes): ``PRAGMA data_version`` bumps
-        when ANOTHER connection commits, ``total_changes`` counts this
-        connection's writes — together any write to the database changes
-        the pair. Database-wide, so it may over-invalidate across apps
-        (allowed by the contract)."""
+        """(data_version, total_changes, ddl_bump): ``PRAGMA
+        data_version`` bumps when ANOTHER connection commits,
+        ``total_changes`` counts this connection's row writes, and
+        ``ddl_bump`` covers this connection's DROP TABLEs (remove()) —
+        together any write to the database changes the triple.
+        Database-wide, so it may over-invalidate across apps (allowed by
+        the contract)."""
         with self._c.lock:
             dv = self._c.conn.execute("PRAGMA data_version").fetchone()[0]
-            return (dv, self._c.conn.total_changes)
+            return (dv, self._c.conn.total_changes, self._c.ddl_bump)
 
     @staticmethod
     def _tz_offset_seconds(dt: datetime) -> int:

@@ -213,9 +213,20 @@ class ECommAlgorithm(Algorithm):
     # reference's read-per-request behavior.
 
     def _filter_cache(self) -> tuple[dict | None, object]:
-        """(cache dict or None if caching disabled, current token)."""
+        """(cache dict or None if caching disabled, current token).
+
+        Read ONCE per query (predict passes the cache down): on remote
+        backends the token read is a network roundtrip. The (app_id,
+        channel_id) resolution is memoized — it is immutable for the
+        life of a deployed engine."""
         try:
-            token = store.change_token(self.params.app_name)
+            from predictionio_tpu.data.storage import get_storage
+
+            ids = getattr(self, "_app_ids", None)
+            if ids is None:
+                ids = store.app_name_to_id(self.params.app_name)
+                self._app_ids = ids
+            token = get_storage().get_events().change_token(*ids)
         except Exception:
             token = None
         if token is None:
@@ -226,7 +237,7 @@ class ECommAlgorithm(Algorithm):
             self._filters = cache
         return cache, token
 
-    def _seen_items(self, user: str) -> set[str]:
+    def _seen_items(self, user: str, cache: dict | None) -> set[str]:
         """Live read of the user's seen events (reference :234-249),
         cached until the event store changes.
 
@@ -235,7 +246,6 @@ class ECommAlgorithm(Algorithm):
         seen sets of EVERY user in one scan, so 40 distinct users cost
         one replay, not 40. Indexed backends (sqlite, http) keep cheap
         per-user point reads."""
-        cache, _ = self._filter_cache()
         if cache is not None:
             if user in cache["seen"]:
                 return cache["seen"][user]
@@ -286,10 +296,9 @@ class ECommAlgorithm(Algorithm):
             cache["seen"][user] = seen
         return seen
 
-    def _unavailable_items(self) -> set[str]:
+    def _unavailable_items(self, cache: dict | None) -> set[str]:
         """Live read of the latest unavailableItems constraint
         (reference :250-265), cached until the event store changes."""
-        cache, _ = self._filter_cache()
         if cache is not None and cache["unavail"] is not None:
             return cache["unavail"]
         try:
@@ -371,11 +380,12 @@ class ECommAlgorithm(Algorithm):
             for cat in query.categories:
                 in_any[self._category_members(model, cat)] = True
             mask |= ~in_any
-        for iid in self._unavailable_items():
+        cache, _ = self._filter_cache()  # one token read per query
+        for iid in self._unavailable_items(cache):
             if iid in model.item_index:
                 mask[model.item_index[iid]] = True
         if self.params.unseen_only:
-            for iid in self._seen_items(query.user):
+            for iid in self._seen_items(query.user, cache):
                 if iid in model.item_index:
                     mask[model.item_index[iid]] = True
         return mask
@@ -390,9 +400,12 @@ class ECommAlgorithm(Algorithm):
         import json as json_mod
 
         key = json_mod.dumps(self.params.weights, sort_keys=True)
-        cached = getattr(model, "_weighted_V", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        cache = getattr(model, "_weighted_V", None)
+        if cache is None:
+            cache = {}
+            model._weighted_V = cache
+        if key in cache:
+            return cache[key]
         import jax.numpy as jnp
 
         _, V = model.device_factors()
@@ -407,7 +420,7 @@ class ECommAlgorithm(Algorithm):
             weighted = V * jnp.asarray(weights)[:, None]
         else:
             weighted = V
-        model._weighted_V = (key, weighted)
+        cache[key] = weighted
         return weighted
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:

@@ -472,14 +472,17 @@ def load_ratings_jsonl(
         scanned.offs[kept, F_TARGET_ENTITY_ID],
         scanned.lens[kept, F_TARGET_ENTITY_ID],
     )
-    rows = list(rows)
-    cols = list(cols)
-    vals = list(ratings[kept])
+    vals = ratings[kept].astype(np.float32)
 
     # lines the scanner couldn't take (escaped ids etc.) go through the
-    # json parser and merge into the same dense id spaces
+    # json parser and merge into the same dense id spaces. Python-list
+    # conversion happens ONLY on this rare path — at 10^7 rows the lists
+    # would cost gigabytes where the arrays cost megabytes.
     fallback = np.flatnonzero(scanned.flags == FLAG_FALLBACK)
     if len(fallback):
+        rows = list(rows)
+        cols = list(cols)
+        vals = list(vals)
         user_map = {u: i for i, u in enumerate(user_ids)}
         item_map = {it: i for i, it in enumerate(item_ids)}
         lines = data.split(b"\n")
@@ -519,4 +522,85 @@ def load_ratings_jsonl(
         np.asarray(rows, dtype=np.int32),
         np.asarray(cols, dtype=np.int32),
         np.asarray(vals, dtype=np.float32),
+    )
+
+
+# chunk size for bounded-RSS bulk reads (the single definition; the
+# jsonl backend aliases it): span tables cost ~176 bytes/line, so a
+# whole-buffer scan of a multi-GB log rivals the log itself in RSS
+SCAN_CHUNK_BYTES = 256 << 20
+
+
+def _line_aligned_chunks(data: bytes, chunk_bytes: int):
+    """Yield line-aligned slices of ~chunk_bytes (a line longer than the
+    chunk extends its slice to the next newline)."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        end = min(pos + chunk_bytes, n)
+        if end < n:
+            cut = data.rfind(b"\n", pos, end)
+            if cut < pos:
+                nxt = data.find(b"\n", end)
+                end = n if nxt < 0 else nxt + 1
+            else:
+                end = cut + 1
+        yield data[pos:end]
+        pos = end
+
+
+def load_ratings_jsonl_chunked(
+    data: bytes,
+    chunk_bytes: int | None = None,
+    **kwargs,
+) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray]:
+    """:func:`load_ratings_jsonl` over line-aligned chunks, merging the
+    per-chunk dense id spaces — the bounded-RSS bulk training read.
+
+    A single whole-buffer scan materializes [n_lines, 11] int64 span
+    tables (~176 bytes/line: gigabytes at 10^7 events) next to the raw
+    buffer; chunking keeps the span tables at O(chunk) while the merged
+    outputs stay compact numpy arrays. Same merge-by-remap as the
+    partitioned store's per-partition concatenation
+    (data/storage/partitioned.py scan_ratings).
+    """
+    if chunk_bytes is None:
+        chunk_bytes = SCAN_CHUNK_BYTES
+    if len(data) <= chunk_bytes:
+        return load_ratings_jsonl(data, **kwargs)
+    user_map: dict[str, int] = {}
+    item_map: dict[str, int] = {}
+    rows_l, cols_l, vals_l = [], [], []
+    for chunk in _line_aligned_chunks(data, chunk_bytes):
+        users_p, items_p, rows_p, cols_p, vals_p = load_ratings_jsonl(
+            chunk, **kwargs
+        )
+        ulut = np.fromiter(
+            (user_map.setdefault(u, len(user_map)) for u in users_p),
+            np.int32,
+            len(users_p),
+        )
+        ilut = np.fromiter(
+            (item_map.setdefault(t, len(item_map)) for t in items_p),
+            np.int32,
+            len(items_p),
+        )
+        if len(vals_p):
+            rows_l.append(ulut[rows_p])
+            cols_l.append(ilut[cols_p])
+            vals_l.append(vals_p)
+    if not vals_l:
+        return (
+            list(user_map),
+            list(item_map),
+            np.empty(0, np.int32),
+            np.empty(0, np.int32),
+            np.empty(0, np.float32),
+        )
+    return (
+        list(user_map),
+        list(item_map),
+        np.concatenate(rows_l),
+        np.concatenate(cols_l),
+        np.concatenate(vals_l),
     )

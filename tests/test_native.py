@@ -458,3 +458,89 @@ class TestFuzzScannerVsJson:
         assert scanned.flags[0] & native.FLAG_FALLBACK
         (e,) = native.parse_events_jsonl(line)
         assert e.entity_id == "y"  # json.loads semantics
+
+
+class TestChunkedScan:
+    """Bounded-RSS bulk read: chunked load/prove must equal the
+    whole-buffer path (VERDICT r3 item 9 — streaming 20M import/train)."""
+
+    @staticmethod
+    def _log(n=500, dup_at=None):
+        lines = []
+        for i in range(n):
+            eid = f"e{dup_at if dup_at is not None and i == n - 1 else i}"
+            lines.append(
+                '{"event":"rate","entityType":"user","entityId":"u%d",'
+                '"targetEntityType":"item","targetEntityId":"i%d",'
+                '"properties":{"rating":%d.0},'
+                '"eventTime":"2020-01-01T00:00:00.000Z","eventId":"%s"}'
+                % (i % 37, i % 23, i % 5 + 1, eid)
+            )
+        return ("\n".join(lines) + "\n").encode()
+
+    def test_chunked_loader_matches_whole_buffer(self):
+        from predictionio_tpu import native
+
+        buf = self._log(700)
+        whole = native.load_ratings_jsonl(buf, event_names=["rate"])
+        # ~30 chunks
+        chunked = native.load_ratings_jsonl_chunked(
+            buf, chunk_bytes=4096, event_names=["rate"]
+        )
+        wu, wi, wr, wc, wv = whole
+        cu, ci, cr, cc, cv = chunked
+        # id SPACES may be ordered differently; triples must match
+        w = sorted(zip((wu[r] for r in wr), (wi[c] for c in wc), wv))
+        c = sorted(zip((cu[r] for r in cr), (ci[c] for c in cc), cv))
+        assert w == c
+        assert sorted(wu) == sorted(cu) and sorted(wi) == sorted(ci)
+
+    def test_chunked_loader_small_buffer_passthrough(self):
+        from predictionio_tpu import native
+
+        buf = self._log(10)
+        a = native.load_ratings_jsonl_chunked(buf, chunk_bytes=1 << 20)
+        b = native.load_ratings_jsonl(buf)
+        assert a[0] == b[0] and a[1] == b[1]
+        assert np.array_equal(a[2], b[2])
+
+    def test_prove_clean_chunked_matches_whole(self):
+        from predictionio_tpu.data.storage.jsonl import (
+            prove_clean,
+            prove_clean_chunked,
+        )
+
+        clean = self._log(400)
+        assert prove_clean(clean)[0] is False
+        assert prove_clean_chunked(clean, chunk_bytes=2048)[0] is False
+        # cross-chunk duplicate id: last line repeats the first line's id
+        dirty = self._log(400, dup_at=0)
+        assert prove_clean(dirty)[0] is True
+        assert prove_clean_chunked(dirty, chunk_bytes=2048)[0] is True
+        # delete markers flag dirty
+        assert prove_clean_chunked(
+            clean + b'{"$delete": "e1"}\n', chunk_bytes=2048
+        )[0] is True
+
+    def test_jsonl_scan_ratings_chunked_path(self, tmp_path, monkeypatch):
+        """Force the big-buffer path through the real backend and check
+        it equals the normal path."""
+        from predictionio_tpu.data.storage import jsonl as jmod
+        from predictionio_tpu.data.storage.jsonl import (
+            JSONLEvents,
+            JSONLStorageClient,
+        )
+
+        dao = JSONLEvents(JSONLStorageClient({"path": str(tmp_path)}))
+        dao.append_jsonl(self._log(600), 1)
+        normal = dao.scan_ratings(1, event_names=["rate"])
+        monkeypatch.setattr(jmod, "SCAN_CHUNK_BYTES", 4096)
+        dao._c.clean_stat.clear()
+        chunked = dao.scan_ratings(1, event_names=["rate"])
+        def triples(b):
+            return sorted(
+                (u, t, float(v))
+                for (u, t), v in zip(b.iter_pairs(), b.vals)
+            )
+        assert triples(normal) == triples(chunked)
+        assert len(chunked) == 600
