@@ -117,10 +117,20 @@ def cmd_status(args) -> int:
 
 
 def cmd_build(args) -> int:
-    """Python engines need no assembly; verify the factory imports."""
+    """Python engines need no assembly; ``build`` verifies what sbt
+    would have caught: the factory imports AND the variant's component
+    names/params bind to the engine's registered classes — a broken
+    template fails here, not at train."""
     _enter_engine_dir(args)  # idempotent; resolves ./engine.json pickup
     if getattr(args, "engine_factory", None) or getattr(args, "variant", None):
-        _engine_from_args(args)
+        engine, variant, factory = _engine_from_args(args)
+        if variant:
+            try:
+                engine.params_from_variant(variant)
+            except Exception as e:
+                print(f"build failed: variant does not bind to "
+                      f"{factory}: {e}", file=sys.stderr)
+                return 1
         print("Engine factory resolves; build OK.")
     else:
         print("Nothing to build for Python engines; use --engine-factory to verify.")

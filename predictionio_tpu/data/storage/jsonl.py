@@ -118,6 +118,23 @@ def prove_clean_chunked(buf: bytes, chunk_bytes: int | None = None):
     duplicate is never missed. Returns ``(needs_compact, None)``; the
     span scan is not reusable by design (it never exists whole).
     """
+    dirty, _ = _chunked_clean_extract(buf, None, chunk_bytes)
+    return dirty, None
+
+
+def _chunked_clean_extract(
+    buf: bytes,
+    filters: dict | None,
+    chunk_bytes: int | None = None,
+):
+    """One chunked pass proving cleanliness AND (with ``filters``)
+    extracting ratings from the same per-chunk span scans — the
+    single-scan property of the whole-buffer path, at O(chunk) memory.
+
+    Returns ``(dirty, result)``: dirty means a compaction is required
+    and any partial extraction was discarded; result is the
+    ``load_ratings_jsonl``-shaped tuple (or None when ``filters`` is
+    None — prove-only mode, or when dirty)."""
     from predictionio_tpu import native
 
     if chunk_bytes is None:
@@ -128,18 +145,58 @@ def prove_clean_chunked(buf: bytes, chunk_bytes: int | None = None):
         return True, None
     hashes: list = []
     total_ids = 0
+    user_map: dict[str, int] = {}
+    item_map: dict[str, int] = {}
+    rows_l: list = []
+    cols_l: list = []
+    vals_l: list = []
     for chunk in native._line_aligned_chunks(buf, chunk_bytes):
-        dirty, uniq, n_with_id = _clean_scan_check(native.scan_events(chunk))
+        scanned = native.scan_events(chunk)
+        dirty, uniq, n_with_id = _clean_scan_check(scanned)
         if dirty:
             return True, None  # intra-chunk duplicate / unscannable line
         total_ids += n_with_id
         hashes.append(
             np.fromiter((hash(u) for u in uniq), np.int64, len(uniq))
         )
-    if not total_ids:
+        if filters is None:
+            continue
+        users_p, items_p, rows_p, cols_p, vals_p = (
+            native.load_ratings_jsonl(chunk, scanned=scanned, **filters)
+        )
+        ulut = np.fromiter(
+            (user_map.setdefault(u, len(user_map)) for u in users_p),
+            np.int32,
+            len(users_p),
+        )
+        ilut = np.fromiter(
+            (item_map.setdefault(t, len(item_map)) for t in items_p),
+            np.int32,
+            len(items_p),
+        )
+        if len(vals_p):
+            rows_l.append(ulut[rows_p])
+            cols_l.append(ilut[cols_p])
+            vals_l.append(vals_p)
+    if total_ids:
+        all_hashes = np.concatenate(hashes)
+        if len(np.unique(all_hashes)) < total_ids:
+            return True, None  # cross-chunk duplicate (or hash collision)
+    if filters is None:
         return False, None
-    all_hashes = np.concatenate(hashes)
-    return len(np.unique(all_hashes)) < total_ids, None
+    if not vals_l:
+        return False, (
+            list(user_map), list(item_map),
+            np.empty(0, np.int32), np.empty(0, np.int32),
+            np.empty(0, np.float32),
+        )
+    return False, (
+        list(user_map),
+        list(item_map),
+        np.concatenate(rows_l),
+        np.concatenate(cols_l),
+        np.concatenate(vals_l),
+    )
 
 
 class JSONLStorageClient:
@@ -369,30 +426,6 @@ class JSONLEvents(base.Events):
             st = path.stat()
             return (st.st_mtime_ns, st.st_size)
 
-        with self._locked(app_id, channel_id) as path:
-            buf = path.read_bytes() if path.exists() else b""
-            scanned = None
-            # multi-GB logs prove cleanliness and extract in line-aligned
-            # chunks: whole-buffer span tables (~176 B/line) would rival
-            # the 20M-event e2e's entire RSS budget
-            big = len(buf) > SCAN_CHUNK_BYTES
-            if buf and self._c.clean_stat.get(path) == _stat(path):
-                needs_compact = False  # unchanged since last proven clean
-            elif big:
-                needs_compact, scanned = prove_clean_chunked(buf)
-            else:
-                needs_compact, scanned = prove_clean(buf)
-            if needs_compact:
-                # compact inline: the flock is not reentrant, so reuse the
-                # under-lock body rather than calling compact()
-                self._compact_locked(app_id, channel_id, path)
-                buf = path.read_bytes()
-                scanned = None  # buf changed; rescan below
-            if buf:
-                # post-compact (or just-proven-clean) logs stay clean
-                # until the file changes; record the stat so the next
-                # read skips the uniqueness pass / re-compaction
-                self._c.clean_stat[path] = _stat(path)
         filters = dict(
             event_names=list(event_names) if event_names is not None else None,
             rating_key=rating_key,
@@ -401,12 +434,59 @@ class JSONLEvents(base.Events):
             target_entity_type=target_entity_type,
             override_ratings=override_ratings,
         )
-        if scanned is None and len(buf) > SCAN_CHUNK_BYTES:
-            users, items, rows, cols, vals = (
-                native.load_ratings_jsonl_chunked(
+        with self._locked(app_id, channel_id) as path:
+            buf = path.read_bytes() if path.exists() else b""
+            snap_stat = _stat(path) if buf else None
+            # multi-GB logs prove cleanliness and extract in line-aligned
+            # chunks OUTSIDE the lock: whole-buffer span tables
+            # (~176 B/line) would rival the 20M-event e2e's entire RSS
+            # budget. The snapshot is immutable, so proof + extraction
+            # of it are race-free; small logs keep the single-lock flow.
+            big = len(buf) > SCAN_CHUNK_BYTES
+            if big:
+                clean_cached = self._c.clean_stat.get(path) == snap_stat
+            else:
+                scanned = None
+                if buf and self._c.clean_stat.get(path) == snap_stat:
+                    needs_compact = False  # unchanged since proven clean
+                else:
+                    needs_compact, scanned = prove_clean(buf)
+                if needs_compact:
+                    # compact inline: the flock is not reentrant, so
+                    # reuse the under-lock body, not compact()
+                    self._compact_locked(app_id, channel_id, path)
+                    buf = path.read_bytes()
+                    scanned = None  # buf changed; rescan below
+                if buf:
+                    # post-compact (or just-proven-clean) logs stay
+                    # clean until the file changes; record the stat so
+                    # the next read skips the uniqueness pass
+                    self._c.clean_stat[path] = _stat(path)
+        if big:
+            if clean_cached:
+                res = native.load_ratings_jsonl_chunked(
                     buf, chunk_bytes=SCAN_CHUNK_BYTES, **filters
                 )
-            )
+            else:
+                # ONE fused pass: per-chunk clean check + extraction on
+                # the same span scans (the whole-buffer path's
+                # single-scan property)
+                dirty, res = _chunked_clean_extract(
+                    buf, filters, SCAN_CHUNK_BYTES
+                )
+                if dirty:
+                    with self._locked(app_id, channel_id) as path:
+                        self._compact_locked(app_id, channel_id, path)
+                        buf = path.read_bytes()
+                        if buf:
+                            self._c.clean_stat[path] = _stat(path)
+                    # compact output is unique by construction
+                    res = native.load_ratings_jsonl_chunked(
+                        buf, chunk_bytes=SCAN_CHUNK_BYTES, **filters
+                    )
+                else:
+                    self._c.clean_stat[path] = snap_stat
+            users, items, rows, cols, vals = res
         else:
             users, items, rows, cols, vals = native.load_ratings_jsonl(
                 buf, scanned=scanned, **filters

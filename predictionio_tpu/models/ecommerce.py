@@ -208,9 +208,11 @@ class ECommAlgorithm(Algorithm):
     # event store per request), while ANY write to the store changes the
     # token and drops the whole cache, so a just-ingested
     # ``$set unavailableItems`` or view event takes effect on the next
-    # query. Backends that can't produce a token (change_token -> None,
-    # e.g. the http client backend) disable caching and keep the
-    # reference's read-per-request behavior.
+    # query. Every shipped backend produces a token (the http client
+    # proxies it to the storage service, so cross-host writes invalidate
+    # too); a custom Events DAO without a change_token override returns
+    # None, which disables caching and keeps the reference's
+    # read-per-request behavior.
 
     def _filter_cache(self) -> tuple[dict | None, object]:
         """(cache dict or None if caching disabled, current token).

@@ -334,3 +334,40 @@ class TestEngineDir:
         )
         assert len(insts) == 2
         s.close()
+
+
+class TestBuild:
+    def test_build_validates_factory_and_variant(self, cli_env, tmp_path):
+        """`pio build` must fail on a variant whose components don't bind
+        to the engine (the sbt-compile-failure analog), and pass on a
+        valid template dir."""
+        good = tmp_path / "good"
+        good.mkdir()
+        (good / "engine.json").write_text(json.dumps({
+            "id": "default",
+            "engineFactory": "predictionio_tpu.models.recommendation.engine",
+            "datasource": {"params": {"app_name": "X"}},
+            "algorithms": [{"name": "als", "params": {"rank": 4}}],
+        }))
+        out = pio(["build", "--engine-dir", str(good)], cli_env)
+        assert "build OK" in out.stdout
+
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        (bad / "engine.json").write_text(json.dumps({
+            "id": "default",
+            "engineFactory": "predictionio_tpu.models.recommendation.engine",
+            "algorithms": [{"name": "no-such-algo", "params": {}}],
+        }))
+        proc = pio(["build", "--engine-dir", str(bad)], cli_env, check=False)
+        assert proc.returncode == 1
+        assert "does not bind" in proc.stderr
+
+        missing = tmp_path / "missing"
+        missing.mkdir()
+        (missing / "engine.json").write_text(json.dumps({
+            "id": "default",
+            "engineFactory": "nope.does.not.exist",
+        }))
+        proc = pio(["build", "--engine-dir", str(missing)], cli_env, check=False)
+        assert proc.returncode != 0

@@ -153,6 +153,29 @@ class TestRemoteDAOs:
         inst = insts.get(iid)
         assert inst.status == "INIT" and inst.start_time == T0
 
+    def test_change_token_proxies_to_backing_store(self, remote_storage):
+        """Serving caches key on change_token; the http DAO proxies it to
+        the storage service, so cross-host writes invalidate too."""
+        remote, backing, _ = remote_storage
+        ev = remote.get_events()
+        def ev_of(i):
+            return Event(
+                event="rate", entity_type="user", entity_id=f"u{i}",
+                properties={"rating": 3.0},
+            )
+
+        t0 = ev.change_token(1)
+        assert t0 is not None
+        ev.insert(ev_of(1), 1)
+        t1 = ev.change_token(1)
+        assert t1 != t0
+        # a write through ANOTHER client of the same service (the
+        # cross-host case) must also move the token seen here
+        backing.get_events().insert(ev_of(2), 1)
+        assert ev.change_token(1) != t1
+        # filters evaluate server-side: keep per-entity reads point reads
+        assert type(ev).entity_indexed is True
+
     def test_server_side_error_propagates_as_same_class(self, remote_storage):
         remote, _, _ = remote_storage
         events = remote.get_events()
