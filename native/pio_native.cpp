@@ -571,4 +571,76 @@ void pio_extract_number(const char* buf, const int64_t* offs,
   }
 }
 
+// FNV-1a 64 over byte spans: out[i] = hash of buf[offs[i], +lens[i]),
+// or 0 for absent spans (offs[i] < 0). Used by the chunked log
+// cleanliness proof to check id uniqueness without materializing
+// millions of Python strings.
+void pio_hash64_spans(const char* buf, const int64_t* offs,
+                      const int64_t* lens, long n, uint64_t* out) {
+  for (long i = 0; i < n; ++i) {
+    if (offs[i] < 0) {
+      out[i] = 0;
+      continue;
+    }
+    const unsigned char* p = (const unsigned char*)buf + offs[i];
+    uint64_t h = 1469598103934665603ULL;
+    for (long j = 0; j < lens[i]; ++j) {
+      h ^= (uint64_t)p[j];
+      h *= 1099511628211ULL;
+    }
+    out[i] = h;
+  }
+}
+
+// Splice per-line JSON suffixes for the import fast path: for each of
+// n_sel selected line spans [starts[i], ends[i]) of buf (each a closed
+// JSON object per the scanner), emit the line with optional
+// `,"eventId":"<32 hex>"` (want_id[i]; ids holds 32 bytes per wanting
+// line, consumed in order) and/or the fixed ct_tail (want_ct[i])
+// inserted before the closing '}', lines joined by '\n' with a trailing
+// '\n'. Returns bytes written into out (caller sizes it worst-case), or
+// -1 if a line doesn't end in '}' after rstrip (caller falls back).
+long pio_splice_lines(const char* buf, const int64_t* starts,
+                      const int64_t* ends, long n_sel,
+                      const uint8_t* want_id, const uint8_t* want_ct,
+                      const char* ids, const char* ct_tail, long ct_len,
+                      char* out) {
+  static const char kIdPrefix[] = ",\"eventId\":\"";
+  const long kIdPrefixLen = (long)sizeof(kIdPrefix) - 1;
+  char* w = out;
+  long id_i = 0;
+  for (long i = 0; i < n_sel; ++i) {
+    const char* s = buf + starts[i];
+    const char* e = buf + ends[i];
+    while (e > s && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r' ||
+                     e[-1] == '\n'))
+      --e;
+    bool id = want_id[i] != 0;
+    bool ct = want_ct[i] != 0;
+    if (!id && !ct) {
+      memcpy(w, s, (size_t)(e - s));
+      w += e - s;
+    } else {
+      if (e == s || e[-1] != '}') return -1;
+      memcpy(w, s, (size_t)(e - s - 1));
+      w += e - s - 1;
+      if (id) {
+        memcpy(w, kIdPrefix, (size_t)kIdPrefixLen);
+        w += kIdPrefixLen;
+        memcpy(w, ids + 32 * id_i, 32);
+        w += 32;
+        *w++ = '"';
+        ++id_i;
+      }
+      if (ct) {
+        memcpy(w, ct_tail, (size_t)ct_len);
+        w += ct_len;
+      }
+      *w++ = '}';
+    }
+    *w++ = '\n';
+  }
+  return (long)(w - out);
+}
+
 }  // extern "C"

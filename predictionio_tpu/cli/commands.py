@@ -347,6 +347,20 @@ def _splice_import_chunk(chunk: bytes, now_iso: str):
     need_id = offs[ok_ix, native.F_EVENT_ID] < 0
     hexpool = binascii.hexlify(np.random.default_rng().bytes(16 * int(need_id.sum())))
     ct_suffix = (',"creationTime":"%s"' % now_iso).encode()
+    fallback = [
+        chunk[starts[i] : ends[i]]
+        for i in np.flatnonzero(~ok & (sc.flags & native.FLAG_EMPTY == 0))
+    ]
+    # assemble the blob in one native pass (the per-line Python loop was
+    # ~40% of import wall-clock at 2M events); falls back to the loop in
+    # degraded no-native mode
+    need_ct = offs[ok_ix, native.F_CREATION_TIME] < 0
+    blob = native.splice_lines(
+        chunk, starts[ok_ix], ends[ok_ix], need_id, need_ct,
+        bytes(hexpool), ct_suffix,
+    )
+    if blob is not None:
+        return blob, len(ok_ix), fallback
     out: list[bytes] = []
     id_i = 0
     for row, wants_id in zip(ok_ix, need_id):
@@ -359,10 +373,6 @@ def _splice_import_chunk(chunk: bytes, now_iso: str):
         if offs[row, native.F_CREATION_TIME] < 0:
             tail += ct_suffix
         out.append(line[:-1] + tail + b"}" if tail else line)
-    fallback = [
-        chunk[starts[i] : ends[i]]
-        for i in np.flatnonzero(~ok & (sc.flags & native.FLAG_EMPTY == 0))
-    ]
     return b"\n".join(out), len(out), fallback
 
 

@@ -76,11 +76,13 @@ def has_delete_markers(buf: bytes) -> bool:
 
 
 def _clean_scan_check(scanned) -> tuple[bool, list[str], int]:
-    """Shared cleanliness predicate over one span scan: returns (dirty,
-    unique ids, count of lines with a scanned id). Dirty when any id
-    repeats or any line's id wasn't scannable (degraded pure-Python mode
-    flags ALL lines, escaped ids flag a few) — either could hide a
-    replacement. Both prove_clean paths apply exactly this check."""
+    """Cleanliness predicate over one WHOLE-buffer span scan: returns
+    (dirty, unique ids, count of lines with a scanned id). Dirty when
+    any id repeats or any line's id wasn't scannable (degraded
+    pure-Python mode flags ALL lines, escaped ids flag a few) — either
+    could hide a replacement. The chunked path applies the same rule
+    with hash-based uniqueness (see _chunked_clean_extract) so it never
+    materializes per-id strings; keep the two predicates in lockstep."""
     from predictionio_tpu import native
 
     ids = scanned.offs[:, native.F_EVENT_ID]
@@ -145,58 +147,37 @@ def _chunked_clean_extract(
         return True, None
     hashes: list = []
     total_ids = 0
-    user_map: dict[str, int] = {}
-    item_map: dict[str, int] = {}
-    rows_l: list = []
-    cols_l: list = []
-    vals_l: list = []
+    merge = native.DenseMerge()
     for chunk in native._line_aligned_chunks(buf, chunk_bytes):
         scanned = native.scan_events(chunk)
-        dirty, uniq, n_with_id = _clean_scan_check(scanned)
-        if dirty:
-            return True, None  # intra-chunk duplicate / unscannable line
+        # same predicate as _clean_scan_check, but uniqueness runs over
+        # native 64-bit span hashes — no per-id Python strings (millions
+        # per chunk); a collision can only over-flag (harmless compact)
+        ids_off = scanned.offs[:, native.F_EVENT_ID]
+        has_id = ids_off >= 0
+        n_with_id = int(has_id.sum())
+        n_lines = int((scanned.flags & native.FLAG_EMPTY == 0).sum())
+        if n_with_id < n_lines:
+            return True, None  # unscannable / id-less line
+        h = native.hash64_spans(
+            chunk, ids_off, scanned.lens[:, native.F_EVENT_ID]
+        )[has_id]
+        if len(np.unique(h)) < n_with_id:
+            return True, None  # intra-chunk duplicate
         total_ids += n_with_id
-        hashes.append(
-            np.fromiter((hash(u) for u in uniq), np.int64, len(uniq))
-        )
+        hashes.append(h)
         if filters is None:
             continue
-        users_p, items_p, rows_p, cols_p, vals_p = (
-            native.load_ratings_jsonl(chunk, scanned=scanned, **filters)
+        merge.add(
+            *native.load_ratings_jsonl(chunk, scanned=scanned, **filters)
         )
-        ulut = np.fromiter(
-            (user_map.setdefault(u, len(user_map)) for u in users_p),
-            np.int32,
-            len(users_p),
-        )
-        ilut = np.fromiter(
-            (item_map.setdefault(t, len(item_map)) for t in items_p),
-            np.int32,
-            len(items_p),
-        )
-        if len(vals_p):
-            rows_l.append(ulut[rows_p])
-            cols_l.append(ilut[cols_p])
-            vals_l.append(vals_p)
     if total_ids:
         all_hashes = np.concatenate(hashes)
         if len(np.unique(all_hashes)) < total_ids:
             return True, None  # cross-chunk duplicate (or hash collision)
     if filters is None:
         return False, None
-    if not vals_l:
-        return False, (
-            list(user_map), list(item_map),
-            np.empty(0, np.int32), np.empty(0, np.int32),
-            np.empty(0, np.float32),
-        )
-    return False, (
-        list(user_map),
-        list(item_map),
-        np.concatenate(rows_l),
-        np.concatenate(cols_l),
-        np.concatenate(vals_l),
-    )
+    return False, merge.result()
 
 
 class JSONLStorageClient:

@@ -1082,35 +1082,15 @@ class PartitionedEvents(base.Events):
                     pool.map(lambda pp: load_one(pp, n_threads=1), live)
                 )
 
-        user_map: dict[str, int] = {}
-        item_map: dict[str, int] = {}
-        rows_l, cols_l, vals_l = [], [], []
-        for users_p, items_p, rows_p, cols_p, vals_p in results:
-            ulut = np.fromiter(
-                (user_map.setdefault(u, len(user_map)) for u in users_p),
-                np.int32,
-                len(users_p),
-            )
-            ilut = np.fromiter(
-                (item_map.setdefault(t, len(item_map)) for t in items_p),
-                np.int32,
-                len(items_p),
-            )
-            if len(vals_p):
-                rows_l.append(ulut[rows_p])
-                cols_l.append(ilut[cols_p])
-                vals_l.append(vals_p)
-        if not vals_l:
-            return base.RatingsBatch(
-                list(user_map), list(item_map),
-                np.empty(0, np.int32), np.empty(0, np.int32),
-                np.empty(0, np.float32),
-            )
+        merge = native.DenseMerge()
+        for result in results:
+            merge.add(*result)
+        users, items, rows, cols, vals = merge.result()
         return base.RatingsBatch(
-            entity_ids=list(user_map),
-            target_ids=list(item_map),
-            rows=np.concatenate(rows_l),
-            cols=np.concatenate(cols_l),
-            vals=np.concatenate(vals_l),
+            entity_ids=users,
+            target_ids=items,
+            rows=rows,
+            cols=cols,
+            vals=vals,
         )
 

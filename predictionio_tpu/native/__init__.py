@@ -123,6 +123,16 @@ def _load() -> ctypes.CDLL | None:
         lib.pio_route_ids.argtypes = [
             ctypes.c_char_p, i64p, i64p, ctypes.c_long, ctypes.c_int32, i32p,
         ]
+        lib.pio_splice_lines.restype = ctypes.c_long
+        lib.pio_splice_lines.argtypes = [
+            ctypes.c_char_p, i64p, i64p, ctypes.c_long, u8p, u8p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, u8p,
+        ]
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        lib.pio_hash64_spans.restype = None
+        lib.pio_hash64_spans.argtypes = [
+            ctypes.c_char_p, i64p, i64p, ctypes.c_long, u64p,
+        ]
         _lib = lib
         return _lib
 
@@ -295,6 +305,65 @@ def route_ids(
                 buf[offs[i] : offs[i] + lens[i]], n_partitions
             )
     return out
+
+
+def hash64_spans(
+    buf: bytes, offs: np.ndarray, lens: np.ndarray
+) -> np.ndarray:
+    """FNV-1a 64 per span (0 for absent spans). Native when available;
+    the Python fallback hashes the materialized bytes (same 0-for-absent
+    contract, different hash function — callers must only compare hashes
+    produced by the same process)."""
+    n = len(offs)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    out = np.empty(n, dtype=np.uint64)
+    lib = _load()
+    if lib is not None:
+        lib.pio_hash64_spans(buf, offs, lens, n, out)
+        return out
+    for i in range(n):
+        if offs[i] < 0:
+            out[i] = 0
+        else:
+            out[i] = np.uint64(
+                hash(buf[offs[i] : offs[i] + lens[i]]) & 0xFFFFFFFFFFFFFFFF
+            )
+    return out
+
+
+def splice_lines(
+    buf: bytes,
+    starts: np.ndarray,
+    ends: np.ndarray,
+    want_id: np.ndarray,
+    want_ct: np.ndarray,
+    ids: bytes,
+    ct_tail: bytes,
+) -> bytes | None:
+    """Assemble the import splice blob: each selected line span gets
+    ``,"eventId":"<32 hex>"`` (where ``want_id``; 32 bytes per id from
+    ``ids``, in order) and/or ``ct_tail`` inserted before its closing
+    brace — the per-line hot loop of ``pio import`` in one native pass.
+    Returns the newline-joined blob, or None when the native library is
+    unavailable or a line is malformed (caller uses its Python loop)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(starts)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    ends = np.ascontiguousarray(ends, dtype=np.int64)
+    want_id = np.ascontiguousarray(want_id, dtype=np.uint8)
+    want_ct = np.ascontiguousarray(want_ct, dtype=np.uint8)
+    worst = int((ends - starts).sum()) + n * (13 + 34 + len(ct_tail) + 2) + 1
+    out = np.empty(worst, dtype=np.uint8)
+    wrote = lib.pio_splice_lines(
+        buf, starts, ends, n, want_id, want_ct, ids, ct_tail,
+        len(ct_tail), out,
+    )
+    if wrote < 0:
+        return None
+    return out[:wrote].tobytes()
 
 
 def extract_number(
@@ -549,6 +618,59 @@ def _line_aligned_chunks(data: bytes, chunk_bytes: int):
         pos = end
 
 
+class DenseMerge:
+    """Merges per-chunk / per-partition ``(users, items, rows, cols,
+    vals)`` results into ONE dense id space by remapping each piece's
+    local indices — the shared merge of the chunked loader, the jsonl
+    fused clean+extract read, and the partitioned store's per-partition
+    concatenation. Sound whenever the pieces' (user, item) pairs are
+    meant to concatenate (no cross-piece last-write-wins needed)."""
+
+    def __init__(self) -> None:
+        self.user_map: dict[str, int] = {}
+        self.item_map: dict[str, int] = {}
+        self._rows: list = []
+        self._cols: list = []
+        self._vals: list = []
+
+    def add(self, users_p, items_p, rows_p, cols_p, vals_p) -> None:
+        ulut = np.fromiter(
+            (self.user_map.setdefault(u, len(self.user_map))
+             for u in users_p),
+            np.int32,
+            len(users_p),
+        )
+        ilut = np.fromiter(
+            (self.item_map.setdefault(t, len(self.item_map))
+             for t in items_p),
+            np.int32,
+            len(items_p),
+        )
+        if len(vals_p):
+            self._rows.append(ulut[rows_p])
+            self._cols.append(ilut[cols_p])
+            self._vals.append(vals_p)
+
+    def result(
+        self,
+    ) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray]:
+        if not self._vals:
+            return (
+                list(self.user_map),
+                list(self.item_map),
+                np.empty(0, np.int32),
+                np.empty(0, np.int32),
+                np.empty(0, np.float32),
+            )
+        return (
+            list(self.user_map),
+            list(self.item_map),
+            np.concatenate(self._rows),
+            np.concatenate(self._cols),
+            np.concatenate(self._vals),
+        )
+
+
 def load_ratings_jsonl_chunked(
     data: bytes,
     chunk_bytes: int | None = None,
@@ -560,47 +682,13 @@ def load_ratings_jsonl_chunked(
     A single whole-buffer scan materializes [n_lines, 11] int64 span
     tables (~176 bytes/line: gigabytes at 10^7 events) next to the raw
     buffer; chunking keeps the span tables at O(chunk) while the merged
-    outputs stay compact numpy arrays. Same merge-by-remap as the
-    partitioned store's per-partition concatenation
-    (data/storage/partitioned.py scan_ratings).
+    outputs stay compact numpy arrays.
     """
     if chunk_bytes is None:
         chunk_bytes = SCAN_CHUNK_BYTES
     if len(data) <= chunk_bytes:
         return load_ratings_jsonl(data, **kwargs)
-    user_map: dict[str, int] = {}
-    item_map: dict[str, int] = {}
-    rows_l, cols_l, vals_l = [], [], []
+    merge = DenseMerge()
     for chunk in _line_aligned_chunks(data, chunk_bytes):
-        users_p, items_p, rows_p, cols_p, vals_p = load_ratings_jsonl(
-            chunk, **kwargs
-        )
-        ulut = np.fromiter(
-            (user_map.setdefault(u, len(user_map)) for u in users_p),
-            np.int32,
-            len(users_p),
-        )
-        ilut = np.fromiter(
-            (item_map.setdefault(t, len(item_map)) for t in items_p),
-            np.int32,
-            len(items_p),
-        )
-        if len(vals_p):
-            rows_l.append(ulut[rows_p])
-            cols_l.append(ilut[cols_p])
-            vals_l.append(vals_p)
-    if not vals_l:
-        return (
-            list(user_map),
-            list(item_map),
-            np.empty(0, np.int32),
-            np.empty(0, np.int32),
-            np.empty(0, np.float32),
-        )
-    return (
-        list(user_map),
-        list(item_map),
-        np.concatenate(rows_l),
-        np.concatenate(cols_l),
-        np.concatenate(vals_l),
-    )
+        merge.add(*load_ratings_jsonl(chunk, **kwargs))
+    return merge.result()
