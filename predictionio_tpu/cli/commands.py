@@ -225,11 +225,22 @@ def export_events(
     channel: str | None = None,
     storage: Storage | None = None,
 ) -> int:
-    """Dump an app's events as JSON-lines (one event per line)."""
+    """Dump an app's events as JSON-lines (one event per line).
+
+    Backends whose storage format is already the wire format (jsonl,
+    partitioned) stream their replay-clean logs verbatim
+    (``export_jsonl`` — no per-event Python objects, the inverse of the
+    import splice); others serialize through the Event model."""
     from predictionio_tpu.data import store
 
     storage = storage or get_storage()
     app_name = _resolve_app_name(app_name, storage)
+    app_id, channel_id = store.app_name_to_id(app_name, channel, storage)
+    events_dao = storage.get_events()
+    fast = getattr(events_dao, "export_jsonl", None)
+    if fast is not None:
+        with open(output_path, "wb") as f:
+            return fast(app_id, channel_id, f)
     events = store.find(app_name, channel_name=channel, storage=storage)
     with open(output_path, "w") as f:
         for e in events:

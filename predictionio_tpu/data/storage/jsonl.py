@@ -68,6 +68,18 @@ def fold_jsonl_file(
                     deleted.discard(e.event_id)
 
 
+def _maybe_blank_lines(buf: bytes) -> bool:
+    """Cheap conservative probe for empty/whitespace-led lines. Stored
+    records always start with '{', so a whitespace byte at a line start
+    indicates (at worst) a blank line; false positives merely force a
+    harmless compaction. Verbatim exports use this: the clean proof
+    tolerates blank lines (FLAG_EMPTY), but an export's record count
+    and output must not include non-records."""
+    return buf.startswith((b"\n", b"\r", b" ", b"\t")) or any(
+        p in buf for p in (b"\n\n", b"\n\r", b"\n ", b"\n\t")
+    )
+
+
 def has_delete_markers(buf: bytes) -> bool:
     """Delete MARKERS are whole records ``{"$delete": ...}`` — the probe
     anchors at line starts so a property VALUE containing "$delete"
@@ -374,6 +386,41 @@ class JSONLEvents(base.Events):
         """
         with self._locked(app_id, channel_id) as path:
             return self._compact_locked(app_id, channel_id, path)
+
+    def export_jsonl(self, app_id: int, channel_id: int | None, out) -> int:
+        """Export splice-through: the storage format IS the wire format,
+        so a replay-clean log streams to ``out`` verbatim (compacting
+        first when it isn't) — no per-event Python objects, the inverse
+        of ``append_jsonl``. Returns the record count."""
+        def _stat(path: Path) -> tuple[int, int]:
+            st = path.stat()
+            return (st.st_mtime_ns, st.st_size)
+
+        with self._locked(app_id, channel_id) as path:
+            buf = path.read_bytes() if path.exists() else b""
+            if not buf:
+                return 0
+            if self._c.clean_stat.get(path) == _stat(path):
+                needs_compact = False  # already proven clean, unchanged
+            elif len(buf) > SCAN_CHUNK_BYTES:
+                needs_compact, _ = prove_clean_chunked(buf)
+            else:
+                needs_compact, _ = prove_clean(buf)
+            # the clean proof tolerates blank lines; a verbatim export
+            # must not (they'd inflate the record count)
+            if not needs_compact and _maybe_blank_lines(buf):
+                needs_compact = True
+            if needs_compact:
+                self._compact_locked(app_id, channel_id, path)
+                buf = path.read_bytes()
+            if buf:
+                self._c.clean_stat[path] = _stat(path)
+        out.write(buf)
+        n_records = buf.count(b"\n")
+        if buf and not buf.endswith(b"\n"):
+            out.write(b"\n")
+            n_records += 1
+        return n_records
 
     def scan_ratings(
         self,

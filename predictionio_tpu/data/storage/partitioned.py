@@ -963,6 +963,108 @@ class PartitionedEvents(base.Events):
             self._c.clean_stat.pop(ns, None)
         return total
 
+    def export_jsonl(self, app_id: int, channel_id: int | None, out) -> int:
+        """Export splice-through (see jsonl.export_jsonl): each partition
+        streams its segments+active verbatim once proven replay-clean
+        (compacted otherwise — shares scan_ratings' proof, compaction,
+        and clean_stat cache). Partition order is the export order —
+        arbitrary, like the reference's RDD part files. Returns the
+        record count."""
+        ns = self._ns_dir(app_id, channel_id)
+        if not ns.exists():
+            return 0
+        n = self._n_partitions(ns)
+        with self._locked_all(ns, n):
+            pbufs, _ = self._proven_clean_buffers_locked(
+                ns, n, forbid_blank_lines=True
+            )
+        total = 0
+        for buf in pbufs:
+            if buf:
+                out.write(buf)
+                total += buf.count(b"\n")
+        return total
+
+    @staticmethod
+    def _read_partition_locked(pdir: Path) -> tuple[bytes, list]:
+        """Concatenated newline-normalized segment+active bytes plus the
+        per-file stat triples; caller holds the partition lock. The
+        replay-order invariant (segments sorted, active last) lives
+        ONLY here — scan_ratings and export both read through it."""
+        parts: list[bytes] = []
+        stats: list = []
+        files = list(PartitionedEvents._segments(pdir))
+        active = pdir / "active.jsonl"
+        if active.exists():
+            files.append(active)
+        for path in files:
+            b = path.read_bytes()
+            if b and not b.endswith(b"\n"):
+                b += b"\n"
+            st = path.stat()
+            stats.append((str(path), st.st_mtime_ns, st.st_size))
+            parts.append(b)
+        return b"".join(parts), stats
+
+    def _proven_clean_buffers_locked(
+        self, ns: Path, n: int, forbid_blank_lines: bool = False
+    ) -> tuple[list[bytes], list]:
+        """Per-partition buffers proven replay-clean (dirty partitions
+        compacted first), with the proof recorded in the clean_stat
+        cache. Caller holds EVERY partition lock (_locked_all) for the
+        whole prove -> compact -> re-read sequence: a writer cannot slip
+        a duplicate id or delete marker between the compaction and the
+        snapshot the cache trusts — which also makes trusting the
+        post-compact state sound in degraded no-native mode, where
+        uniqueness is unprovable but compaction just restored it by
+        construction.
+
+        ``forbid_blank_lines``: additionally compact partitions whose
+        buffers may contain empty/whitespace lines (the clean proof
+        tolerates them; a verbatim export must not, or its record count
+        and output would include non-records). Returns (pbufs, scans)
+        where scans[pp] is a reusable span scan or None."""
+        from predictionio_tpu import native
+        from predictionio_tpu.data.storage.jsonl import _maybe_blank_lines
+
+        def read_all() -> tuple[list[bytes], tuple]:
+            pbufs: list[bytes] = []
+            stats: list = []
+            for pp in range(n):
+                buf, st = self._read_partition_locked(self._pdir(ns, pp))
+                pbufs.append(buf)
+                stats.extend(st)
+            return pbufs, tuple(stats)
+
+        pbufs, stat_key = read_all()
+        scans: list = [None] * n
+        if not any(pbufs):
+            return pbufs, scans
+        dirty_blanks = forbid_blank_lines and any(
+            _maybe_blank_lines(b) for b in pbufs if b
+        )
+        if self._c.clean_stat.get(ns) != stat_key or dirty_blanks:
+            compacted = False
+            for pp in range(n):
+                if not pbufs[pp]:
+                    continue
+                needs, scans[pp] = (
+                    prove_clean(pbufs[pp])
+                    if native.native_available()
+                    else (True, None)  # unprovable: compact
+                )
+                if forbid_blank_lines and not needs:
+                    needs = _maybe_blank_lines(pbufs[pp])
+                if needs:
+                    self._compact_partition_locked(self._pdir(ns, pp))
+                    compacted = True
+            if compacted:
+                pbufs, stat_key = read_all()
+                scans = [None] * n
+        with self._c.lock:
+            self._c.clean_stat[ns] = stat_key
+        return pbufs, scans
+
     # -- columnar bulk read ------------------------------------------------
 
     def scan_ratings(
@@ -997,57 +1099,10 @@ class PartitionedEvents(base.Events):
             return base.RatingsBatch.empty()
         n = self._n_partitions(ns)
 
-        def read_all_locked() -> tuple[list[bytes], tuple]:
-            """Per-partition concatenated buffers + the store-wide stat
-            key (per-partition so dirt can be localized)."""
-            pbufs: list[bytes] = []
-            stats = []
-            for pp in range(n):
-                pdir = self._pdir(ns, pp)
-                parts: list[bytes] = []
-                files = list(self._segments(pdir))
-                active = pdir / "active.jsonl"
-                if active.exists():
-                    files.append(active)
-                for path in files:
-                    b = path.read_bytes()
-                    if b and not b.endswith(b"\n"):
-                        b += b"\n"
-                    st = path.stat()
-                    stats.append((str(path), st.st_mtime_ns, st.st_size))
-                    parts.append(b)
-                pbufs.append(b"".join(parts))
-            return pbufs, tuple(stats)
-
-        # the whole prove -> compact -> re-read sequence holds every
-        # partition lock: a writer cannot slip a duplicate id or delete
-        # marker between the compaction and the snapshot the cache (and
-        # this scan) trusts — which also makes trusting the post-compact
-        # state sound in degraded no-native mode, where uniqueness is
-        # unprovable but compaction just restored it by construction
         with self._locked_all(ns, n):
-            pbufs, stat_key = read_all_locked()
-            if not any(pbufs):
-                return base.RatingsBatch.empty()
-            scans: list = [None] * n
-            if self._c.clean_stat.get(ns) != stat_key:
-                compacted = False
-                for pp in range(n):
-                    if not pbufs[pp]:
-                        continue
-                    needs, scans[pp] = (
-                        prove_clean(pbufs[pp])
-                        if native.native_available()
-                        else (True, None)  # unprovable: compact
-                    )
-                    if needs:
-                        self._compact_partition_locked(self._pdir(ns, pp))
-                        compacted = True
-                if compacted:
-                    pbufs, stat_key = read_all_locked()
-                    scans = [None] * n
-            with self._c.lock:
-                self._c.clean_stat[ns] = stat_key
+            pbufs, scans = self._proven_clean_buffers_locked(ns, n)
+        if not any(pbufs):
+            return base.RatingsBatch.empty()
         # buffers are immutable snapshots: parse outside the locks
         live = [pp for pp in range(n) if pbufs[pp]]
 

@@ -765,3 +765,115 @@ class TestGroupCommit:
         f.write_bytes(b"x")
         seq2 = c.note_write()
         c.wait_durable(seq2, f)
+
+
+class TestExportSplice:
+    """export_jsonl fast path: stream the replay-clean log verbatim;
+    must be semantically identical to the per-event slow path."""
+
+    def _fill(self, dao, app_id):
+        ids = []
+        for i in range(40):
+            ids.append(dao.insert(_event(i), app_id))
+        # exercise last-write-wins + deletes: export must reflect the
+        # FOLDED state (forces a compact before streaming)
+        dao.insert(
+            Event(
+                event="rate", entity_type="user", entity_id="u0-replaced",
+                properties={"rating": 9.0}, event_id=ids[0],
+                event_time=T0,
+            ),
+            app_id,
+        )
+        dao.delete(ids[1], app_id)
+        return ids
+
+    def _roundtrip(self, dao, app_id, tmp_path, name):
+        from predictionio_tpu.cli import commands
+        from predictionio_tpu.data.storage import App, set_storage, test_storage
+
+        out = tmp_path / f"{name}.jsonl"
+        with open(out, "wb") as f:
+            n = dao.export_jsonl(app_id, None, f)
+        source = {e.event_id: e for e in dao.find(app_id, limit=None)}
+        assert n == len(source)
+        # re-import into a fresh memory store and compare
+        s2 = test_storage()
+        set_storage(s2)
+        try:
+            s2.get_metadata_apps().insert(App(0, "ExpApp"))
+            commands.import_events("ExpApp", str(out), storage=s2)
+            got = {e.event_id: e for e in s2.get_events().find(1, limit=None)}
+        finally:
+            set_storage(None)
+        assert set(got) == set(source)
+        for eid, e in source.items():
+            g = got[eid]
+            assert g.entity_id == e.entity_id
+            assert g.properties.to_dict() == e.properties.to_dict()
+            assert g.event_time == e.event_time
+
+    def test_jsonl_export_roundtrip(self, tmp_path):
+        dao = JSONLEvents(JSONLStorageClient({"path": str(tmp_path / "j")}))
+        self._fill(dao, 1)
+        self._roundtrip(dao, 1, tmp_path, "jsonl")
+
+    def test_partitioned_export_roundtrip(self, tmp_path):
+        from predictionio_tpu.data.storage.partitioned import (
+            PartitionedEvents,
+            PartitionedStorageClient,
+        )
+
+        dao = PartitionedEvents(PartitionedStorageClient(
+            {"path": str(tmp_path / "p"), "partitions": 4,
+             "segment_bytes": 500}
+        ))
+        self._fill(dao, 1)
+        self._roundtrip(dao, 1, tmp_path, "partitioned")
+
+    def test_blank_lines_compacted_out_of_export(self, tmp_path):
+        """A log with blank lines (external edit) still proves clean for
+        scans, but a verbatim export must not count or emit them."""
+        dao = JSONLEvents(JSONLStorageClient({"path": str(tmp_path)}))
+        for i in range(5):
+            dao.insert(_event(i), 1)
+        path = dao._file(1, None)
+        path.write_bytes(path.read_bytes() + b"\n \n")
+        out = tmp_path / "exp.jsonl"
+        with open(out, "wb") as f:
+            n = dao.export_jsonl(1, None, f)
+        assert n == 5
+        lines = out.read_bytes().splitlines()
+        assert len(lines) == 5 and all(ln.startswith(b"{") for ln in lines)
+
+    def test_cli_export_uses_fast_path(self, tmp_path, monkeypatch):
+        from predictionio_tpu.cli import commands
+        from predictionio_tpu.data.storage import (
+            App,
+            Storage,
+            set_storage,
+        )
+
+        s = Storage(env={
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+            "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+            "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "ev"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        app_id = s.get_metadata_apps().insert(App(0, "FastExp"))
+        for i in range(10):
+            s.get_events().insert(_event(i), app_id)
+        # the slow path must NOT run for jsonl-backed storage
+        def boom(*a, **k):
+            raise AssertionError("slow export path used for jsonl backend")
+
+        from predictionio_tpu.data import store as store_mod
+
+        monkeypatch.setattr(store_mod, "find", boom)
+        out = tmp_path / "exp.jsonl"
+        n = commands.export_events("FastExp", str(out), storage=s)
+        assert n == 10
+        assert out.read_bytes().count(b"\n") == 10
