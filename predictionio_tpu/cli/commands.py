@@ -235,10 +235,10 @@ def export_events(
 
     storage = storage or get_storage()
     app_name = _resolve_app_name(app_name, storage)
-    app_id, channel_id = store.app_name_to_id(app_name, channel, storage)
     events_dao = storage.get_events()
     fast = getattr(events_dao, "export_jsonl", None)
     if fast is not None:
+        app_id, channel_id = store.app_name_to_id(app_name, channel, storage)
         with open(output_path, "wb") as f:
             return fast(app_id, channel_id, f)
     events = store.find(app_name, channel_name=channel, storage=storage)

@@ -205,6 +205,9 @@ class JSONLStorageClient:
         # pass — and, in degraded no-native mode, avoid re-compacting —
         # until the file changes
         self.clean_stat: dict[Path, tuple[int, int]] = {}
+        # stricter cache for verbatim exports: proven clean AND free of
+        # blank lines (clean_stat alone tolerates blanks)
+        self.export_clean_stat: dict[Path, tuple[int, int]] = {}
         # per-file fsync group commit (see groupcommit.py): concurrent
         # ingest requests share fsyncs instead of paying one each
         from predictionio_tpu.data.storage.groupcommit import CoalescerMap
@@ -396,13 +399,19 @@ class JSONLEvents(base.Events):
             st = path.stat()
             return (st.st_mtime_ns, st.st_size)
 
+        # snapshot under the lock; prove OUTSIDE it (the proof of an
+        # immutable snapshot needs no lock, and a multi-GB chunked proof
+        # under the client-wide lock would stall every ingest request —
+        # the same pattern as scan_ratings' big path)
         with self._locked(app_id, channel_id) as path:
             buf = path.read_bytes() if path.exists() else b""
             if not buf:
                 return 0
-            if self._c.clean_stat.get(path) == _stat(path):
-                needs_compact = False  # already proven clean, unchanged
-            elif len(buf) > SCAN_CHUNK_BYTES:
+            snap_stat = _stat(path)
+        if self._c.export_clean_stat.get(path) == snap_stat:
+            needs_compact = False  # proven clean AND blank-free, unchanged
+        else:
+            if len(buf) > SCAN_CHUNK_BYTES:
                 needs_compact, _ = prove_clean_chunked(buf)
             else:
                 needs_compact, _ = prove_clean(buf)
@@ -410,11 +419,17 @@ class JSONLEvents(base.Events):
             # must not (they'd inflate the record count)
             if not needs_compact and _maybe_blank_lines(buf):
                 needs_compact = True
-            if needs_compact:
+        if needs_compact:
+            with self._locked(app_id, channel_id) as path:
                 self._compact_locked(app_id, channel_id, path)
                 buf = path.read_bytes()
-            if buf:
-                self._c.clean_stat[path] = _stat(path)
+                if buf:
+                    snap_stat = _stat(path)
+                    self._c.clean_stat[path] = snap_stat
+        if buf:
+            # compact output is clean and blank-free by construction
+            self._c.export_clean_stat[path] = snap_stat
+            self._c.clean_stat[path] = snap_stat
         out.write(buf)
         n_records = buf.count(b"\n")
         if buf and not buf.endswith(b"\n"):
