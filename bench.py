@@ -325,6 +325,75 @@ def _latency_block(url: str, queries: list[dict], warmup: int = 10) -> dict:
     }
 
 
+# Every gated client: connect, signal readiness ('R' on stdout), block
+# on the start gate (stdin), then fire keep-alive requests. The ready
+# byte keeps interpreter/connect startup OUT of the timed window.
+_CLIENT_PREAMBLE = (
+    "import sys,http.client\n"
+    "host,port,path,n,off=(sys.argv[1],int(sys.argv[2]),sys.argv[3],"
+    "int(sys.argv[4]),int(sys.argv[5]))\n"
+    "c=http.client.HTTPConnection(host,port,timeout=30)\n"
+    "c.connect()\n"
+    "sys.stdout.write('R'); sys.stdout.flush()\n"
+    "sys.stdin.readline()\n"
+)
+
+
+def _run_gated_clients(
+    client_body: str, host: str, port: int, path: str,
+    n_procs: int, per_proc: int,
+) -> float:
+    """Spawn stdlib-only (-S: skips the accelerator plugin's boot hook)
+    client subprocesses, wait until each has connected and signalled
+    ready, release them simultaneously, and return the wall seconds from
+    the gate to the last exit."""
+    import subprocess
+    import sys as _sys
+
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, "-S", "-c", _CLIENT_PREAMBLE + client_body,
+             host, str(port), path, str(per_proc), str(w)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+        )
+        for w in range(n_procs)
+    ]
+    for p in procs:
+        if p.stdout.read(1) != b"R":
+            raise RuntimeError("client subprocess failed before ready")
+    t0 = time.perf_counter()
+    for p in procs:
+        p.stdin.write(b"\n")
+        p.stdin.flush()
+    for p in procs:
+        if p.wait() != 0:
+            raise RuntimeError("client subprocess failed")
+    return time.perf_counter() - t0
+
+
+def _concurrent_qps(host: str, port: int, path: str, queries: list[dict],
+                    n_procs: int = 8, per_proc: int = 40) -> dict:
+    """Query throughput under concurrent client PROCESSES (keep-alive,
+    start-gated): the serving-capacity number the per-request latency
+    block can't show."""
+    body = json.dumps(queries[0])
+    client_body = (
+        "body=%r\n"
+        "for j in range(n):\n"
+        "    c.request('POST',path,body=body,"
+        "headers={'Content-Type':'application/json'})\n"
+        "    r=c.getresponse(); r.read()\n"
+        "    assert r.status==200, r.status\n"
+    ) % body
+    dt = _run_gated_clients(client_body, host, port, path, n_procs, per_proc)
+    return {
+        "clients": n_procs,
+        "total_queries": n_procs * per_proc,
+        "qps": round(n_procs * per_proc / dt, 1),
+    }
+
+
 def bench_serving(extras: dict) -> None:
     """POST /queries.json p50/p99 through a real EngineServer: dense
     top-k, RingCatalog sharded serving, and the e-commerce live-filter
@@ -388,6 +457,9 @@ def bench_serving(extras: dict) -> None:
     try:
         extras.setdefault("serving", {})["dense"] = _latency_block(
             f"http://127.0.0.1:{port}/queries.json", queries
+        )
+        extras["serving"]["dense_concurrent"] = _concurrent_qps(
+            "127.0.0.1", port, "/queries.json", queries
         )
     finally:
         server.stop()
@@ -510,16 +582,9 @@ def bench_ingest(extras: dict) -> None:
         # their commits. Client subprocesses keep the measurement off
         # this process's GIL (in-process client threads serialize JSON
         # work against the server and understate the server's capacity).
-        import subprocess
-        import sys as _sys
-
         n_conc, conc_procs, per_proc = 600, 8, 75
-        client_src = (
-            "import json,sys,http.client\n"
-            "host,port,path,n,off=(sys.argv[1],int(sys.argv[2]),sys.argv[3],"
-            "int(sys.argv[4]),int(sys.argv[5]))\n"
-            "sys.stdin.readline()  # start gate: excludes interpreter spawn\n"
-            "c=http.client.HTTPConnection(host,port,timeout=30)\n"
+        ingest_body = (
+            "import json\n"
             "for j in range(n):\n"
             "    p={'event':'rate','entityType':'user',\n"
             "       'entityId':f'cu{off}_{j}','targetEntityType':'item',\n"
@@ -531,26 +596,10 @@ def bench_ingest(extras: dict) -> None:
             "    r=c.getresponse(); r.read()\n"
             "    assert r.status==201, r.status\n"
         )
-        procs = [
-            subprocess.Popen(
-                # -S: stdlib-only client, skips site hooks (the ambient
-                # accelerator plugin boot would cost seconds per client);
-                # persistent connection per client — the SDK shape
-                [_sys.executable, "-S", "-c", client_src,
-                 "127.0.0.1", str(port),
-                 f"/events.json?accessKey={key}", str(per_proc), str(w)],
-                stdin=subprocess.PIPE,
-            )
-            for w in range(conc_procs)
-        ]
-        t0 = time.perf_counter()
-        for p in procs:
-            p.stdin.write(b"\n")
-            p.stdin.flush()
-        for p in procs:
-            if p.wait() != 0:
-                raise RuntimeError("ingest client subprocess failed")
-        conc_s = time.perf_counter() - t0
+        conc_s = _run_gated_clients(
+            ingest_body, "127.0.0.1", port,
+            f"/events.json?accessKey={key}", conc_procs, per_proc,
+        )
         extras["ingest"] = {
             "batch_events_per_s": round(n_batches * 50 / batch_s),
             "batch_workers": workers,

@@ -421,11 +421,15 @@ class JSONLEvents(base.Events):
                 needs_compact = True
         if needs_compact:
             with self._locked(app_id, channel_id) as path:
+                if not path.exists():
+                    # remove() interleaved while we proved outside the
+                    # lock; compacting would resurrect an empty file for
+                    # the deleted app
+                    return 0
                 self._compact_locked(app_id, channel_id, path)
                 buf = path.read_bytes()
                 if buf:
                     snap_stat = _stat(path)
-                    self._c.clean_stat[path] = snap_stat
         if buf:
             # compact output is clean and blank-free by construction
             self._c.export_clean_stat[path] = snap_stat
