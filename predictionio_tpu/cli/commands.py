@@ -240,7 +240,11 @@ def export_events(
     if fast is not None:
         app_id, channel_id = store.app_name_to_id(app_name, channel, storage)
         with open(output_path, "wb") as f:
-            return fast(app_id, channel_id, f)
+            n = fast(app_id, channel_id, f)
+        if n is not None:
+            return n
+        # capability probe said no (http backend whose backing store
+        # can't splice-export): fall through to the per-event path
     events = store.find(app_name, channel_name=channel, storage=storage)
     with open(output_path, "w") as f:
         for e in events:

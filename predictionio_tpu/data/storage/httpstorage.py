@@ -122,6 +122,66 @@ HTTPEvents = _make_dao_class("events", base.Events)
 # filters evaluate server-side: a per-entity read transfers only that
 # entity's events, so serving caches should NOT bulk-scan through this
 HTTPEvents.entity_indexed = True
+
+
+def _http_export_jsonl(self, app_id, channel_id, out):
+    """Splice export over the wire: stream the storage service's
+    /bulk/export response (raw JSONL bytes, record count in a header)
+    into ``out``. Returns None when the service can't splice-export
+    (backing store without the capability, or an older service with no
+    /bulk/export route) — the caller then uses the per-event slow path.
+
+    The stream is close-delimited (no length framing), so the received
+    newline count is validated against the header count — a mid-stream
+    connection drop must fail loudly, not report a truncated file as a
+    successful export."""
+    req = urllib.request.Request(
+        f"{self._client.base_url}/bulk/export",
+        data=json.dumps(
+            {"app_id": app_id, "channel_id": channel_id}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    if self._client.auth_key:
+        req.add_header("x-pio-storage-key", self._client.auth_key)
+    try:
+        with urllib.request.urlopen(
+            req, timeout=self._client.timeout
+        ) as resp:
+            n = int(resp.headers.get("X-Pio-Record-Count", "0"))
+            got = 0
+            while True:
+                chunk = resp.read(8 << 20)
+                if not chunk:
+                    break
+                out.write(chunk)
+                got += chunk.count(b"\n")
+            if got != n:
+                raise HTTPStorageError(
+                    f"bulk export truncated: streamed {got} of {n} records"
+                )
+            return n
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            body = {}
+        if e.code in (403, 404, 405):
+            # no capability (403) or an older service without the route
+            # (404/405): fall back to the per-event path
+            return None
+        raise HTTPStorageError(
+            f"bulk export failed: HTTP {e.code}: "
+            f"{body.get('message', '')}".rstrip(": ")
+        ) from e
+    except urllib.error.URLError as e:
+        raise HTTPStorageError(
+            f"storage service unreachable at {self._client.base_url}: "
+            f"{e.reason}"
+        ) from e
+
+
+HTTPEvents.export_jsonl = _http_export_jsonl
 HTTPModels = _make_dao_class("models", base.Models)
 
 _REPO_TO_CLASS = {

@@ -206,6 +206,31 @@ class HTTPApp:
                 self._send(response)
 
             def _send(self, response: Response):
+                if (
+                    isinstance(response.body, tuple)
+                    and not isinstance(response.body[1], (bytes, bytearray))
+                ):
+                    # streaming body: (content_type, iterator-of-bytes).
+                    # No Content-Length; Connection: close delimits the
+                    # stream (bulk export of multi-GB logs must not
+                    # materialize in server RSS)
+                    content_type, chunks = response.body
+                    self.send_response(response.status)
+                    self.send_header("Content-Type", content_type)
+                    self.send_header("Connection", "close")
+                    for k, v in response.headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    for chunk in chunks:
+                        if chunk:
+                            self.wfile.write(chunk)
+                    self.wfile.flush()
+                    self.close_connection = True
+                    if response.after_send is not None:
+                        threading.Thread(
+                            target=response.after_send, daemon=True
+                        ).start()
+                    return
                 if isinstance(response.body, tuple):
                     content_type, payload = response.body
                 else:

@@ -97,9 +97,9 @@ class StorageServer:
 
         @router.route("POST", "/rpc/<repo>/<method>")
         def rpc(request: Request) -> Response:
-            if self.auth_key is not None:
-                if request.headers.get("x-pio-storage-key") != self.auth_key:
-                    return Response.error("invalid storage key", 401)
+            denied = self._check_auth(request)
+            if denied is not None:
+                return denied
             repo = request.path_params["repo"]
             method = request.path_params["method"]
             if repo not in _REPOS:
@@ -130,7 +130,75 @@ class StorageServer:
                 )
             return Response.json({"result": wire.encode(result)})
 
+        @router.route("POST", "/bulk/export")
+        def bulk_export(request: Request) -> Response:
+            """Stream an app's events as raw JSONL — the splice export
+            over the wire (clients' HTTPEvents.export_jsonl). The
+            backing store exports into a spooled temp file (bounded
+            server RSS; spills to disk past 64MB) which streams out in
+            chunks with the record count in a header."""
+            denied = self._check_auth(request)
+            if denied is not None:
+                return denied
+            payload = request.json() or {}
+            dao = self.storage.get_events()
+            fast = getattr(dao, "export_jsonl", None)
+            if fast is None:
+                return Response.error(
+                    "backend does not implement export_jsonl", 403
+                )
+            import tempfile
+
+            spool = tempfile.SpooledTemporaryFile(max_size=64 << 20)
+            try:
+                n = fast(
+                    int(payload["app_id"]),
+                    (
+                        int(payload["channel_id"])
+                        if payload.get("channel_id") is not None
+                        else None
+                    ),
+                    spool,
+                )
+            except Exception as e:
+                spool.close()
+                logger.exception("bulk export failed")
+                return Response.json(
+                    {"error": type(e).__name__, "message": str(e)}, status=500
+                )
+            if n is None:
+                # chained-remote case: the backing store is itself an
+                # http backend whose service lacks the capability
+                spool.close()
+                return Response.error(
+                    "backend does not implement export_jsonl", 403
+                )
+            spool.seek(0)
+
+            def chunks():
+                try:
+                    while True:
+                        b = spool.read(8 << 20)
+                        if not b:
+                            return
+                        yield b
+                finally:
+                    spool.close()
+
+            return Response(
+                200,
+                ("application/x-ndjson", chunks()),
+                headers={"X-Pio-Record-Count": str(n)},
+            )
+
         return router
+
+    def _check_auth(self, request: Request) -> Response | None:
+        """Shared x-pio-storage-key gate for every route; None = allowed."""
+        if self.auth_key is not None:
+            if request.headers.get("x-pio-storage-key") != self.auth_key:
+                return Response.error("invalid storage key", 401)
+        return None
 
     def start(self, background: bool = True) -> int:
         port = self.app.start(background=background)

@@ -176,6 +176,77 @@ class TestRemoteDAOs:
         # filters evaluate server-side: keep per-entity reads point reads
         assert type(ev).entity_indexed is True
 
+    def test_bulk_export_falls_back_without_backend_support(
+        self, remote_storage, tmp_path
+    ):
+        """A memory-backed storage service has no splice export: the
+        http client's export_jsonl returns None and the CLI export
+        falls back to the per-event path, still producing the file."""
+        from predictionio_tpu.cli import commands
+        from predictionio_tpu.data.storage import App
+
+        remote, backing, _ = remote_storage
+        app_id = remote.get_metadata_apps().insert(App(0, "ExpHttp"))
+        for i in range(6):
+            remote.get_events().insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                      properties={"rating": 1.0}),
+                app_id,
+            )
+        import io
+
+        assert remote.get_events().export_jsonl(app_id, None, io.BytesIO()) is None
+        out = tmp_path / "exp.jsonl"
+        n = commands.export_events("ExpHttp", str(out), storage=remote)
+        assert n == 6 and out.read_bytes().count(b"\n") == 6
+
+    def test_bulk_export_streams_from_jsonl_backing(self, tmp_path):
+        """A jsonl-backed storage service streams the splice export over
+        the wire: raw bytes, record count in the header."""
+        import io
+
+        from predictionio_tpu.data.storage import App, Storage
+
+        backing = Storage(env={
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+            "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+            "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "ev"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        server = StorageServer(storage=backing, host="127.0.0.1", port=0,
+                               auth_key="sekret")
+        port = server.start(background=True)
+        try:
+            remote = Storage(env={
+                "PIO_STORAGE_SOURCES_REMOTE_TYPE": "http",
+                "PIO_STORAGE_SOURCES_REMOTE_URL": f"http://127.0.0.1:{port}",
+                "PIO_STORAGE_SOURCES_REMOTE_AUTH_KEY": "sekret",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "REMOTE",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "REMOTE",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "REMOTE",
+            })
+            app_id = remote.get_metadata_apps().insert(App(0, "StreamExp"))
+            for i in range(25):
+                remote.get_events().insert(
+                    Event(event="rate", entity_type="user",
+                          entity_id=f"u{i}", properties={"rating": 2.0}),
+                    app_id,
+                )
+            buf = io.BytesIO()
+            n = remote.get_events().export_jsonl(app_id, None, buf)
+            assert n == 25
+            lines = buf.getvalue().splitlines()
+            assert len(lines) == 25
+            assert all(ln.startswith(b"{") for ln in lines)
+            # RPC calls still work on the same client after the
+            # Connection: close streaming response
+            assert remote.get_events().change_token(app_id) is not None
+        finally:
+            server.stop()
+
     def test_server_side_error_propagates_as_same_class(self, remote_storage):
         remote, _, _ = remote_storage
         events = remote.get_events()
