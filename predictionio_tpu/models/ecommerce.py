@@ -129,6 +129,10 @@ class ECommAlgorithmParams(Params):
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    # bf16 halves HBM gather / ICI all_gather bytes at parity
+    # (f32 accumulation; ops/als.py ALSParams.storage_dtype)
+    compute_dtype: str = "float32"
+    storage_dtype: str = "float32"
     weights: list[dict] = field(default_factory=list)  # [{items, weight}]
     sharded_train: bool = False  # train over the WorkflowContext mesh
 
@@ -187,6 +191,8 @@ class ECommAlgorithm(Algorithm):
                 implicit=True,
                 alpha=self.params.alpha,
                 seed=self.params.seed,
+                compute_dtype=self.params.compute_dtype,
+                storage_dtype=self.params.storage_dtype,
             ),
             ctx,
             sharded=self.params.sharded_train,

@@ -129,6 +129,10 @@ class ALSAlgorithmParams(Params):
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    # bf16 halves HBM gather / ICI all_gather bytes at parity
+    # (f32 accumulation; ops/als.py ALSParams.storage_dtype)
+    compute_dtype: str = "float32"
+    storage_dtype: str = "float32"
     sharded_train: bool = False  # train over the WorkflowContext mesh
 
 
@@ -225,6 +229,8 @@ class ALSAlgorithm(Algorithm):
             implicit=True,
             alpha=self.params.alpha,
             seed=self.params.seed,
+            compute_dtype=self.params.compute_dtype,
+            storage_dtype=self.params.storage_dtype,
         )
         from predictionio_tpu.parallel.als_sharded import train_for_context
 

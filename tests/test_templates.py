@@ -176,6 +176,24 @@ class TestSimilarProduct:
         parities = [int(s.item[1:]) % 2 for s in result.itemScores]
         assert parities.count(0) >= 2  # mostly even items similar to i0
 
+    def test_bf16_storage_through_template(self, seeded):
+        """storage_dtype plumbs through the template's implicit-ALS
+        train and serves coherent similarities."""
+        from predictionio_tpu.models import similarproduct as sim
+
+        algo = sim.ALSAlgorithm(sim.ALSAlgorithmParams(
+            rank=6, num_iterations=8, alpha=2.0,
+            compute_dtype="bfloat16", storage_dtype="bfloat16",
+        ))
+        td = sim.SimilarProductDataSource(
+            sim.DataSourceParams(app_name="SimApp")
+        ).read_training(CTX)
+        model = algo.train(CTX, td)
+        result = algo.predict(model, sim.Query(items=["i0"], num=3))
+        assert len(result.itemScores) == 3
+        parities = [int(s.item[1:]) % 2 for s in result.itemScores]
+        assert parities.count(0) >= 2  # same-parity structure preserved
+
     def test_category_and_blacklist_filters(self, seeded):
         from predictionio_tpu.models import similarproduct as sim
 
