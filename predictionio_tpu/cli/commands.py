@@ -434,14 +434,20 @@ def import_events(
             count += len(batch)
 
     def _flush(data: bytes) -> None:
-        nonlocal count
+        nonlocal count, splice
         if splice is None:
             _flush_slow(data)
             return
         blob, n_spliced, fallback = _splice_import_chunk(data, now_iso)
         if blob:
-            splice(blob, app_id, channel_id)
-            count += n_spliced
+            try:
+                splice(blob, app_id, channel_id)
+                count += n_spliced
+            except NotImplementedError:
+                # http backend whose storage service can't splice:
+                # degrade to per-event inserts for the rest of the run
+                splice = None
+                _flush_slow(blob)
         if fallback:
             _flush_slow(fallback)
 

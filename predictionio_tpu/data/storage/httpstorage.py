@@ -124,6 +124,43 @@ HTTPEvents = _make_dao_class("events", base.Events)
 HTTPEvents.entity_indexed = True
 
 
+class _BulkUnsupported(Exception):
+    """The storage service (or its backing store) can't splice: 403
+    capability miss, or 404/405 from an older service without the
+    route. Callers degrade to the per-event path."""
+
+
+def _open_bulk(client: HTTPStorageClient, path_and_query: str, data: bytes):
+    """POST to a /bulk/* route with shared auth and error mapping:
+    403/404/405 -> _BulkUnsupported, other HTTP errors -> the mapped
+    exception class with the server's message, unreachable ->
+    HTTPStorageError. Returns the open response (caller closes)."""
+    req = urllib.request.Request(
+        f"{client.base_url}{path_and_query}",
+        data=data,
+        headers={"Content-Type": "application/x-ndjson"},
+    )
+    if client.auth_key:
+        req.add_header("x-pio-storage-key", client.auth_key)
+    try:
+        return urllib.request.urlopen(req, timeout=client.timeout)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            body = {}
+        if e.code in (403, 404, 405):
+            raise _BulkUnsupported() from None
+        exc_cls = _ERROR_TYPES.get(body.get("error", ""), HTTPStorageError)
+        raise exc_cls(
+            body.get("message", f"bulk request failed: HTTP {e.code}")
+        ) from None
+    except urllib.error.URLError as e:
+        raise HTTPStorageError(
+            f"storage service unreachable at {client.base_url}: {e.reason}"
+        ) from e
+
+
 def _http_export_jsonl(self, app_id, channel_id, out):
     """Splice export over the wire: stream the storage service's
     /bulk/export response (raw JSONL bytes, record count in a header)
@@ -135,53 +172,54 @@ def _http_export_jsonl(self, app_id, channel_id, out):
     newline count is validated against the header count — a mid-stream
     connection drop must fail loudly, not report a truncated file as a
     successful export."""
-    req = urllib.request.Request(
-        f"{self._client.base_url}/bulk/export",
-        data=json.dumps(
-            {"app_id": app_id, "channel_id": channel_id}
-        ).encode(),
-        headers={"Content-Type": "application/json"},
-    )
-    if self._client.auth_key:
-        req.add_header("x-pio-storage-key", self._client.auth_key)
     try:
-        with urllib.request.urlopen(
-            req, timeout=self._client.timeout
-        ) as resp:
-            n = int(resp.headers.get("X-Pio-Record-Count", "0"))
-            got = 0
-            while True:
-                chunk = resp.read(8 << 20)
-                if not chunk:
-                    break
-                out.write(chunk)
-                got += chunk.count(b"\n")
-            if got != n:
-                raise HTTPStorageError(
-                    f"bulk export truncated: streamed {got} of {n} records"
-                )
-            return n
-    except urllib.error.HTTPError as e:
-        try:
-            body = json.loads(e.read())
-        except Exception:
-            body = {}
-        if e.code in (403, 404, 405):
-            # no capability (403) or an older service without the route
-            # (404/405): fall back to the per-event path
-            return None
-        raise HTTPStorageError(
-            f"bulk export failed: HTTP {e.code}: "
-            f"{body.get('message', '')}".rstrip(": ")
-        ) from e
-    except urllib.error.URLError as e:
-        raise HTTPStorageError(
-            f"storage service unreachable at {self._client.base_url}: "
-            f"{e.reason}"
-        ) from e
+        resp = _open_bulk(
+            self._client,
+            "/bulk/export",
+            json.dumps({"app_id": app_id, "channel_id": channel_id}).encode(),
+        )
+    except _BulkUnsupported:
+        return None  # caller uses the per-event slow path
+    with resp:
+        n = int(resp.headers.get("X-Pio-Record-Count", "0"))
+        got = 0
+        while True:
+            chunk = resp.read(8 << 20)
+            if not chunk:
+                break
+            out.write(chunk)
+            got += chunk.count(b"\n")
+        if got != n:
+            raise HTTPStorageError(
+                f"bulk export truncated: streamed {got} of {n} records"
+            )
+        return n
 
 
 HTTPEvents.export_jsonl = _http_export_jsonl
+
+
+def _http_append_jsonl(self, blob, app_id, channel_id=None):
+    """Splice import over the wire: POST the raw JSONL blob to the
+    storage service's /bulk/import (no per-event wire encoding). Raises
+    NotImplementedError when the service can't splice (backing store
+    without append_jsonl, older service without the route, or degraded
+    no-native validation) — the import path then falls back to
+    per-event RPC inserts."""
+    qs = f"app_id={app_id}"
+    if channel_id is not None:
+        qs += f"&channel_id={channel_id}"
+    try:
+        resp = _open_bulk(self._client, f"/bulk/import?{qs}", bytes(blob))
+    except _BulkUnsupported:
+        raise NotImplementedError(
+            "storage service has no splice import"
+        ) from None
+    with resp:
+        resp.read()
+
+
+HTTPEvents.append_jsonl = _http_append_jsonl
 HTTPModels = _make_dao_class("models", base.Models)
 
 _REPO_TO_CLASS = {

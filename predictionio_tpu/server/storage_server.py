@@ -130,6 +130,85 @@ class StorageServer:
                 )
             return Response.json({"result": wire.encode(result)})
 
+        @router.route("POST", "/bulk/import")
+        def bulk_import(request: Request) -> Response:
+            """Raw JSONL splice import over the wire: the request body IS
+            the line blob (no per-event wire encoding, no base64
+            inflation), appended through the backing store's
+            append_jsonl. The clients' HTTPEvents.append_jsonl — `pio
+            import` against an http storage source lands here."""
+            denied = self._check_auth(request)
+            if denied is not None:
+                return denied
+            dao = self.storage.get_events()
+            splice = getattr(dao, "append_jsonl", None)
+            if splice is None:
+                return Response.error(
+                    "backend does not implement append_jsonl", 403
+                )
+            try:
+                app_id = int(request.query["app_id"])
+            except (KeyError, ValueError):
+                return Response.error(
+                    "app_id query param required (integer)", 400
+                )
+            try:
+                channel_id = (
+                    int(request.query["channel_id"])
+                    if request.query.get("channel_id")
+                    else None
+                )
+            except ValueError:
+                return Response.error(
+                    "channel_id query param must be an integer", 400
+                )
+            blob = request.body
+            declared = request.headers.get("content-length")
+            if declared is not None and int(declared) != len(blob):
+                # a dropped client connection yields a short read; an
+                # appended truncated line would corrupt the log for
+                # every later replay
+                return Response.error("truncated request body", 400)
+            if not blob:
+                return Response.error("empty body", 400)
+            # the server is the trust boundary for append_jsonl's
+            # contract (scanner-clean lines, eventId on every line):
+            # clients validate, but a corrupt blob committed verbatim
+            # would poison the app's whole log
+            from predictionio_tpu import native
+
+            if not native.native_available():
+                # degraded mode can't validate spans; per-event RPC
+                # (which fully parses) is the safe path
+                return Response.error(
+                    "splice import unavailable without the native codec",
+                    403,
+                )
+            probe = blob if blob.endswith(b"\n") else blob + b"\n"
+            sc = native.scan_events(probe)
+            nonempty = (sc.flags & native.FLAG_EMPTY) == 0
+            if (
+                (((sc.flags & native.FLAG_FALLBACK) != 0) & nonempty).any()
+                or ((sc.offs[:, native.F_EVENT_ID] < 0) & nonempty).any()
+            ):
+                return Response.error(
+                    "body must be scanner-clean JSONL with an eventId "
+                    "on every line",
+                    400,
+                )
+            try:
+                splice(blob, app_id, channel_id)
+            except (EventValidationError, ValueError, KeyError, TypeError) as e:
+                return Response.json(
+                    {"error": type(e).__name__, "message": str(e)}, status=400
+                )
+            except Exception as e:
+                logger.exception("bulk import failed")
+                return Response.json(
+                    {"error": type(e).__name__, "message": str(e)}, status=500
+                )
+            return Response.json({"ok": True})
+
         @router.route("POST", "/bulk/export")
         def bulk_export(request: Request) -> Response:
             """Stream an app's events as raw JSONL — the splice export

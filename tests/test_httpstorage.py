@@ -247,6 +247,74 @@ class TestRemoteDAOs:
         finally:
             server.stop()
 
+    def test_bulk_import_splices_and_falls_back(self, remote_storage, tmp_path):
+        """pio import against an http source: raw /bulk/import when the
+        backing store can splice; per-event RPC otherwise (memory
+        backing here -> NotImplementedError -> fallback), same result."""
+        from predictionio_tpu.cli import commands
+        from predictionio_tpu.data.storage import App
+
+        remote, backing, _ = remote_storage
+        app_id = remote.get_metadata_apps().insert(App(0, "ImpHttp"))
+        src = tmp_path / "in.jsonl"
+        src.write_text("\n".join(
+            '{"event":"rate","entityType":"user","entityId":"u%d",'
+            '"properties":{"rating":1.0},'
+            '"eventTime":"2020-01-01T00:00:00.000Z"}' % i
+            for i in range(40)
+        ) + "\n")
+        n = commands.import_events("ImpHttp", str(src), storage=remote)
+        assert n == 40
+        assert len(backing.get_events().find(app_id, limit=None)) == 40
+
+    def test_bulk_import_fast_route_with_jsonl_backing(self, tmp_path):
+        from predictionio_tpu.cli import commands
+        from predictionio_tpu.data.storage import App, Storage
+
+        backing = Storage(env={
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+            "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+            "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "ev"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        server = StorageServer(storage=backing, host="127.0.0.1", port=0,
+                               auth_key="sekret")
+        port = server.start(background=True)
+        try:
+            remote = Storage(env={
+                "PIO_STORAGE_SOURCES_REMOTE_TYPE": "http",
+                "PIO_STORAGE_SOURCES_REMOTE_URL": f"http://127.0.0.1:{port}",
+                "PIO_STORAGE_SOURCES_REMOTE_AUTH_KEY": "sekret",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "REMOTE",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "REMOTE",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "REMOTE",
+            })
+            app_id = remote.get_metadata_apps().insert(App(0, "FastImp"))
+            src = tmp_path / "in.jsonl"
+            src.write_text("\n".join(
+                '{"event":"rate","entityType":"user","entityId":"u%d",'
+                '"targetEntityType":"item","targetEntityId":"i%d",'
+                '"properties":{"rating":%d.0},'
+                '"eventTime":"2020-01-01T00:00:00.000Z"}' % (i, i % 7, i % 5 + 1)
+                for i in range(60)
+            ) + "\n")
+            n = commands.import_events("FastImp", str(src), storage=remote)
+            assert n == 60
+            # splice landed in the backing jsonl log (one file, 60 lines)
+            logs = list((tmp_path / "ev").glob("events_*.jsonl"))
+            assert len(logs) == 1
+            assert logs[0].read_bytes().count(b"\n") == 60
+            # and the remote scan sees the dense arrays
+            batch = remote.get_events().scan_ratings(
+                app_id, event_names=["rate"]
+            )
+            assert len(batch) == 60
+        finally:
+            server.stop()
+
     def test_server_side_error_propagates_as_same_class(self, remote_storage):
         remote, _, _ = remote_storage
         events = remote.get_events()
@@ -551,3 +619,58 @@ class TestRemotePartitioned:
             remote.close()
             server.stop()
             backing.close()
+
+
+class TestBulkImportValidation:
+    """The storage service is the trust boundary for splice imports."""
+
+    def _remote(self, tmp_path):
+        from predictionio_tpu.data.storage import Storage
+
+        backing = Storage(env={
+            "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+            "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+            "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "ev"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        })
+        server = StorageServer(storage=backing, host="127.0.0.1", port=0)
+        port = server.start(background=True)
+        return backing, server, port
+
+    def _post(self, port, qs, body):
+        import urllib.error
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/bulk/import?{qs}", data=body
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+
+    def test_rejects_truncated_and_malformed_blobs(self, tmp_path):
+        backing, server, port = self._remote(tmp_path)
+        try:
+            good = (
+                b'{"event":"rate","entityType":"user","entityId":"u1",'
+                b'"properties":{"rating":1.0},'
+                b'"eventTime":"2020-01-01T00:00:00.000Z","eventId":"e1"}\n'
+            )
+            assert self._post(port, "app_id=1", good) == 200
+            # truncated mid-line JSON must be rejected, not appended
+            assert self._post(port, "app_id=1", good[:-30]) == 400
+            # missing eventId must be rejected (replay keys on it)
+            no_id = good.replace(b',"eventId":"e1"', b"")
+            assert self._post(port, "app_id=1", no_id) == 400
+            # bad params get precise errors
+            assert self._post(port, "app_id=nope", good) == 400
+            assert self._post(port, "app_id=1&channel_id=zz", good) == 400
+            # the log still replays cleanly after the rejects
+            assert len(backing.get_events().find(1, limit=None)) == 1
+        finally:
+            server.stop()
