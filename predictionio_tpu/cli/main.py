@@ -346,6 +346,7 @@ def cmd_deploy(args) -> int:
         server_config=_load_server_config(args),
         log_url=args.log_url,
         log_prefix=args.log_prefix,
+        batch_window_ms=args.batch_window_ms,
     )
     # foreground, like the reference: backgrounding is the caller's job
     # (shell &, supervisor); a daemon thread would die with this process
@@ -670,6 +671,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     d.add_argument(
         "--log-prefix", help="prefix prepended to remote log payloads"
+    )
+    d.add_argument(
+        "--batch-window-ms", type=float, default=0.0,
+        help="micro-batch concurrent queries for up to this many ms into "
+        "one batched device call (0 = per-request serving); amortizes "
+        "per-call dispatch on TPU attachments",
     )
     d.set_defaults(fn=cmd_deploy)
 

@@ -382,7 +382,11 @@ class ALSAlgorithm(Algorithm):
             uixs = np.asarray(
                 [model.user_index[q.user] for _, q in known], dtype=np.int32
             )
+            # power-of-two k: the jitted batch top-k specializes on k,
+            # and micro-batched serving would otherwise recompile per
+            # distinct max(num) in a batch (results slice to q.num)
             k = max(int(q.num) for _, q in known)
+            k = 1 << max(0, k - 1).bit_length()
             if self.params.sharded_serving:
                 scores, ids = model.ring_catalog().top_k(
                     model.user_factors[uixs], k
