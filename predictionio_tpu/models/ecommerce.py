@@ -135,6 +135,10 @@ class ECommAlgorithmParams(Params):
     storage_dtype: str = "float32"
     weights: list[dict] = field(default_factory=list)  # [{items, weight}]
     sharded_train: bool = False  # train over the WorkflowContext mesh
+    # per-chip budget for the sharded trainer's gathered opposite
+    # factors; past it training auto-switches to the ppermute ring
+    # half-step (parallel/als_sharded.py). None = library default (8 GiB)
+    sharded_gather_budget_bytes: int | None = None
 
 
 @dataclass
@@ -193,6 +197,9 @@ class ECommAlgorithm(Algorithm):
                 seed=self.params.seed,
                 compute_dtype=self.params.compute_dtype,
                 storage_dtype=self.params.storage_dtype,
+                **als_ops.sharded_budget_kwarg(
+                    self.params.sharded_gather_budget_bytes
+                ),
             ),
             ctx,
             sharded=self.params.sharded_train,

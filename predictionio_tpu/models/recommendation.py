@@ -189,6 +189,11 @@ class ALSAlgorithmParams(Params):
     # degree-bucket widths for the padded ALS layout (ops/als.py); rows
     # hotter than the largest width segment exactly across table rows
     bucket_widths: tuple[int, ...] = als_ops.DEFAULT_BUCKETS
+    # per-chip budget for the sharded trainer's gathered opposite factors;
+    # catalogs past it auto-switch to the ppermute ring half-step whose
+    # working set shrinks with mesh size (parallel/als_sharded.py
+    # "Memory model"). None = library default (8 GiB)
+    sharded_gather_budget_bytes: int | None = None
 
 
 @dataclass
@@ -260,6 +265,7 @@ class ALSAlgorithm(Algorithm):
             seed=self.params.seed,
             compute_dtype=self.params.compute_dtype,
             storage_dtype=self.params.storage_dtype,
+            **als_ops.sharded_budget_kwarg(self.params.sharded_gather_budget_bytes),
         )
         from predictionio_tpu.parallel.als_sharded import train_for_context
 

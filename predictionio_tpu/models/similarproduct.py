@@ -134,6 +134,10 @@ class ALSAlgorithmParams(Params):
     compute_dtype: str = "float32"
     storage_dtype: str = "float32"
     sharded_train: bool = False  # train over the WorkflowContext mesh
+    # per-chip budget for the sharded trainer's gathered opposite
+    # factors; past it training auto-switches to the ppermute ring
+    # half-step (parallel/als_sharded.py). None = library default (8 GiB)
+    sharded_gather_budget_bytes: int | None = None
 
 
 @dataclass
@@ -231,6 +235,9 @@ class ALSAlgorithm(Algorithm):
             seed=self.params.seed,
             compute_dtype=self.params.compute_dtype,
             storage_dtype=self.params.storage_dtype,
+            **als_ops.sharded_budget_kwarg(
+                self.params.sharded_gather_budget_bytes
+            ),
         )
         from predictionio_tpu.parallel.als_sharded import train_for_context
 

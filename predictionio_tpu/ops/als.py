@@ -97,10 +97,13 @@ def build_padded_buckets(
 
     Rows whose degree exceeds the largest width are **segmented** across
     multiple table rows of the largest bucket (exact training; the solver
-    scatter-adds segment Gramians). With ``segment=False`` they instead
-    keep their ``width`` highest-|rating| entries (truncation — required
-    by the mesh-sharded trainer, whose scatter cannot combine segments
-    across devices). Buckets are ordered by width, rows by id.
+    scatter-adds segment Gramians). Every production path — single-chip
+    ``als_train`` AND the mesh-sharded trainer, which colocates all of a
+    row's segments on one shard (parallel/als_sharded.py shard_bucket) —
+    trains segmented rows exactly. ``segment=False`` is an opt-in lossy
+    cap: such rows instead keep their ``width`` highest-|rating| entries
+    (bounds the table size when blockbuster rows may be approximated).
+    Buckets are ordered by width, rows by id.
     """
     if len(rows) == 0:
         return []
@@ -413,6 +416,21 @@ class ALSParams:
     # program is untouched) while rank-64/128 buckets (2.6-11.2 GiB
     # unchunked, which OOM a 16-GiB v5e) get chunked.
     gather_chunk_bytes: int = 2 << 30
+    # Per-chip budget for the mesh-sharded trainer's gathered opposite
+    # factor matrix (parallel/als_sharded.py). When the all_gather of one
+    # side would exceed it, the trainer auto-selects the ppermute RING
+    # half-step (opposite-factor slabs rotate around the mesh; per-chip
+    # memory then SHRINKS with mesh size) instead of the latency-optimal
+    # full all_gather. 8 GiB = half of a 16-GiB v5e: every catalog the
+    # all_gather design ceiling admits stays on the fused-gather path.
+    sharded_gather_budget_bytes: int = 8 << 30
+
+
+def sharded_budget_kwarg(value: int | None) -> dict:
+    """ALSParams kwargs fragment used by the templates: include
+    ``sharded_gather_budget_bytes`` only when the engine params override
+    it (None keeps the library default above)."""
+    return {} if value is None else {"sharded_gather_budget_bytes": int(value)}
 
 
 def init_factors(num: int, rank: int, key, scale: float | None = None):
@@ -478,25 +496,47 @@ def _solve_bucket_inline(
     col_ids, ratings, mask = bucket_arrays
     reg = params.reg if reg is None else reg
     alpha = params.alpha if alpha is None else alpha
-    D = factors_other.shape[1]
     dt = jnp.dtype(params.compute_dtype)
-    if params.implicit:
-        w = (alpha * ratings * mask).astype(dt)
-        r = ((1.0 + alpha * ratings) * mask).astype(dt)
-        weighted = params.implicit_weighted_reg
-    else:
-        w = mask.astype(dt)
-        r = (ratings * mask).astype(dt)
-        weighted = params.weighted_reg
+    w, r = _bucket_weights(ratings, mask, params, alpha)
     A, b = _gramian_rhs_gathered(
         factors_other, col_ids, w, r, dt, params.gather_chunk_bytes
     )
     n = mask.sum(axis=1)
+    return _finish_bucket_solve(
+        A, b, n, gram, params, seg_row, num_solved_rows, reg
+    )
+
+
+def _bucket_weights(ratings, mask, params: ALSParams, alpha):
+    """Per-entry Gramian weight ``w`` and rhs weight ``r`` for one bucket
+    (explicit: w=mask, r=rating; implicit: Hu-Koren-Volinsky confidence).
+    Shared by the gather path and the ring trainer, which further masks
+    these by slab ownership per rotation."""
+    dt = jnp.dtype(params.compute_dtype)
+    if params.implicit:
+        w = (alpha * ratings * mask).astype(dt)
+        r = ((1.0 + alpha * ratings) * mask).astype(dt)
+    else:
+        w = mask.astype(dt)
+        r = (ratings * mask).astype(dt)
+    return w, r
+
+
+def _finish_bucket_solve(
+    A, b, n, gram, params: ALSParams, seg_row, num_solved_rows, reg
+):
+    """Tail of a bucket solve given accumulated normal equations:
+    scatter-add row segments, regularize, add the implicit Gramian, and
+    batched-Cholesky solve. Shared by `_solve_bucket_inline` (which
+    accumulates (A, b) in one gather) and the ring sharded trainer
+    (which accumulates them over ppermute rotations)."""
+    D = b.shape[1]
     if seg_row is not None:
         R = num_solved_rows
         A = jnp.zeros((R, D, D), A.dtype).at[seg_row].add(A)
         b = jnp.zeros((R, D), b.dtype).at[seg_row].add(b)
         n = jnp.zeros((R,), n.dtype).at[seg_row].add(n)
+    weighted = params.implicit_weighted_reg if params.implicit else params.weighted_reg
     lam = reg * (n if weighted else jnp.ones_like(n))
     lam = jnp.where(n > 0, lam, 1.0)
     A = A + lam[:, None, None] * jnp.eye(D, dtype=jnp.float32)

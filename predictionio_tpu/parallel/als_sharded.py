@@ -28,27 +28,46 @@ same math as single-chip, with no truncation of blockbuster rows.
 half-iteration is one ``shard_map`` region per bucket set. No per-bucket
 Python dispatch, no host round-trips of the factors.
 
-**Memory model (the all_gather working set).** Per chip, each
+**Memory model — two half-step variants, auto-selected.** Per chip, each
 half-iteration holds: (a) its shard of both factor matrices —
-``(rows + cols) / n_shards * D * 4`` bytes, shrinking with mesh size;
-(b) its shard of the bucket tables (col_ids/ratings/mask ~= 12 bytes per
-rating / n_shards), shrinking with mesh size; and (c) the ``all_gather``
-of the FULL opposite factor matrix (``_train_fused_sharded.shard_fn``) —
-``opposite_rows * D * 4`` bytes, which does NOT shrink with mesh size.
-Per-bucket ``[B, K, D]`` factor-gather temps are additionally bounded by
-``ALSParams.gather_chunk_bytes`` (the solves run through the same
-``_solve_bucket_inline`` as single-chip, so wide buckets at high rank
-chunk identically here — see ops/als.py).
-(c) is the design ceiling: on 16-GiB v5e chips the gathered side caps at
-roughly 10^8 rows at rank 20 or 1.6*10^7 at rank 128 (at half of HBM).
-MovieLens-20M (2.7*10^4 items, rank 20 -> 2 MiB gathered) and any
-catalog up to ~10^7 entities are far below it; the gather is one fused
-ICI collective and is the latency-optimal choice there (ALX makes the
-same trade, PAPERS.md). Past that ceiling the half-step must switch to a
-blocked gather / ppermute ring over opposite-factor slabs (the
-ring-top-k pattern in parallel/ring_topk.py applied to training) —
-deliberately NOT implemented until a workload needs it; this docstring
-is the recorded decision.
+``(rows + cols) / n_shards * D * itemsize`` bytes, shrinking with mesh
+size; (b) its shard of the bucket tables (col_ids/ratings/mask ~= 12
+bytes per rating / n_shards), shrinking with mesh size; and (c) the
+working set of the opposite factor matrix, which depends on the variant:
+
+- ``gather`` (``all_gather`` of the FULL opposite side): (c) =
+  ``opposite_rows * D * itemsize`` bytes per chip, NOT shrinking with
+  mesh size. One fused ICI collective — the latency-optimal choice while
+  it fits (ALX makes the same trade, PAPERS.md). On 16-GiB v5e the
+  gathered side caps at roughly 10^8 rows at rank 20 or 1.6*10^7 at
+  rank 128 (at half of HBM). MovieLens-20M (2.7*10^4 items, rank 20 ->
+  2 MiB gathered) is far below it.
+- ``ring`` (blocked ``ppermute`` rotation, the ring-top-k pattern of
+  parallel/ring_topk.py applied to training): each chip keeps only ONE
+  opposite-factor slab (``opposite_rows / n_shards * D``) resident;
+  slabs rotate around the mesh once per half-step, and each bucket's
+  normal equations ``(A, b)`` accumulate in place against the passing
+  slabs. (c) becomes slab + accumulators —
+  ``opposite_rows/S * D + target_table_rows/S * D^2`` floats — which
+  SHRINKS with mesh size, like MLlib's block ALS (whose executors hold
+  per-user triangular systems the same way; reference
+  examples/scala-parallel-recommendation/custom-prepartor/src/main/
+  scala/ALSAlgorithm.scala:72 delegates to that substrate). Bucket
+  tables are repartitioned host-side by slab owner
+  (``ring_partition_bucket``) so each rotation computes only against
+  the entries the passing slab can serve — total gather/Gramian work
+  stays at parity with gather mode (up to sub-table padding slop), and
+  the real price is S collective hops of the slabs per half-step
+  instead of one fused all_gather.
+
+``sharded_als_train`` picks the variant per run: ``gather`` while the
+gathered side fits ``ALSParams.sharded_gather_budget_bytes``, ``ring``
+past it (``mode=`` overrides). Per-bucket ``[B, K, D]`` factor-gather
+temps are bounded by ``ALSParams.gather_chunk_bytes`` in BOTH variants
+(the ring gathers from its resident slab through the same chunked
+helper). Both variants are exact on segmented hot rows and share the
+single-chip bucket math (ops/als.py `_bucket_weights` /
+`_finish_bucket_solve`).
 """
 
 from __future__ import annotations
@@ -59,6 +78,7 @@ from dataclasses import dataclass
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -180,6 +200,61 @@ def upload_sharded_buckets(
     )
 
 
+def ring_partition_bucket(
+    sb: ShardedBucket, opp_rows_loc: int, shards: int
+) -> ShardedBucket:
+    """Repartition one sharded bucket's tables by opposite-slab OWNER for
+    the ring half-step: ``col_ids/ratings/mask`` become ``[S*B, S_owner,
+    K_sub]`` where slot ``[b, s, :]`` packs exactly the entries of table
+    row ``b`` whose opposite factor row lives on shard ``s`` (owner =
+    ``col_id // opp_rows_loc``; factors are row-contiguous over shards).
+
+    This is what keeps ring-mode COMPUTE at parity with gather mode: each
+    rotation consumes only its ``[B, K_sub]`` sub-table (``K_sub`` = max
+    entries any (row, owner) pair holds) instead of re-gathering the full
+    ``[B, K]`` table with (S-1)/S of the weights zeroed. Total ring work
+    is ``S * B * K_sub * D^2`` vs gather's ``B * K * D^2`` — parity up to
+    padding slop when entries spread across owners (random id layouts;
+    the common case), degrading only for adversarial skew where one
+    (row, owner) pair holds most of a row's entries. Table memory is
+    ``S * K_sub / K`` times the flat layout — near parity in the common
+    spread case (``K_sub ~= K/S``), but up to S times under the same
+    adversarial skew (``K_sub -> K``); size ring-mode runs accordingly.
+    """
+    SB, K = sb.col_ids.shape
+    m_flat = sb.mask.reshape(-1) > 0
+    rows_idx = np.repeat(np.arange(SB, dtype=np.int64), K)[m_flat]
+    own = (sb.col_ids.reshape(-1)[m_flat].astype(np.int64)) // opp_rows_loc
+    cnt = np.zeros((SB, shards), np.int64)
+    np.add.at(cnt, (rows_idx, own), 1)
+    K_sub = max(1, int(cnt.max()))
+    # within-(row, owner) rank: stable sort by the group key, then
+    # position minus group start
+    key = rows_idx * shards + own
+    order = np.argsort(key, kind="stable")
+    ks = key[order]
+    starts = np.concatenate([[0], np.nonzero(np.diff(ks))[0] + 1])
+    counts = np.diff(np.concatenate([starts, [len(ks)]]))
+    rank = np.arange(len(ks)) - np.repeat(starts, counts)
+    rr, oo = rows_idx[order], own[order]
+    col_ids = np.zeros((SB, shards, K_sub), np.int32)
+    ratings = np.zeros((SB, shards, K_sub), np.float32)
+    mask = np.zeros((SB, shards, K_sub), np.float32)
+    col_ids[rr, oo, rank] = sb.col_ids.reshape(-1)[m_flat][order]
+    ratings[rr, oo, rank] = sb.ratings.reshape(-1)[m_flat][order]
+    mask[rr, oo, rank] = 1.0
+    return ShardedBucket(
+        row_ids=sb.row_ids,
+        col_ids=col_ids,
+        ratings=ratings,
+        mask=mask,
+        seg_row=sb.seg_row,
+        shards=sb.shards,
+        rows_per_shard=sb.rows_per_shard,
+        table_rows_per_shard=sb.table_rows_per_shard,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Device-side: fused training program
 # ---------------------------------------------------------------------------
@@ -230,8 +305,6 @@ def init_sharded_factors(
     U_dev = jax.device_put(U, sharding)
     V_dev = jax.device_put(V, sharding)
     if params.storage_dtype != "float32":
-        import jax.numpy as jnp
-
         sd = jnp.dtype(params.storage_dtype)
         U_dev = U_dev.astype(sd)  # elementwise: sharding preserved
         V_dev = V_dev.astype(sd)
@@ -247,54 +320,165 @@ def init_sharded_factors(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("params", "mesh", "axis"),
+    static_argnames=("params", "mesh", "axis", "mode"),
     donate_argnums=(0, 1),
 )
 def _train_fused_sharded(
-    U, V, row_arrays, col_arrays, iterations, params: als_ops.ALSParams, mesh, axis
+    U,
+    V,
+    row_arrays,
+    col_arrays,
+    iterations,
+    params: als_ops.ALSParams,
+    mesh,
+    axis,
+    mode: str = "gather",
 ):
     """The whole sharded training run as ONE device program.
 
     ``lax.fori_loop`` over iterations (dynamic trip count — one compile
     serves any iteration count); each half-step is a single ``shard_map``
-    region solving every bucket (one ``all_gather`` of the opposite
-    factors, one ``psum`` for the implicit Gramian), followed by global
-    scatters of the solutions into the sharded factor matrix.
+    region solving every bucket, followed by global scatters of the
+    solutions into the sharded factor matrix. Two half-step variants
+    (module docstring, "Memory model"):
+
+    - ``mode="gather"``: one ``all_gather`` of the opposite factors; each
+      bucket solves against the full gathered matrix.
+    - ``mode="ring"``: the opposite factors never materialize whole on
+      any chip. A ``fori_loop`` over the mesh size rotates opposite
+      slabs with ``ppermute``; per rotation each bucket masks its
+      entries down to the ones owned by the passing slab and
+      accumulates their Gramian/rhs contribution into persistent
+      ``(A, b)`` normal equations, which are solved once the ring
+      completes. Entry ownership is index arithmetic: factors are
+      row-contiguous over shards, so global column id ``g`` lives on
+      shard ``g // rows_per_shard`` at offset ``g % rows_per_shard``.
+
+    The implicit-feedback Gramian is psum'd from shard-local factors in
+    both variants (it never needed the gather).
     """
     shards = mesh.shape[axis]
     factor_spec = NamedSharding(mesh, P(axis))
+    dt = jnp.dtype(params.compute_dtype)
+
+    def gather_shard_fn(rows_per, other_shard, *flat):
+        other_full = jax.lax.all_gather(other_shard, axis, tiled=True)
+        gram = None
+        if params.implicit:
+            gram = jax.lax.psum(
+                als_ops.compute_gram(other_shard, params.compute_dtype), axis
+            )
+        outs = []
+        for bi in range(0, len(flat) // 4):
+            col_ids, ratings, mask, seg_row = flat[bi * 4 : bi * 4 + 4]
+            outs.append(
+                als_ops._solve_bucket_inline(
+                    other_full,
+                    gram,
+                    (col_ids, ratings, mask),
+                    params,
+                    seg_row=seg_row,
+                    num_solved_rows=rows_per[bi],
+                )
+            )
+        return tuple(outs)
+
+    def ring_shard_fn(rows_per, other_shard, *flat):
+        # tables arrive OWNER-PARTITIONED (`ring_partition_bucket`):
+        # [B_loc, S, K_sub], slot [:, s, :] holding the entries whose
+        # opposite factor row lives on shard s — each rotation slices out
+        # exactly the sub-table the passing slab can serve, keeping ring
+        # compute at parity with gather mode.
+        slab_rows = other_shard.shape[0]
+        D = other_shard.shape[1]
+        me = jax.lax.axis_index(axis)
+        gram = None
+        if params.implicit:
+            gram = jax.lax.psum(
+                als_ops.compute_gram(other_shard, params.compute_dtype), axis
+            )
+        nb = len(flat) // 4
+        # zero accumulators are constants; mark them device-varying so
+        # they sit in the fori_loop carry beside the ppermute'd slab
+        varying = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+        buckets3 = [flat[bi * 4 : bi * 4 + 3] for bi in range(nb)]
+        accs = tuple(
+            (
+                varying(jnp.zeros((col_ids.shape[0], D, D), jnp.float32)),
+                varying(jnp.zeros((col_ids.shape[0], D), jnp.float32)),
+            )
+            for col_ids, _r, _m in buckets3
+        )
+        # send my slab to the next shard each step; after t rotations I
+        # hold the slab of shard (me - t) mod S
+        perm = [(i, (i + 1) % shards) for i in range(shards)]
+
+        def owner_slice(x, owner):
+            # [B, S, K_sub] -> the current owner's [B, K_sub] sub-table
+            return jax.lax.dynamic_slice_in_dim(x, owner, 1, axis=1)[:, 0]
+
+        def accumulate(owner, slab, accs):
+            new_accs = []
+            for (col_ids, ratings, mask), (A, b) in zip(buckets3, accs):
+                sub_ids = owner_slice(col_ids, owner)
+                # weights are computed on the sliced [B, K_sub] sub-table
+                # per rotation (elementwise, negligible) rather than
+                # precomputed whole — ring mode exists for HBM relief
+                w, r = als_ops._bucket_weights(
+                    owner_slice(ratings, owner),
+                    owner_slice(mask, owner),
+                    params,
+                    params.alpha,
+                )
+                # padding slots hold col_id 0 with zero weight; clip keeps
+                # their local index in range, the weight kills the term
+                lid = jnp.clip(sub_ids - owner * slab_rows, 0, slab_rows - 1)
+                A_c, b_c = als_ops._gramian_rhs_gathered(
+                    slab, lid, w, r, dt, params.gather_chunk_bytes
+                )
+                new_accs.append((A + A_c, b + b_c))
+            return tuple(new_accs)
+
+        def rotate(t, carry):
+            slab, accs = carry
+            accs = accumulate(jnp.mod(me - t, shards), slab, accs)
+            slab = jax.lax.ppermute(slab, axis, perm)
+            return slab, accs
+
+        # S-1 rotate-and-accumulate steps, then the final slab's
+        # accumulation peeled out of the loop: S-1 collective hops per
+        # half-step, not S (the last rotation's result would be unused)
+        slab, accs = jax.lax.fori_loop(
+            0, shards - 1, rotate, (other_shard, accs)
+        )
+        accs = accumulate(jnp.mod(me - (shards - 1), shards), slab, accs)
+        outs = []
+        for bi, (A, b) in enumerate(accs):
+            mask, seg_row = flat[bi * 4 + 2], flat[bi * 4 + 3]
+            outs.append(
+                als_ops._finish_bucket_solve(
+                    A,
+                    b,
+                    mask.sum(axis=(1, 2)),
+                    gram,
+                    params,
+                    seg_row,
+                    rows_per[bi],
+                    params.reg,
+                )
+            )
+        return tuple(outs)
+
+    shard_fn = {"gather": gather_shard_fn, "ring": ring_shard_fn}[mode]
 
     def half(target, other, buckets):
         # per-bucket solved-rows-per-shard, static at trace time
         rows_per = [b[0].shape[0] // shards for b in buckets]
-
-        def shard_fn(other_shard, *flat):
-            other_full = jax.lax.all_gather(other_shard, axis, tiled=True)
-            gram = None
-            if params.implicit:
-                gram = jax.lax.psum(
-                    als_ops.compute_gram(other_shard, params.compute_dtype), axis
-                )
-            outs = []
-            for bi in range(0, len(flat) // 4):
-                col_ids, ratings, mask, seg_row = flat[bi * 4 : bi * 4 + 4]
-                outs.append(
-                    als_ops._solve_bucket_inline(
-                        other_full,
-                        gram,
-                        (col_ids, ratings, mask),
-                        params,
-                        seg_row=seg_row,
-                        num_solved_rows=rows_per[bi],
-                    )
-                )
-            return tuple(outs)
-
         flat = []
         for _row_ids, col_ids, ratings, mask, seg_row in buckets:
             flat += [col_ids, ratings, mask, seg_row]
         xs = jax.shard_map(
-            shard_fn,
+            functools.partial(shard_fn, rows_per),
             mesh=mesh,
             in_specs=(P(axis),) + (P(axis),) * len(flat),
             out_specs=(P(axis),) * len(buckets),
@@ -312,18 +496,36 @@ def _train_fused_sharded(
     return jax.lax.fori_loop(0, iterations, step, (U, V))
 
 
+def choose_sharded_mode(
+    data: als_ops.RatingsData, params: als_ops.ALSParams, shards: int
+) -> str:
+    """Pick the half-step variant for a run: ``gather`` while the larger
+    gathered side fits ``params.sharded_gather_budget_bytes`` per chip,
+    ``ring`` past it (module docstring, "Memory model")."""
+    itemsize = jnp.dtype(params.storage_dtype).itemsize
+    gathered = (
+        max(_padded_len(data.num_rows, shards), _padded_len(data.num_cols, shards))
+        * params.rank
+        * itemsize
+    )
+    return "ring" if gathered > params.sharded_gather_budget_bytes else "gather"
+
+
 def sharded_als_train(
     data: als_ops.RatingsData,
     params: als_ops.ALSParams,
     mesh: Mesh,
     axis: str = "data",
+    mode: str = "auto",
 ) -> tuple[jax.Array, jax.Array]:
     """Full multi-chip ALS with mesh-resident factors.
 
     Exact on arbitrarily hot rows: segmented buckets are consumed as-is
     (segments colocated per shard — see ``shard_bucket``), so results
-    match single-chip ``als_train`` for the same seed. Returns (U, V)
-    trimmed to the true row counts (still sharded device arrays)."""
+    match single-chip ``als_train`` for the same seed. ``mode`` is
+    ``"gather"``, ``"ring"``, or ``"auto"`` (default: pick by the
+    per-chip budget — ``choose_sharded_mode``). Returns (U, V) trimmed
+    to the true row counts (still sharded device arrays)."""
     import dataclasses
 
     if axis not in mesh.shape:
@@ -333,6 +535,10 @@ def sharded_als_train(
             f"(e.g. --mesh {axis}=N) or pass axis="
         )
     shards = mesh.shape[axis]
+    if mode == "auto":
+        mode = choose_sharded_mode(data, params, shards)
+    elif mode not in ("gather", "ring"):
+        raise ValueError(f"mode must be auto|gather|ring, got {mode!r}")
     state = init_sharded_factors(data, params, mesh, axis)
     row_sb = [
         shard_bucket(b, shards, state.U.shape[0] - 1) for b in data.row_buckets
@@ -340,6 +546,17 @@ def sharded_als_train(
     col_sb = [
         shard_bucket(b, shards, state.V.shape[0] - 1) for b in data.col_buckets
     ]
+    if mode == "ring":
+        # partition each table by opposite-slab owner so every rotation
+        # consumes only the sub-table the passing slab can serve
+        row_sb = [
+            ring_partition_bucket(sb, state.V.shape[0] // shards, shards)
+            for sb in row_sb
+        ]
+        col_sb = [
+            ring_partition_bucket(sb, state.U.shape[0] // shards, shards)
+            for sb in col_sb
+        ]
     row_arrays = upload_sharded_buckets(row_sb, mesh, axis)
     col_arrays = upload_sharded_buckets(col_sb, mesh, axis)
     # iterations rides as a dynamic loop bound (shared compile across
@@ -354,6 +571,7 @@ def sharded_als_train(
         static_params,
         mesh,
         axis,
+        mode,
     )
     return U[: data.num_rows], V[: data.num_cols]
 
