@@ -966,24 +966,55 @@ class PartitionedEvents(base.Events):
     def export_jsonl(self, app_id: int, channel_id: int | None, out) -> int:
         """Export splice-through (see jsonl.export_jsonl): each partition
         streams its segments+active verbatim once proven replay-clean
-        (compacted otherwise — shares scan_ratings' proof, compaction,
-        and clean_stat cache). Partition order is the export order —
-        arbitrary, like the reference's RDD part files. Returns the
-        record count."""
+        (compacted otherwise). Partition order is the export order —
+        arbitrary, like the reference's RDD part files.
+
+        Proven and streamed ONE PARTITION AT A TIME: each partition's
+        lock is held only for its own read/prove/compact, and its buffer
+        is written to ``out`` before the next partition is touched — so
+        a multi-GB namespace stalls concurrent ingest on at most one
+        partition at a time and peak RSS is one partition, not the
+        store (the per-partition proofs are each sound on their own:
+        ids route to exactly one partition, so replay-cleanliness is a
+        per-partition property). Returns the record count."""
         ns = self._ns_dir(app_id, channel_id)
         if not ns.exists():
             return 0
         n = self._n_partitions(ns)
-        with self._locked_all(ns, n):
-            pbufs, _ = self._proven_clean_buffers_locked(
-                ns, n, forbid_blank_lines=True
-            )
         total = 0
-        for buf in pbufs:
+        for pp in range(n):
+            buf = self._proven_clean_partition(ns, pp)
             if buf:
                 out.write(buf)
                 total += buf.count(b"\n")
         return total
+
+    def _proven_clean_partition(self, ns: Path, pp: int) -> bytes:
+        """One partition's buffer proven replay-clean and blank-line
+        free (compacted under that partition's lock when the proof
+        fails or is unavailable). Unlike ``_proven_clean_buffers_locked``
+        this takes only the single partition lock and leaves the
+        namespace-level clean_stat cache alone (the next scan_ratings
+        re-proves from its own snapshot)."""
+        from predictionio_tpu import native
+        from predictionio_tpu.data.storage.jsonl import _maybe_blank_lines
+
+        pdir = self._pdir(ns, pp)
+        with self._locked(pdir):
+            buf, _ = self._read_partition_locked(pdir)
+            if not buf:
+                return b""
+            needs, _scan = (
+                prove_clean(buf)
+                if native.native_available()
+                else (True, None)  # unprovable: compact
+            )
+            if not needs:
+                needs = _maybe_blank_lines(buf)
+            if needs:
+                self._compact_partition_locked(pdir)
+                buf, _ = self._read_partition_locked(pdir)
+        return buf
 
     @staticmethod
     def _read_partition_locked(pdir: Path) -> tuple[bytes, list]:

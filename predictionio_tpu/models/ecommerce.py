@@ -24,6 +24,7 @@ the event-store read never stalls the device path mid-computation.
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -176,6 +177,15 @@ class ECommAlgorithm(Algorithm):
     params_class = ECommAlgorithmParams
     query_class = Query
 
+    def __init__(self, params=None):
+        super().__init__(params)
+        # serving caches are read and rebuilt from concurrent HTTP
+        # handler threads; one lock (double-checked before each costly
+        # rebuild) keeps a write spike from fanning out N duplicate
+        # full-store scans / [I, D] multiplies whose results all but one
+        # thread would discard
+        self._serve_lock = threading.Lock()
+
     def train(self, ctx: WorkflowContext, td: TrainingData) -> ECommModel:
         if not len(td.view_events):
             raise ValueError("cannot train on zero view events")
@@ -248,8 +258,11 @@ class ECommAlgorithm(Algorithm):
             return None, None
         cache = getattr(self, "_filters", None)
         if cache is None or cache["token"] != token:
-            cache = {"token": token, "seen": {}, "unavail": None}
-            self._filters = cache
+            with self._serve_lock:
+                cache = getattr(self, "_filters", None)  # double-check
+                if cache is None or cache["token"] != token:
+                    cache = {"token": token, "seen": {}, "unavail": None}
+                    self._filters = cache
         return cache, token
 
     def _seen_items(self, user: str, cache: dict | None) -> set[str]:
@@ -273,27 +286,30 @@ class ECommAlgorithm(Algorithm):
         except Exception:
             indexed = True
         if cache is not None and not indexed:
-            try:
-                events = store.find(
-                    app_name=self.params.app_name,
-                    entity_type="user",
-                    event_names=list(self.params.seen_events),
-                    target_entity_type="item",
-                    limit=None,
-                )
-            except Exception:
-                logger.exception(
-                    "seen-items scan failed; serving without filter"
-                )
-                return set()
-            seen_all: dict[str, set[str]] = {}
-            for e in events:
-                if e.target_entity_id:
-                    seen_all.setdefault(e.entity_id, set()).add(
-                        e.target_entity_id
+            with self._serve_lock:
+                if cache.get("seen_all") is not None:  # double-check
+                    return cache["seen_all"].get(user, frozenset())
+                try:
+                    events = store.find(
+                        app_name=self.params.app_name,
+                        entity_type="user",
+                        event_names=list(self.params.seen_events),
+                        target_entity_type="item",
+                        limit=None,
                     )
-            cache["seen_all"] = seen_all
-            return seen_all.get(user, frozenset())
+                except Exception:
+                    logger.exception(
+                        "seen-items scan failed; serving without filter"
+                    )
+                    return set()
+                seen_all: dict[str, set[str]] = {}
+                for e in events:
+                    if e.target_entity_id:
+                        seen_all.setdefault(e.entity_id, set()).add(
+                            e.target_entity_id
+                        )
+                cache["seen_all"] = seen_all
+                return seen_all.get(user, frozenset())
         try:
             events = store.find_by_entity(
                 app_name=self.params.app_name,
@@ -370,15 +386,18 @@ class ECommAlgorithm(Algorithm):
             model._cat_members = index
         got = index.get(category)
         if got is None:
-            got = np.fromiter(
-                (
-                    ix
-                    for iid, ix in model.item_index.items()
-                    if category in model.categories.get(iid, ())
-                ),
-                np.int64,
-            )
-            index[category] = got
+            with self._serve_lock:
+                got = index.get(category)  # double-check
+                if got is None:
+                    got = np.fromiter(
+                        (
+                            ix
+                            for iid, ix in model.item_index.items()
+                            if category in model.categories.get(iid, ())
+                        ),
+                        np.int64,
+                    )
+                    index[category] = got
         return got
 
     def _exclusions(self, model: ECommModel, query: Query) -> np.ndarray:
@@ -415,28 +434,34 @@ class ECommAlgorithm(Algorithm):
         import json as json_mod
 
         key = json_mod.dumps(self.params.weights, sort_keys=True)
+        # lock-free hit path: predicts must not stall behind the lock
+        # while another thread holds it across a full-store seen scan
         cache = getattr(model, "_weighted_V", None)
-        if cache is None:
-            cache = {}
-            model._weighted_V = cache
-        if key in cache:
+        if cache is not None and key in cache:
             return cache[key]
-        import jax.numpy as jnp
+        with self._serve_lock:
+            cache = getattr(model, "_weighted_V", None)  # double-check
+            if cache is None:
+                cache = {}
+                model._weighted_V = cache
+            if key in cache:
+                return cache[key]
+            import jax.numpy as jnp
 
-        _, V = model.device_factors()
-        if self.params.weights:
-            n = len(model.item_index)
-            weights = np.ones(n, dtype=np.float32)
-            for group in self.params.weights:
-                w = float(group.get("weight", 1.0))
-                for iid in group.get("items", []):
-                    if iid in model.item_index:
-                        weights[model.item_index[iid]] = w
-            weighted = V * jnp.asarray(weights)[:, None]
-        else:
-            weighted = V
-        cache[key] = weighted
-        return weighted
+            _, V = model.device_factors()
+            if self.params.weights:
+                n = len(model.item_index)
+                weights = np.ones(n, dtype=np.float32)
+                for group in self.params.weights:
+                    w = float(group.get("weight", 1.0))
+                    for iid in group.get("items", []):
+                        if iid in model.item_index:
+                            weights[model.item_index[iid]] = w
+                weighted = V * jnp.asarray(weights)[:, None]
+            else:
+                weighted = V
+            cache[key] = weighted
+            return weighted
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         import jax.numpy as jnp

@@ -196,6 +196,57 @@ class StorageServer:
                     "on every line",
                     400,
                 )
+            # replay-safety: committing a line that later fails
+            # Event.from_dict (missing event/entityType/entityId, or an
+            # unparseable eventTime/creationTime) would brick every
+            # find()/export of this (app, channel) with
+            # EventValidationError. The CLI client checks this before
+            # sending (_splice_import_chunk), but the server is the
+            # trust boundary — mirror the predicate here.
+            if b'"$delete"' in blob:
+                # a top-level {"$delete": id} key acts as a jsonl delete
+                # MARKER on replay — deleting an attacker-chosen
+                # existing event. Inside a JSON string the quote would
+                # be escaped (\"), so any raw occurrence of these bytes
+                # is a key; the client routes such lines to the
+                # per-event RPC path (cli/commands.py), never a splice
+                # blob — reject the blob outright.
+                return Response.error(
+                    'splice blobs may not carry "$delete" markers; use '
+                    "the delete RPC or per-event import",
+                    400,
+                )
+            import numpy as np
+
+            offs, lens = sc.offs, sc.lens
+            ok = np.ones(len(sc), dtype=bool)
+            for f in (native.F_EVENT, native.F_ENTITY_TYPE, native.F_ENTITY_ID):
+                ok &= (offs[:, f] >= 0) & (lens[:, f] > 0)
+            ok &= offs[:, native.F_EVENT_TIME] >= 0
+            ok &= ~np.isnan(
+                native.parse_times(
+                    probe,
+                    offs[:, native.F_EVENT_TIME],
+                    lens[:, native.F_EVENT_TIME],
+                )
+            )
+            ct = offs[:, native.F_CREATION_TIME] >= 0
+            ok &= ~ct | ~np.isnan(
+                native.parse_times(
+                    probe,
+                    offs[:, native.F_CREATION_TIME],
+                    lens[:, native.F_CREATION_TIME],
+                )
+            )
+            if (~ok & nonempty).any():
+                bad = int(np.flatnonzero(~ok & nonempty)[0])
+                return Response.error(
+                    "line %d would poison the log on replay: every line "
+                    "needs non-empty event/entityType/entityId and a "
+                    "parseable eventTime (and creationTime when present)"
+                    % (bad + 1),
+                    400,
+                )
             try:
                 splice(blob, app_id, channel_id)
             except (EventValidationError, ValueError, KeyError, TypeError) as e:

@@ -674,3 +674,57 @@ class TestBulkImportValidation:
             assert len(backing.get_events().find(1, limit=None)) == 1
         finally:
             server.stop()
+
+    def test_rejects_replay_poisoning_lines(self, tmp_path):
+        """Scanner-clean lines that would still fail Event.from_dict on
+        replay (missing required fields, unparseable times) must be
+        rejected server-side — one committed line would brick every
+        later find()/export of the (app, channel)."""
+        backing, server, port = self._remote(tmp_path)
+        try:
+            good = (
+                b'{"event":"rate","entityType":"user","entityId":"u1",'
+                b'"properties":{"rating":1.0},'
+                b'"eventTime":"2020-01-01T00:00:00.000Z","eventId":"e1"}\n'
+            )
+            # scanner-clean but nothing except an eventId
+            only_id = b'{"eventId":"00112233445566778899aabbccddeeff"}\n'
+            assert self._post(port, "app_id=1", only_id) == 400
+            # required fields present but empty / missing
+            for mutated in (
+                good.replace(b'"entityId":"u1"', b'"entityId":""'),
+                good.replace(b'"entityType":"user",', b""),
+                good.replace(b'"event":"rate",', b""),
+            ):
+                assert self._post(port, "app_id=1", mutated) == 400
+            # unparseable times poison every later read
+            bad_et = good.replace(
+                b'"eventTime":"2020-01-01T00:00:00.000Z"',
+                b'"eventTime":"not-a-time"',
+            )
+            assert self._post(port, "app_id=1", bad_et) == 400
+            no_et = good.replace(
+                b'"eventTime":"2020-01-01T00:00:00.000Z",', b""
+            )
+            assert self._post(port, "app_id=1", no_et) == 400
+            bad_ct = good[:-2] + b',"creationTime":"garbage"}\n'
+            assert self._post(port, "app_id=1", bad_ct) == 400
+            # a poisoned line inside an otherwise-good batch rejects the
+            # whole blob atomically
+            assert self._post(port, "app_id=1", good + only_id) == 400
+            # a $delete marker would delete an attacker-chosen event on
+            # replay; the splice route must refuse it even when every
+            # replay-safety field is present (cli clients route such
+            # lines to the per-event RPC path, never a splice blob)
+            marker = (
+                b'{"$delete":"victim-id","event":"rate","entityType":"user",'
+                b'"entityId":"u1","eventTime":"2020-01-01T00:00:00.000Z",'
+                b'"eventId":"aa112233445566778899aabbccddeeff"}\n'
+            )
+            assert self._post(port, "app_id=1", marker) == 400
+            assert self._post(port, "app_id=1", good + marker) == 400
+            # good lines still import, and the log replays cleanly
+            assert self._post(port, "app_id=1", good) == 200
+            assert len(backing.get_events().find(1, limit=None)) == 1
+        finally:
+            server.stop()

@@ -459,6 +459,7 @@ class TestMicroBatchedServing:
         batched = EngineServer(
             engine, inst, storage=deployed_engine["storage"],
             host="127.0.0.1", port=0, batch_window_ms=25.0,
+            dispatch_cost_s=10.0,  # pin window-wait mode (probe-independent)
         )
         port = batched.start()
         algo = batched.algorithms[0]
@@ -536,6 +537,7 @@ class TestMicroBatchedServing:
             server = EngineServer(
                 engine, inst, storage=deployed_engine["storage"],
                 host="127.0.0.1", port=0, batch_window_ms=batch_window_ms,
+                dispatch_cost_s=10.0,  # pin window-wait mode
             )
             algo = server.algorithms[0]
             real_p, real_bp = type(algo).predict, type(algo).batch_predict
@@ -586,6 +588,101 @@ class TestMicroBatchedServing:
         # ~8 calls (~0.64s); batched ~1-2 calls + the 40ms window
         assert batched < unbatched / 2, (unbatched, batched)
 
+    def test_bypass_mode_lone_query_skips_window(self, storage, deployed_engine):
+        """Adaptive policy: when the measured dispatch cost is below the
+        window, the window is bypassed — a lone query must NOT pay the
+        configured wait (the round-4 foot-gun: enabling batching on a
+        fast-dispatch attachment made serving worse)."""
+        import time as _time
+
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        server = EngineServer(
+            deployed_engine["engine"], deployed_engine["server"].instance,
+            storage=deployed_engine["storage"], host="127.0.0.1", port=0,
+            batch_window_ms=500.0, dispatch_cost_s=0.0,  # bypass mode
+        )
+        assert server.batcher is not None and not server.batcher._window_wait
+        port = server.start()
+        try:
+            http("POST", f"http://127.0.0.1:{port}/queries.json",
+                 {"user": "u1", "num": 3})  # warm
+            t0 = _time.perf_counter()
+            status, _body = http(
+                "POST", f"http://127.0.0.1:{port}/queries.json",
+                {"user": "u1", "num": 3},
+            )
+            took = _time.perf_counter() - t0
+            assert status == 200
+            assert took < 0.25, (
+                f"lone query took {took:.3f}s with a 0.5s window: the "
+                "bypass did not kick in"
+            )
+        finally:
+            server.stop()
+
+    def test_bypass_mode_still_batches_under_serialized_dispatch(
+        self, storage, deployed_engine
+    ):
+        """With the window bypassed, batches must still form naturally:
+        requests that queue behind an in-flight (serialized) device call
+        coalesce into the next call — the ~N x win survives without any
+        configured wait."""
+        import threading as _threading
+        import time as _time
+
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        engine = deployed_engine["engine"]
+        inst = deployed_engine["server"].instance
+        device_lock = _threading.Lock()
+        server = EngineServer(
+            engine, inst, storage=deployed_engine["storage"],
+            host="127.0.0.1", port=0,
+            batch_window_ms=2.0, dispatch_cost_s=0.0,  # bypass mode
+        )
+        algo = server.algorithms[0]
+        real_bp = type(algo).batch_predict
+        calls = []
+
+        def taxed_batch(self_, model, queries):
+            with device_lock:  # per CALL, like serialized dispatch
+                _time.sleep(0.08)
+            calls.append(len(queries))
+            return real_bp(self_, model, queries)
+
+        type(algo).batch_predict = taxed_batch
+        port = server.start()
+        try:
+            users = [f"u{i}" for i in range(8)]
+
+            def round_trip():
+                threads = [
+                    _threading.Thread(
+                        target=http,
+                        args=("POST", f"http://127.0.0.1:{port}/queries.json",
+                              {"user": u, "num": 3}),
+                    )
+                    for u in users
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60)
+
+            round_trip()  # warm: jit compiles outside the measurement
+            calls.clear()
+            round_trip()
+            # 8 concurrent queries behind 80ms serialized calls: natural
+            # batching must coalesce them into far fewer calls
+            # (sum(calls) exceeds 8: batches pad to power-of-two sizes)
+            assert len(calls) <= 4, (
+                f"no natural batching: {len(calls)} calls for {len(users)}"
+            )
+        finally:
+            type(algo).batch_predict = real_bp
+            server.stop()
+
     def test_bad_query_does_not_poison_batchmates(self, storage, deployed_engine):
         import threading as _threading
 
@@ -594,7 +691,7 @@ class TestMicroBatchedServing:
         batched = EngineServer(
             deployed_engine["engine"], deployed_engine["server"].instance,
             storage=deployed_engine["storage"], host="127.0.0.1", port=0,
-            batch_window_ms=25.0,
+            batch_window_ms=25.0, dispatch_cost_s=10.0,
         )
         port = batched.start()
         try:
