@@ -241,6 +241,10 @@ def bench_core(scale: str, extras: dict, result: dict) -> None:
             cpu_s = time.perf_counter() - t0
             pred = (Un[rows] * Vn[cols]).sum(1)
             result["vs_baseline"] = round(cpu_s / tpu_s, 2)
+            # vs_baseline is vs_numpy_host: the identical blocked ALS in
+            # f32 NumPy on this host CPU, NOT a measured Spark run
+            # (BASELINE.md "Comparator calibration")
+            result["baseline_comparator"] = "numpy_host"
             result["baseline_cpu_s"] = round(cpu_s, 4)
             result["baseline_rmse"] = round(
                 float(np.sqrt(np.mean((pred - vals) ** 2))), 4
@@ -592,21 +596,12 @@ def bench_ingest(extras: dict) -> None:
             ))
         batch_s = time.perf_counter() - t0
 
-        # sequential singles: per-request latency (each request pays its
-        # own commit wait — the floor, no coalescing possible)
-        n_single = 300
-        singles = [batch_payload(10_000 + j)[0] for j in range(n_single)]
-        t0 = time.perf_counter()
-        for payload in singles:
-            _post_json(f"{url}/events.json?accessKey={key}", payload)
-        single_s = time.perf_counter() - t0
-
-        # concurrent singles: production shape — many independent client
-        # PROCESSES, one event per request; fsync group commit coalesces
-        # their commits. Client subprocesses keep the measurement off
-        # this process's GIL (in-process client threads serialize JSON
-        # work against the server and understate the server's capacity).
-        n_conc, conc_procs, per_proc = 600, 8, 75
+        # singles: one client process, one event per request over a
+        # persistent connection (the reference SDKs pool keep-alive
+        # connections; a per-request TCP connect would measure the
+        # client, not the server). Subprocess keeps the client off this
+        # process's GIL. Each request pays its own commit wait — the
+        # sequential floor, no coalescing possible in sync=always mode.
         ingest_body = (
             "import json\n"
             "for j in range(n):\n"
@@ -620,6 +615,14 @@ def bench_ingest(extras: dict) -> None:
             "    r=c.getresponse(); r.read()\n"
             "    assert r.status==201, r.status\n"
         )
+        n_single = 300
+        single_s = _run_gated_clients(
+            ingest_body, "127.0.0.1", port,
+            f"/events.json?accessKey={key}", 1, n_single,
+        )
+        # concurrent singles: production shape — many independent client
+        # PROCESSES; fsync group commit coalesces their commits
+        n_conc, conc_procs, per_proc = 600, 8, 75
         conc_s = _run_gated_clients(
             ingest_body, "127.0.0.1", port,
             f"/events.json?accessKey={key}", conc_procs, per_proc,
@@ -632,6 +635,53 @@ def bench_ingest(extras: dict) -> None:
             "single_concurrent_events_per_s": round(n_conc / conc_s),
             "single_concurrent_clients": conc_procs,
             "event_backend": E2E_BACKEND,
+        }
+    finally:
+        server.stop()
+
+    # sync=interval:20 — the reference's HBase-WAL-hflush durability
+    # (ack after flush to the page cache; background fsync every 20 ms).
+    # Sequential single-event ingest is fsync-BOUND in the default
+    # always mode (a lone client can never share its fsync), so this is
+    # the apples-to-apples comparison against the reference's write path.
+    import tempfile as _tempfile
+
+    from predictionio_tpu.data.storage import Storage
+
+    tmp = _tempfile.mkdtemp(dir=os.environ["BENCH_TMPDIR"])
+    storage_i = Storage(env={
+        "PIO_STORAGE_SOURCES_DB_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+        "PIO_STORAGE_SOURCES_LOG_PATH": tmp,
+        "PIO_STORAGE_SOURCES_LOG_SYNC": "interval:20",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+    })
+    app_id = storage_i.get_metadata_apps().insert(App(0, "BenchIngestI"))
+    key = storage_i.get_metadata_access_keys().insert(AccessKey("", app_id, []))
+    storage_i.get_events().init(app_id)
+    server = EventServer(storage=storage_i, host="127.0.0.1", port=0)
+    port = server.start(background=True)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        n_single = 300
+        _post_json(  # warmup
+            f"{url}/events.json?accessKey={key}", batch_payload(20_000)[0]
+        )
+        single_s = _run_gated_clients(
+            ingest_body, "127.0.0.1", port,
+            f"/events.json?accessKey={key}", 1, n_single,
+        )
+        n_conc, conc_procs, per_proc = 600, 8, 75
+        conc_s = _run_gated_clients(
+            ingest_body, "127.0.0.1", port,
+            f"/events.json?accessKey={key}", conc_procs, per_proc,
+        )
+        extras["ingest"]["interval_sync"] = {
+            "sync": "interval:20",
+            "single_events_per_s": round(n_single / single_s),
+            "single_concurrent_events_per_s": round(n_conc / conc_s),
         }
     finally:
         server.stop()

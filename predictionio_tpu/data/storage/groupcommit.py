@@ -24,12 +24,52 @@ paths run under the append lock (no writes in flight), make the old
 bytes durable themselves (fsync-before-rename, or deletion making
 durability moot), and then call :meth:`mark_all_durable` so pending
 waiters complete instead of fsyncing a replaced file.
+
+Sync modes: the backends ack in one of two durability modes (the
+``sync`` source property):
+
+- ``always`` (default): ack after a covering fsync (the protocol
+  above) — stronger than the reference, whose HBase WAL default is
+  hflush (replica memory, not disk).
+- ``interval[:ms]``: ack after write+flush — the bytes are in the OS
+  page cache, so they survive a PROCESS crash (the reference's hflush
+  semantics); a background :class:`CoalescerMap` thread fsyncs pending
+  logs every ``ms`` (default 50), bounding the loss window on a kernel
+  crash/power failure to one interval. Single-event REST ingest is
+  fsync-bound sequentially (a lone client can never share its fsync),
+  so this is the knob that lifts it to reference-parity throughput.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+
+logger = logging.getLogger(__name__)
+
+
+def parse_sync_mode(value: str | None) -> float | None:
+    """``sync`` source property -> fsync interval in seconds, or None
+    for always-fsync. Accepts ``always``, ``interval``, ``interval:ms``."""
+    if value is None or value == "" or value == "always":
+        return None
+    if value == "interval":
+        return 0.05
+    if value.startswith("interval:"):
+        import math
+
+        ms = float(value.split(":", 1)[1])
+        # nan would spin the syncer thread (wait(nan) returns
+        # immediately); inf would never run it (unbounded loss window)
+        if not (ms > 0) or math.isinf(ms):
+            raise ValueError(
+                f"sync interval must be positive and finite, got {value!r}"
+            )
+        return ms / 1e3
+    raise ValueError(
+        f"sync must be 'always', 'interval', or 'interval:<ms>', got {value!r}"
+    )
 
 
 class FsyncCoalescer:
@@ -57,6 +97,31 @@ class FsyncCoalescer:
             self._synced = self._seq
             self._cond.notify_all()
 
+    def _fsync_and_mark(self, path, target: int) -> None:
+        """The syncer body shared by ``wait_durable`` and ``sync_now``:
+        fsync ``path`` (a missing file means it was rotated/removed —
+        whoever replaced it owned durability, see module doc) and mark
+        ``target`` durable. Caller must have set ``_syncing`` under the
+        condition with ``target = self._seq``."""
+        ok = False
+        try:
+            try:
+                fd = os.open(str(path), os.O_RDONLY)
+            except FileNotFoundError:
+                ok = True
+            else:
+                try:
+                    os.fsync(fd)
+                    ok = True
+                finally:
+                    os.close(fd)
+        finally:
+            with self._cond:
+                self._syncing = False
+                if ok:
+                    self._synced = max(self._synced, target)
+                self._cond.notify_all()
+
     def wait_durable(self, my_seq: int, path) -> None:
         """Block until an fsync covering ``my_seq`` has completed,
         becoming the syncer if none is running. Raises the fsync's
@@ -70,34 +135,33 @@ class FsyncCoalescer:
                     continue
                 self._syncing = True
                 target = self._seq
-            ok = False
-            try:
-                try:
-                    fd = os.open(str(path), os.O_RDONLY)
-                except FileNotFoundError:
-                    # file rotated/removed under us: whoever replaced it
-                    # was responsible for durability (see module doc)
-                    ok = True
-                else:
-                    try:
-                        os.fsync(fd)
-                        ok = True
-                    finally:
-                        os.close(fd)
-            finally:
-                with self._cond:
-                    self._syncing = False
-                    if ok:
-                        self._synced = max(self._synced, target)
-                    self._cond.notify_all()
+            self._fsync_and_mark(path, target)
+
+    def sync_now(self, path) -> None:
+        """Fsync ``path`` if any issued sequence is not yet durable,
+        without blocking on another syncer (the interval thread's
+        entry point; a concurrent ``wait_durable`` syncer covers us)."""
+        with self._cond:
+            if self._synced >= self._seq or self._syncing:
+                return
+            self._syncing = True
+            target = self._seq
+        self._fsync_and_mark(path, target)
 
 
 class CoalescerMap:
-    """Thread-safe path -> FsyncCoalescer registry (one per client)."""
+    """Thread-safe path -> FsyncCoalescer registry (one per client).
 
-    def __init__(self) -> None:
+    With ``interval_s`` set, a daemon thread (started lazily on first
+    ``get``) fsyncs every registered log with undurable appends each
+    interval — the ``sync=interval`` mode's background syncer."""
+
+    def __init__(self, interval_s: float | None = None) -> None:
         self._lock = threading.Lock()
         self._map: dict[str, FsyncCoalescer] = {}
+        self._interval = interval_s
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
 
     def get(self, path) -> FsyncCoalescer:
         key = str(path)
@@ -105,4 +169,25 @@ class CoalescerMap:
             got = self._map.get(key)
             if got is None:
                 got = self._map[key] = FsyncCoalescer()
+            if (
+                self._interval is not None
+                and self._thread is None
+            ):
+                self._thread = threading.Thread(
+                    target=self._interval_loop, daemon=True
+                )
+                self._thread.start()
             return got
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _interval_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                items = list(self._map.items())
+            for key, committer in items:
+                try:
+                    committer.sync_now(key)
+                except OSError:  # pragma: no cover - disk error: retry next tick
+                    logger.exception("interval fsync of %s failed", key)

@@ -110,10 +110,17 @@ class PartitionedStorageClient:
         # per-partition-dir thread locks (cross-process safety comes from
         # the flock; a global lock here would serialize the parallel scans)
         self.path_locks: dict[str, threading.RLock] = {}
-        # per-active-log fsync group commit (see groupcommit.py)
-        from predictionio_tpu.data.storage.groupcommit import CoalescerMap
+        # per-active-log fsync group commit (see groupcommit.py); the
+        # `sync` source property selects always-fsync acks (default) or
+        # interval mode (flush-acked, background fsync — the reference's
+        # HBase-WAL-hflush durability)
+        from predictionio_tpu.data.storage.groupcommit import (
+            CoalescerMap,
+            parse_sync_mode,
+        )
 
-        self.committers = CoalescerMap()
+        self.sync_interval = parse_sync_mode(self.config.get("sync"))
+        self.committers = CoalescerMap(self.sync_interval)
         # namespace dir -> (partition count, meta-file (inode, mtime_ns))
         # — the count is immutable for one life of the namespace; the
         # identity pair detects a remove()+recreate by another process
@@ -122,6 +129,10 @@ class PartitionedStorageClient:
         # replay-clean (unique ids, no delete markers): lets scan_ratings
         # skip the uniqueness pass until any file changes
         self.clean_stat: dict[Path, tuple] = {}
+
+    def close(self) -> None:
+        """Stop the interval syncer thread (Storage.close)."""
+        self.committers.stop()
 
 
 class PartitionedEvents(base.Events):
@@ -608,7 +619,10 @@ class PartitionedEvents(base.Events):
                 pdir, line
             )
             self._maybe_seal_locked(pdir)
-        committer.wait_durable(seq, active)
+        if self._c.sync_interval is None:
+            committer.wait_durable(seq, active)
+        # interval mode: flushed to the page cache; the background
+        # syncer makes it disk-durable within one interval
         return event_id
 
     def _append_group_committed_locked(
@@ -672,8 +686,9 @@ class PartitionedEvents(base.Events):
                     self._append_group_committed_locked(pdir, b"".join(lines))
                 )
                 self._maybe_seal_locked(pdir)
-        for committer, seq, active in waits:
-            committer.wait_durable(seq, active)
+        if self._c.sync_interval is None:
+            for committer, seq, active in waits:
+                committer.wait_durable(seq, active)
         return ids
 
     def append_jsonl(

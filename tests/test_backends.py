@@ -766,6 +766,88 @@ class TestGroupCommit:
         seq2 = c.note_write()
         c.wait_durable(seq2, f)
 
+    def test_parse_sync_mode(self):
+        import pytest as _pytest
+
+        from predictionio_tpu.data.storage.groupcommit import parse_sync_mode
+
+        assert parse_sync_mode(None) is None
+        assert parse_sync_mode("always") is None
+        assert parse_sync_mode("interval") == 0.05
+        assert parse_sync_mode("interval:20") == 0.02
+        for bad in ("interval:0", "interval:-5", "sometimes"):
+            with _pytest.raises(ValueError):
+                parse_sync_mode(bad)
+
+    def test_interval_sync_mode_acks_without_fsync(self, tmp_path, monkeypatch):
+        """sync=interval: inserts ack after flush (no inline fsync — the
+        reference's hflush durability), events are immediately readable,
+        and the background syncer makes them disk-durable within an
+        interval."""
+        import os as os_mod
+        import time as time_mod
+
+        from predictionio_tpu.data.storage import groupcommit
+
+        dao = JSONLEvents(
+            JSONLStorageClient({"path": str(tmp_path), "sync": "interval:20"})
+        )
+        calls = []
+        real_fsync = os_mod.fsync
+
+        def counting_fsync(fd):
+            calls.append(fd)
+            return real_fsync(fd)
+
+        monkeypatch.setattr(groupcommit.os, "fsync", counting_fsync)
+        n = 40
+        ids = [dao.insert(_event(i), 1) for i in range(n)]
+        inline = len(calls)
+        assert inline < n / 2, (
+            f"interval mode still fsyncs inline: {inline} fsyncs for {n}"
+        )
+        assert {e.event_id for e in dao.find(1, limit=None)} == set(ids)
+        # the background syncer catches up within a couple of intervals
+        committer = dao._c.committers.get(dao._file(1, None))
+        deadline = time_mod.time() + 2.0
+        while time_mod.time() < deadline:
+            with committer._cond:
+                if committer._synced >= committer._seq:
+                    break
+            time_mod.sleep(0.01)
+        with committer._cond:
+            assert committer._synced >= committer._seq, "syncer never ran"
+        assert len(calls) > inline, "background fsync never happened"
+
+    def test_interval_sync_mode_partitioned(self, tmp_path):
+        from predictionio_tpu.data.storage.partitioned import (
+            PartitionedEvents,
+            PartitionedStorageClient,
+        )
+
+        dao = PartitionedEvents(PartitionedStorageClient(
+            {"path": str(tmp_path / "p"), "partitions": 2,
+             "sync": "interval:20"}
+        ))
+        ids = [dao.insert(_event(i), 7) for i in range(30)]
+        assert {e.event_id for e in dao.find(7, limit=None)} == set(ids)
+
+    def test_append_fd_survives_compact_and_remove(self, tmp_path):
+        """The cached append handle must not write to a dead inode after
+        compact (atomic replace) or remove (unlink): inode revalidation
+        under the flock reopens it."""
+        dao = JSONLEvents(JSONLStorageClient({"path": str(tmp_path)}))
+        dao.insert(_event(0), 1)
+        dao.delete(dao.find(1)[0].event_id, 1)
+        dao.insert(_event(1), 1)
+        assert dao.compact(1) == 1  # replaces the log file
+        dao.insert(_event(2), 1)  # cached fd must detect the new inode
+        assert {e.entity_id for e in dao.find(1, limit=None)} == {"u1", "u2"}
+        assert dao.remove(1)
+        dao.init(1)
+        dao.insert(_event(3), 1)
+        assert [e.entity_id for e in dao.find(1, limit=None)] == ["u3"]
+
 
 class TestExportSplice:
     """export_jsonl fast path: stream the replay-clean log verbatim;
