@@ -488,6 +488,11 @@ def bench_serving(extras: dict) -> None:
         extras["serving"]["dense_concurrent_batched"] = {
             **_concurrent_qps("127.0.0.1", port, "/queries.json", queries),
             "window_ms": round(window_ms, 2),
+            # adaptive policy evidence: the startup-probed dispatch cost
+            # and whether the window was bypassed because of it
+            "dispatch_ms": round(server.batcher.dispatch_cost_s * 1e3, 3),
+            "engaged": server.batcher.engaged,
+            "window_bypassed": not server.batcher._window_wait,
         }
     finally:
         server.stop()
@@ -729,6 +734,65 @@ def bench_e2e(extras: dict) -> None:
     t0 = time.perf_counter()
     imported = commands.import_events("BenchE2E", path, storage=storage)
     import_s = time.perf_counter() - t0
+
+    rss_after_import_mb = (
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    )
+
+    # the OTHER event backend at the same scale: import + columnar scan
+    # only (train is device-side and backend-independent), so the driver
+    # artifact carries import rate and scan RSS for BOTH jsonl and
+    # partitioned at the 20M north-star scale (VERDICT r4 item 6). Runs
+    # in its OWN subprocess: each backend's peak RSS is then a real
+    # per-process number instead of one conflated high-water mark.
+    other_name = "partitioned" if E2E_BACKEND == "jsonl" else "jsonl"
+    other: dict = {"event_backend": other_name}
+    try:
+        import subprocess
+        import sys as _sys
+
+        child_code = (
+            "import json, os, resource, sys, time\n"
+            "from predictionio_tpu.cli import commands\n"
+            "from predictionio_tpu.data.storage import App, Storage\n"
+            "backend, path, root = sys.argv[1], sys.argv[2], sys.argv[3]\n"
+            "s = Storage(env={\n"
+            "    'PIO_STORAGE_SOURCES_DB_TYPE': 'memory',\n"
+            "    'PIO_STORAGE_SOURCES_LOG_TYPE': backend,\n"
+            "    'PIO_STORAGE_SOURCES_LOG_PATH': root,\n"
+            "    'PIO_STORAGE_REPOSITORIES_METADATA_SOURCE': 'DB',\n"
+            "    'PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE': 'LOG',\n"
+            "    'PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE': 'DB',\n"
+            "})\n"
+            "s.get_metadata_apps().insert(App(0, 'BenchE2E'))\n"
+            "t0 = time.perf_counter()\n"
+            "n = commands.import_events('BenchE2E', path, storage=s)\n"
+            "imp_s = time.perf_counter() - t0\n"
+            "app = s.get_metadata_apps().get_by_name('BenchE2E')\n"
+            "t0 = time.perf_counter()\n"
+            "batch = s.get_events().scan_ratings(app.id, event_names=['rate'])\n"
+            "scan_s = time.perf_counter() - t0\n"
+            "print(json.dumps({\n"
+            "    'import_s': round(imp_s, 1),\n"
+            "    'import_events_per_s': round(n / imp_s),\n"
+            "    'scan_s': round(scan_s, 1),\n"
+            "    'scan_rows': len(batch),\n"
+            "    'peak_rss_mb': resource.getrusage(\n"
+            "        resource.RUSAGE_SELF).ru_maxrss // 1024,\n"
+            "}))\n"
+        )
+        proc = subprocess.run(
+            [_sys.executable, "-c", child_code, other_name, path,
+             os.path.join(tmpdir, "events_other")],
+            capture_output=True, text=True, timeout=3000,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            other["error"] = proc.stderr.strip()[-300:]
+        else:
+            other.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+    except Exception as e:  # record, keep benching
+        other["error"] = f"{type(e).__name__}: {e}"
     os.unlink(path)
 
     engine = recommendation.engine()
@@ -753,12 +817,18 @@ def bench_e2e(extras: dict) -> None:
         "import_s": round(import_s, 1),
         "import_events_per_s": round(imported / import_s),
         "train_s": round(train_s, 1),  # columnar scan + bucketing + device
-        # ru_maxrss is a process-wide high-water mark; rss_before_mb shows
-        # how much of it predates this section (core-scale benchmarks)
+        # ru_maxrss is a process-wide high-water mark; the phase marks
+        # localize it (rss_before_mb predates this section entirely)
         "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024,
+        "rss_after_import_mb": rss_after_import_mb,
         "rss_before_mb": rss_before_mb,
         "event_backend": E2E_BACKEND,
+        "other_backend": other,
     }
+    if n >= 20_000_000:
+        # the VERDICT r4 "e2e_20m" block: north-star-scale end-to-end in
+        # the driver artifact every round (peak RSS bound is the claim)
+        extras["e2e_20m"] = extras["e2e"]
 
 
 def sharded_child() -> None:
@@ -866,6 +936,9 @@ def main() -> None:
     # degrading — round 3 lost its TPU artifact to a single 240s wait
     device_fallback = None
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "55"))
+    orig_jax_platforms = os.environ.get("JAX_PLATFORMS")
+    orig_run_scales = list(RUN_SCALES)
+    orig_rank_sweep = list(RANK_SWEEP)
     for attempt in range(2):
         device_fallback = None
         try:
@@ -916,8 +989,10 @@ def main() -> None:
             )
         if "BENCH_RANK_SWEEP" not in os.environ:
             RANK_SWEEP.clear()
-        if "BENCH_E2E_EVENTS" not in os.environ:
-            E2E_EVENTS = 1_000_000
+        # E2E stays at the 20M north-star scale even degraded: the
+        # chunked-scan RSS bound is a host-side claim (CPU acceptable,
+        # VERDICT r4 item 6), and the whole section measures ~8-10 min
+        # on this host's CPU
 
     # all storage for serving/e2e lives in one throwaway dir; configure
     # BEFORE the first get_storage() call binds the singleton
@@ -962,17 +1037,82 @@ def main() -> None:
 
     _mark.t0 = section_t0
 
+    def _try_recover(where: str) -> bool:
+        """Degraded run, cheap re-probe: a tunnel that comes back
+        mid-run still yields accelerator rows for the core scales.
+        Recovery restores the child-process env (core measurements run
+        in fresh subprocesses that bind their own backend); THIS
+        process keeps its initialized CPU backend, so host-side
+        sections that already ran keep their labels."""
+        nonlocal device_fallback
+        if device_fallback is None:
+            return False
+        try:
+            probe = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "from predictionio_tpu.utils import apply_platform_env;"
+                    "apply_platform_env();import jax;"
+                    "print(jax.devices()[0].platform);"
+                    "print(str(jax.devices()[0]))",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=float(os.environ.get("BENCH_REPROBE_TIMEOUT", "20")),
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                # the child must NOT inherit the degraded-mode cpu pin
+                env={
+                    k: v
+                    for k, v in os.environ.items()
+                    if k != "JAX_PLATFORMS"
+                } | (
+                    {"JAX_PLATFORMS": orig_jax_platforms}
+                    if orig_jax_platforms is not None
+                    else {}
+                ),
+            )
+        except subprocess.TimeoutExpired:
+            return False
+        lines = probe.stdout.strip().splitlines()
+        if probe.returncode != 0 or not lines or lines[0] == "cpu":
+            return False
+        # tunnel is back: child benchmarks will attach to it via env
+        if orig_jax_platforms is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = orig_jax_platforms
+        RUN_SCALES[:] = orig_run_scales
+        RANK_SWEEP[:] = orig_rank_sweep
+        extras["device_recovered"] = {"at": where, "device": lines[-1]}
+        result["device"] = (
+            f"{lines[-1]} (tunnel recovered {where}; earlier host-side "
+            "sections ran on cpu)"
+        )
+        device_fallback = None
+        extras.pop("device_fallback", None)
+        print(f"# accelerator recovered {where}: {lines[-1]}", file=sys.stderr)
+        return True
+
+    def _run_core_scales() -> None:
+        for scale in RUN_SCALES:
+            try:
+                bench_core(scale, extras, result)
+            except Exception as e:  # record, keep benching
+                extras[scale] = {"error": f"{type(e).__name__}: {e}"}
+            _mark(f"core_{scale}")
+
     # core scales FIRST: on remote-tunnel TPU attachments (this box),
     # per-dispatch latency grows to ~130 ms once the process has run many
     # device calls, which would pollute the fused-program wall-clocks if
     # serving/e2e ran before them (measured: 100k 6.7 ms fresh vs 268 ms
     # after the other sections)
-    for scale in RUN_SCALES:
-        try:
-            bench_core(scale, extras, result)
-        except Exception as e:  # record, keep benching
-            extras[scale] = {"error": f"{type(e).__name__}: {e}"}
-        _mark(f"core_{scale}")
+    _run_core_scales()
+    if _try_recover("after_core"):
+        # re-run the cores in fresh children now attached to the
+        # accelerator (the recovered rows overwrite the CPU ones; the
+        # artifact records the recovery point)
+        _run_core_scales()
 
     if RUN_SERVING:
         try:
@@ -987,6 +1127,11 @@ def main() -> None:
         except Exception as e:
             extras["ingest"] = {"error": f"{type(e).__name__}: {e}"}
         _mark("ingest")
+
+    # second chance a few minutes in: serving+ingest are host-heavy, so
+    # a tunnel that came up during them still buys TPU core rows
+    if _try_recover("after_ingest"):
+        _run_core_scales()
 
     if E2E_EVENTS > 0:
         try:

@@ -110,7 +110,13 @@ def cmd_version(args) -> int:
 def cmd_status(args) -> int:
     from predictionio_tpu.cli import commands
 
-    info = commands.status()
+    try:
+        info = commands.status()
+    except ImportError as e:
+        # driver-gated backends (postgres/psycopg2, s3/boto3): the
+        # remedy is in the message — surface it, not a traceback
+        print(f"storage verification failed: {e}", file=sys.stderr)
+        return 1
     print(json.dumps(info, indent=2))
     print("(sanity check) All storage repositories verified.")
     return 0

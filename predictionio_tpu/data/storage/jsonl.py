@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import os
 import threading
 import uuid
@@ -32,6 +33,8 @@ except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.memory import query_events
+
+logger = logging.getLogger(__name__)
 
 # chunk size for bounded-RSS bulk reads: past this buffer size the
 # columnar read proves cleanliness and extracts ratings in line-aligned
@@ -373,24 +376,29 @@ class JSONLEvents(base.Events):
         removed file makes durability moot (see groupcommit.py)."""
         with self._locked(app_id, channel_id) as path:
             f = self._append_fd(path)
+            # the buffer is empty here (every success path flushes), so
+            # the on-disk size is the true pre-append length
+            pre_size = os.fstat(f.fileno()).st_size
             try:
                 f.write(blob)
                 f.flush()
             except Exception:
-                # a failed write/flush can leave this blob in the cached
-                # writer's buffer; a later insert's flush would then
-                # resurrect an event the client saw FAIL. Close the raw
-                # fd first (drops the buffer without flushing it) and
-                # evict the handle.
+                # a failed write/flush can leave this blob (or a torn
+                # prefix of it) buffered or partially on disk; a later
+                # flush would resurrect an event the client saw FAIL,
+                # and a torn tail line would corrupt replay. Evict the
+                # handle, let close flush whatever it can, then roll the
+                # log back to its pre-append length under the flock.
                 self._c.append_fds.pop(str(path), None)
-                try:
-                    os.close(f.fileno())
-                except OSError:  # pragma: no cover
-                    pass
                 try:
                     f.close()
                 except (OSError, ValueError):
                     pass
+                try:
+                    with open(path, "ab") as g:
+                        g.truncate(pre_size)
+                except OSError:  # pragma: no cover - disk fully failed
+                    logger.exception("could not roll back torn append")
                 raise
             committer = self._c.committers.get(path)
             seq = committer.note_write()
@@ -413,13 +421,17 @@ class JSONLEvents(base.Events):
             if f is not None:
                 f.close()
         # drop the lock sidecar too (after releasing the flock) so a
-        # deleted app/channel leaves nothing behind; the cached handle
-        # goes with it (later _locked calls detect the dead inode anyway)
+        # deleted app/channel leaves nothing behind. The cached-handle
+        # eviction must run under the client lock: a concurrent _locked
+        # in another thread may already hold this very handle, and
+        # closing it out from under them would drop their flock
+        # mid-append (later _locked calls detect the dead inode anyway)
         lockpath = self._file(app_id, channel_id).with_suffix(".jsonl.lock")
-        lf = self._c.lock_fds.pop(str(lockpath), None)
-        if lf is not None:
-            lf.close()
-        lockpath.unlink(missing_ok=True)
+        with self._c.lock:
+            lf = self._c.lock_fds.pop(str(lockpath), None)
+            if lf is not None:
+                lf.close()
+            lockpath.unlink(missing_ok=True)
         return existed
 
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:

@@ -65,9 +65,12 @@ except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.jsonl import (
+    SCAN_CHUNK_BYTES,
+    _chunked_clean_extract,
     fold_jsonl_file,
     has_delete_markers,
     prove_clean,
+    prove_clean_chunked,
 )
 from predictionio_tpu.data.storage.memory import query_events
 
@@ -1094,11 +1097,15 @@ class PartitionedEvents(base.Events):
             for pp in range(n):
                 if not pbufs[pp]:
                     continue
-                needs, scans[pp] = (
-                    prove_clean(pbufs[pp])
-                    if native.native_available()
-                    else (True, None)  # unprovable: compact
-                )
+                if not native.native_available():
+                    needs, scans[pp] = True, None  # unprovable: compact
+                elif len(pbufs[pp]) > SCAN_CHUNK_BYTES:
+                    # big partitions prove in O(chunk) memory; the span
+                    # scan is not retained (scan_ratings re-extracts
+                    # through the chunked path)
+                    needs, scans[pp] = prove_clean_chunked(pbufs[pp])
+                else:
+                    needs, scans[pp] = prove_clean(pbufs[pp])
                 if forbid_blank_lines and not needs:
                     needs = _maybe_blank_lines(pbufs[pp])
                 if needs:
@@ -1152,20 +1159,38 @@ class PartitionedEvents(base.Events):
         # buffers are immutable snapshots: parse outside the locks
         live = [pp for pp in range(n) if pbufs[pp]]
 
+        filters = dict(
+            event_names=(
+                list(event_names) if event_names is not None else None
+            ),
+            rating_key=rating_key,
+            default_ratings=default_ratings,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            override_ratings=override_ratings,
+        )
+
         def load_one(pp: int, n_threads: int = 0):
-            return native.load_ratings_jsonl(
-                pbufs[pp],
-                event_names=(
-                    list(event_names) if event_names is not None else None
-                ),
-                rating_key=rating_key,
-                default_ratings=default_ratings,
-                entity_type=entity_type,
-                target_entity_type=target_entity_type,
-                override_ratings=override_ratings,
-                scanned=scans[pp],
-                n_threads=n_threads,
-            )
+            buf = pbufs[pp]
+            try:
+                if scans[pp] is None and len(buf) > SCAN_CHUNK_BYTES:
+                    # big partition: extract through line-aligned chunks
+                    # so the span arrays are O(chunk), not O(partition)
+                    # — with all partitions parsing in PARALLEL,
+                    # whole-buffer spans multiplied to ~9 GB at the 20M
+                    # north-star scale (measured round 5)
+                    dirty, result = _chunked_clean_extract(buf, filters)
+                    if not dirty:
+                        return result
+                    # freshly-compacted data flagged dirty can only be
+                    # a hash collision: fall through to the exact path
+                return native.load_ratings_jsonl(
+                    buf, scanned=scans[pp], n_threads=n_threads, **filters
+                )
+            finally:
+                # the snapshot is parsed; release it before the other
+                # partitions finish (bounds peak RSS to live buffers)
+                pbufs[pp] = None
 
         if len(live) == 1:
             results = [load_one(live[0])]

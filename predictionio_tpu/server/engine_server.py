@@ -70,22 +70,31 @@ class _MicroBatcher:
     batched matmul also fills the MXU where single queries underuse it.
 
     ADAPTIVE: at construction one timed no-op device call measures the
-    per-dispatch cost this attachment actually pays. Waiting out the
-    window can only win when one saved dispatch is worth more than the
-    wait, so when ``dispatch <= window`` the window is BYPASSED: the
-    worker serves whatever is queued and never idle-waits (a lone query
-    pays zero added latency; batches still form naturally from requests
-    that queue behind an in-flight device call — the serialized-dispatch
-    regime where batching matters). When ``dispatch > window`` (remote
-    tunnels) the worker additionally waits up to the window to grow the
-    batch — an added latency bounded by the window, which is itself
-    below one dispatch.
+    per-dispatch cost this attachment actually pays, picking one of
+    three regimes:
+
+    - ``dispatch < MIN_DISPATCH_S`` (fast local attachments): batching
+      cannot win — there is no dispatch worth amortizing, and funneling
+      requests through one worker thread only serializes work the
+      handler threads would overlap. The batcher DISENGAGES
+      (``engaged`` False) and the route serves per-request.
+    - ``MIN_DISPATCH_S <= dispatch <= window``: drain-only batching —
+      the worker serves whatever is queued and never idle-waits (a lone
+      query pays zero added latency; batches form naturally from
+      requests that queue behind an in-flight device call).
+    - ``dispatch > window`` (remote tunnels, ~130 ms/call): the worker
+      additionally waits up to the window to grow the batch — added
+      latency bounded by the window, itself below one dispatch.
 
     Semantics are identical to per-request serving: every Algorithm has
     ``batch_predict`` (the default loops ``predict``), and
     serving/plugins/feedback still run per query. A failing batch
     retries its items individually so one bad query can't poison its
     batchmates."""
+
+    # below this measured per-dispatch cost there is nothing worth
+    # amortizing and the worker-thread funnel only costs throughput
+    MIN_DISPATCH_S = 1e-3
 
     def __init__(self, server: "EngineServer", window_ms: float,
                  max_batch: int = 64, dispatch_cost_s: float | None = None):
@@ -101,8 +110,17 @@ class _MicroBatcher:
             self._measure_dispatch() if dispatch_cost_s is None
             else dispatch_cost_s
         )
+        self.engaged = self.dispatch_cost_s >= self.MIN_DISPATCH_S
         self._window_wait = self.dispatch_cost_s > self._window
-        if not self._window_wait:
+        if not self.engaged:
+            logger.info(
+                "micro-batch: measured dispatch %.3f ms on this "
+                "attachment — below the %.1f ms floor, serving "
+                "per-request (batching disengaged)",
+                self.dispatch_cost_s * 1e3,
+                self.MIN_DISPATCH_S * 1e3,
+            )
+        elif not self._window_wait:
             logger.info(
                 "micro-batch: measured dispatch %.2f ms <= window %.1f ms "
                 "on this attachment; window bypassed (batches form only "
@@ -110,8 +128,10 @@ class _MicroBatcher:
                 self.dispatch_cost_s * 1e3,
                 window_ms,
             )
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        self._thread = None
+        if self.engaged:  # disengaged: the route never submits
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
 
     @staticmethod
     def _measure_dispatch() -> float:
@@ -161,7 +181,8 @@ class _MicroBatcher:
         # lock); let the worker finish its in-flight batch, then fail
         # whatever is still queued rather than leaving clients blocked
         # on the future timeout
-        self._thread.join(timeout=5)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
         while True:
             try:
                 _, f, _ = self._q.get_nowait()
@@ -533,7 +554,11 @@ class EngineServer:
             if not isinstance(body, dict):
                 return Response.error("request body must be a JSON object", 400)
             try:
-                if server.batcher is not None and server.batcher.active:
+                if (
+                    server.batcher is not None
+                    and server.batcher.active
+                    and server.batcher.engaged
+                ):
                     response_obj = server.batcher.submit(body).result(timeout=60)
                 else:
                     response_obj = server.handle_query(body)

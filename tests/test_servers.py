@@ -600,9 +600,10 @@ class TestMicroBatchedServing:
         server = EngineServer(
             deployed_engine["engine"], deployed_engine["server"].instance,
             storage=deployed_engine["storage"], host="127.0.0.1", port=0,
-            batch_window_ms=500.0, dispatch_cost_s=0.0,  # bypass mode
+            batch_window_ms=500.0, dispatch_cost_s=0.0,  # sub-floor
         )
-        assert server.batcher is not None and not server.batcher._window_wait
+        # below the dispatch floor the batcher disengages entirely
+        assert server.batcher is not None and not server.batcher.engaged
         port = server.start()
         try:
             http("POST", f"http://127.0.0.1:{port}/queries.json",
@@ -639,8 +640,11 @@ class TestMicroBatchedServing:
         server = EngineServer(
             engine, inst, storage=deployed_engine["storage"],
             host="127.0.0.1", port=0,
-            batch_window_ms=2.0, dispatch_cost_s=0.0,  # bypass mode
+            # 5 ms dispatch: over the 1 ms engage floor, under the
+            # 10 ms window -> drain-only natural batching
+            batch_window_ms=10.0, dispatch_cost_s=0.005,
         )
+        assert server.batcher.engaged and not server.batcher._window_wait
         algo = server.algorithms[0]
         real_bp = type(algo).batch_predict
         calls = []
