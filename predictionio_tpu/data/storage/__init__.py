@@ -162,6 +162,17 @@ def _search_backend() -> _Backend:
     )
 
 
+def _postgres_backend() -> _Backend:
+    # psycopg2-gated (like the boto3-gated s3 backend): the import error
+    # surfaces at first use with a clear message
+    from predictionio_tpu.data.storage import postgres as pg
+
+    return _Backend(
+        client_factory=lambda cfg: pg.PostgresStorageClient(cfg),
+        daos=dict(pg.DAOS),
+    )
+
+
 _BACKEND_TYPES: dict[str, Callable[[], _Backend]] = {
     "sqlite": _sqlite_backend,
     "memory": _memory_backend,
@@ -172,6 +183,7 @@ _BACKEND_TYPES: dict[str, Callable[[], _Backend]] = {
     "s3": _s3_backend,
     "http": _http_backend,
     "search": _search_backend,
+    "postgres": _postgres_backend,
 }
 
 # which repositories each backend type can serve (capability subsets,
@@ -187,6 +199,7 @@ _TYPE_CAPABILITIES: dict[str, tuple[str, ...]] = {
     "s3": (MODELDATA,),
     "http": REPOSITORIES,
     "search": REPOSITORIES,
+    "postgres": REPOSITORIES,
 }
 
 

@@ -1,0 +1,434 @@
+"""PostgreSQL storage backend — the reference's production JDBC default.
+
+The reference's default production metadata/event store is PostgreSQL
+over JDBC (storage/jdbc/src/main/scala/org/apache/predictionio/data/
+storage/jdbc/StorageClient.scala; JDBCLEvents.scala:37 for the per-app
+``pio_event_<appId>[_<channel>]`` tables). This backend completes that
+role for the registry: ``PIO_STORAGE_SOURCES_<X>_TYPE=postgres`` with
+``host``/``port``/``dbname``/``user``/``password`` (or a single ``url``
+DSN) properties.
+
+DESIGN: the DAO logic lives once, in sqlite.py — every DAO here is a
+subclass of its sqlite counterpart, connected through
+:class:`_DialectConn`, an adapter that speaks the sqlite3 surface the
+DAOs use (``execute``/``executemany``/``executescript``, transaction
+context manager, ``lastrowid``, exception classes) while translating
+the SQL dialect:
+
+- ``?`` placeholders -> ``%s`` (the DB-API ``format`` paramstyle);
+- ``INSERT OR REPLACE INTO t`` -> ``INSERT ... ON CONFLICT (id) DO
+  UPDATE SET col=EXCLUDED.col ...`` (explicit per-table column lists);
+- ``INSERT INTO pio_apps/pio_channels`` -> ``... RETURNING id`` so the
+  sqlite ``lastrowid`` contract holds for SERIAL keys;
+- undefined-table errors (SQLSTATE 42P01) -> ``sqlite3.OperationalError
+  ("no such table: ...")`` and unique violations (23505) ->
+  ``sqlite3.IntegrityError`` so the DAOs' create-on-demand and
+  duplicate-detection paths work unchanged;
+- DDL is NOT translated: postgres-specific CREATE TABLE scripts live
+  here (SERIAL keys, DOUBLE PRECISION timestamps, BYTEA models).
+
+The psycopg2 driver is imported lazily at client construction (gated,
+like the boto3-gated s3 backend); the dialect adapter itself is
+driver-agnostic DB-API and is exercised in CI against a fake driver
+backed by sqlite (tests/test_postgres.py) — the translation layer and
+every DAO path run for real there even though no postgres server is
+available in the build image.
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+import threading
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.sqlite import (
+    SQLiteAccessKeys,
+    SQLiteApps,
+    SQLiteChannels,
+    SQLiteEngineInstances,
+    SQLiteEvaluationInstances,
+    SQLiteEvents,
+    SQLiteModels,
+)
+
+# column lists for the INSERT OR REPLACE -> ON CONFLICT translation
+# (order matters only for readability; the SET list is what counts)
+_TABLE_COLUMNS = {
+    "pio_engine_instances": (
+        "id", "status", "starttime", "endtime", "engineid", "engineversion",
+        "enginevariant", "enginefactory", "batch", "env", "runtimeconf",
+        "datasourceparams", "preparatorparams", "algorithmsparams",
+        "servingparams",
+    ),
+    "pio_evaluation_instances": (
+        "id", "status", "starttime", "endtime", "evaluationclass",
+        "engineparamsgeneratorclass", "batch", "env", "runtimeconf",
+        "evaluatorresults", "evaluatorresultshtml", "evaluatorresultsjson",
+    ),
+    "pio_models": ("id", "models"),
+    # any pio_event_* table
+    "pio_event": (
+        "id", "event", "entitytype", "entityid", "targetentitytype",
+        "targetentityid", "properties", "eventtime", "eventtimezone",
+        "tags", "prid", "creationtime",
+    ),
+}
+
+_OR_REPLACE = re.compile(r"^\s*INSERT OR REPLACE INTO\s+(\S+)\s+", re.I)
+_RETURNING_TABLES = ("pio_apps", "pio_channels")
+
+
+def translate_sql(sql: str) -> str:
+    """sqlite-dialect DAO SQL -> postgres dialect (module docstring)."""
+    m = _OR_REPLACE.match(sql)
+    if m:
+        table = m.group(1)
+        key = "pio_event" if table.startswith("pio_event_") else table
+        cols = _TABLE_COLUMNS.get(key)
+        if cols is None:
+            raise ValueError(
+                f"no ON CONFLICT column list for table {table!r}"
+            )
+        sets = ", ".join(f"{c}=EXCLUDED.{c}" for c in cols if c != "id")
+        sql = (
+            f"INSERT INTO {table} {sql[m.end():]} "
+            f"ON CONFLICT (id) DO UPDATE SET {sets}"
+        )
+    sql = sql.replace("?", "%s")
+    stripped = sql.lstrip().lower()
+    if stripped.startswith("insert into") and " returning " not in stripped:
+        table = sql.split()[2].split("(")[0]
+        if table in _RETURNING_TABLES:
+            sql += " RETURNING id"
+    return sql
+
+
+def _sqlstate(err) -> str | None:
+    """SQLSTATE of a driver exception (psycopg2 exposes ``pgcode``; the
+    test fake mimics it)."""
+    return getattr(err, "pgcode", None)
+
+
+class _Cursor:
+    """Cursor shim: adds the sqlite ``lastrowid``-from-RETURNING trick."""
+
+    def __init__(self, cur, returned_id=None):
+        self._cur = cur
+        self.lastrowid = returned_id
+
+    @property
+    def rowcount(self):
+        return self._cur.rowcount
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+    def fetchmany(self, n):
+        return self._cur.fetchmany(n)
+
+
+class _DialectConn:
+    """The sqlite3-connection surface the DAOs drive, over a DB-API
+    postgres connection (module docstring)."""
+
+    def __init__(self, conn):
+        self._conn = conn
+        self.total_changes = 0  # only read via change_token, overridden
+
+    def __enter__(self):
+        self._conn.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._conn.__exit__(*exc)
+
+    def _run(self, method, sql, arg):
+        tsql = translate_sql(sql)
+        cur = self._conn.cursor()
+        try:
+            getattr(cur, method)(tsql, arg)
+        except Exception as err:  # translate driver errors (docstring)
+            state = _sqlstate(err)
+            if state == "42P01":
+                # undefined_table: the transaction is aborted — roll it
+                # back so the DAO's create-and-retry can proceed (the
+                # retry re-enters `with conn`, starting a fresh one)
+                self._conn.rollback()
+                raise sqlite3.OperationalError(
+                    f"no such table: {err}"
+                ) from err
+            if state is not None and state.startswith("23"):
+                self._conn.rollback()
+                raise sqlite3.IntegrityError(str(err)) from err
+            raise
+        returned = None
+        if tsql.endswith(" RETURNING id"):
+            row = cur.fetchone()
+            returned = row[0] if row else None
+        if not tsql.lstrip().lower().startswith("select"):
+            self.total_changes += max(0, cur.rowcount)
+        return _Cursor(cur, returned)
+
+    def execute(self, sql: str, params: tuple | list = ()):  # noqa: A003
+        if sql.lstrip().upper().startswith("PRAGMA"):
+            return _Cursor(self._conn.cursor())  # sqlite-only; no-op
+        return self._run("execute", sql, tuple(params))
+
+    def executemany(self, sql: str, rows) -> None:
+        self._run("executemany", sql, list(rows))
+
+    def executescript(self, script: str) -> None:
+        # DDL scripts only (no string literals containing ';')
+        for stmt in script.split(";"):
+            if stmt.strip():
+                self.execute(stmt)
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class PostgresStorageClient:
+    """One postgres connection shared by all DAOs of this source
+    (serialized by ``lock``, like the sqlite client)."""
+
+    def __init__(self, config: dict | None = None, connection=None):
+        self.config = config or {}
+        self.lock = threading.RLock()
+        self.ddl_bump = 0
+        if connection is None:
+            connection = self._connect(self.config)
+        self.conn = _DialectConn(connection)
+        self._init_meta_tables()
+
+    @staticmethod
+    def _connect(config: dict):
+        try:
+            import psycopg2
+        except ImportError as e:  # pragma: no cover - driver-gated
+            raise ImportError(
+                "the postgres storage backend needs psycopg2 "
+                "(PIO_STORAGE_SOURCES_<X>_TYPE=postgres); install "
+                "psycopg2-binary or switch the source type to sqlite"
+            ) from e
+        if config.get("url"):
+            return psycopg2.connect(config["url"])
+        return psycopg2.connect(
+            host=config.get("host", "localhost"),
+            port=int(config.get("port", 5432)),
+            dbname=config.get("dbname", "pio"),
+            user=config.get("user", "pio"),
+            password=config.get("password", "pio"),
+        )
+
+    def query(self, sql: str, params: tuple | list = ()) -> list:
+        with self.lock:
+            cur = self.conn.execute(sql, params)
+            rows = cur.fetchall()
+            self.conn.commit()  # leave no idle-in-transaction reads
+            return rows
+
+    def query_one(self, sql: str, params: tuple | list = ()):
+        rows = self.query(sql, params)
+        return rows[0] if rows else None
+
+    def _init_meta_tables(self) -> None:
+        with self.lock, self.conn:
+            self.conn.executescript(
+                """
+                CREATE TABLE IF NOT EXISTS pio_apps (
+                  id SERIAL PRIMARY KEY,
+                  name TEXT NOT NULL UNIQUE,
+                  description TEXT);
+                CREATE TABLE IF NOT EXISTS pio_access_keys (
+                  accesskey TEXT PRIMARY KEY,
+                  appid INTEGER NOT NULL,
+                  events TEXT NOT NULL);
+                CREATE TABLE IF NOT EXISTS pio_channels (
+                  id SERIAL PRIMARY KEY,
+                  name TEXT NOT NULL,
+                  appid INTEGER NOT NULL,
+                  UNIQUE(name, appid));
+                CREATE TABLE IF NOT EXISTS pio_engine_instances (
+                  id TEXT PRIMARY KEY,
+                  status TEXT NOT NULL,
+                  starttime DOUBLE PRECISION NOT NULL,
+                  endtime DOUBLE PRECISION NOT NULL,
+                  engineid TEXT NOT NULL,
+                  engineversion TEXT NOT NULL,
+                  enginevariant TEXT NOT NULL,
+                  enginefactory TEXT NOT NULL,
+                  batch TEXT,
+                  env TEXT,
+                  runtimeconf TEXT,
+                  datasourceparams TEXT,
+                  preparatorparams TEXT,
+                  algorithmsparams TEXT,
+                  servingparams TEXT);
+                CREATE TABLE IF NOT EXISTS pio_evaluation_instances (
+                  id TEXT PRIMARY KEY,
+                  status TEXT NOT NULL,
+                  starttime DOUBLE PRECISION NOT NULL,
+                  endtime DOUBLE PRECISION NOT NULL,
+                  evaluationclass TEXT,
+                  engineparamsgeneratorclass TEXT,
+                  batch TEXT,
+                  env TEXT,
+                  runtimeconf TEXT,
+                  evaluatorresults TEXT,
+                  evaluatorresultshtml TEXT,
+                  evaluatorresultsjson TEXT);
+                CREATE TABLE IF NOT EXISTS pio_models (
+                  id TEXT PRIMARY KEY,
+                  models BYTEA NOT NULL);
+                """
+            )
+
+    def close(self) -> None:
+        with self.lock:
+            self.conn.close()
+
+
+def _advance_serial(client, table: str) -> None:
+    """After an explicit-id insert, lift the SERIAL sequence past it —
+    sqlite's AUTOINCREMENT never reuses explicit ids, and without this a
+    later auto-id insert would collide with the restored row."""
+    with client.lock:
+        client.query(
+            f"SELECT setval(pg_get_serial_sequence('{table}', 'id'), "
+            f"(SELECT COALESCE(MAX(id), 1) FROM {table}))"
+        )
+
+
+class PostgresApps(SQLiteApps):
+    def insert(self, app: base.App) -> int | None:
+        got = super().insert(app)
+        if got is not None and app.id != 0:
+            _advance_serial(self._c, "pio_apps")
+        return got
+
+
+class PostgresAccessKeys(SQLiteAccessKeys):
+    pass
+
+
+class PostgresChannels(SQLiteChannels):
+    def insert(self, channel: base.Channel) -> int | None:
+        got = super().insert(channel)
+        if got is not None and channel.id != 0:
+            _advance_serial(self._c, "pio_channels")
+        return got
+
+
+class PostgresEngineInstances(SQLiteEngineInstances):
+    pass
+
+
+class PostgresEvaluationInstances(SQLiteEvaluationInstances):
+    pass
+
+
+class PostgresModels(SQLiteModels):
+    def get(self, model_id: str) -> base.Model | None:
+        got = super().get(model_id)
+        if got is None:
+            return None
+        # psycopg2 returns BYTEA as memoryview
+        return base.Model(got.id, bytes(got.models))
+
+
+class PostgresEvents(SQLiteEvents):
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        t = self._table(app_id, channel_id)
+        with self._c.lock, self._c.conn:
+            self._c.conn.executescript(
+                f"""
+                CREATE TABLE IF NOT EXISTS {t} (
+                  id TEXT PRIMARY KEY,
+                  event TEXT NOT NULL,
+                  entitytype TEXT NOT NULL,
+                  entityid TEXT NOT NULL,
+                  targetentitytype TEXT,
+                  targetentityid TEXT,
+                  properties TEXT,
+                  eventtime DOUBLE PRECISION NOT NULL,
+                  eventtimezone TEXT,
+                  tags TEXT,
+                  prid TEXT,
+                  creationtime DOUBLE PRECISION NOT NULL);
+                CREATE INDEX IF NOT EXISTS {t}_time ON {t} (eventtime);
+                CREATE INDEX IF NOT EXISTS {t}_entity
+                  ON {t} (entitytype, entityid)
+                """
+            )
+        return True
+
+    @staticmethod
+    def _rating_value_col(rating_key: str) -> tuple[str, list]:
+        # jsonb_typeof 'number' covers ints and floats and excludes
+        # booleans — same semantics as the sqlite json_type filter; the
+        # key rides as a bound parameter (used twice), no quoting rules
+        return (
+            "CASE WHEN jsonb_typeof((properties::jsonb) -> ?) = 'number' "
+            "THEN ((properties::jsonb) ->> ?)::float8 ELSE NULL END",
+            [rating_key, rating_key],
+        )
+
+    def batch_insert(
+        self, events, app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        """One ON CONFLICT DO UPDATE statement cannot touch a row twice
+        (SQLSTATE 21000), so duplicate explicit event_ids within a batch
+        are deduped last-wins first — the result the sqlite/jsonl
+        replace semantics produce for the same input."""
+        events = list(events)
+        explicit = {}
+        generated = []
+        for e in events:
+            if e.event_id:
+                explicit[e.event_id] = e  # last occurrence wins
+            else:
+                generated.append(e)
+        if len(explicit) + len(generated) == len(events):
+            return super().batch_insert(events, app_id, channel_id)
+        deduped = generated + list(explicit.values())
+        got = super().batch_insert(deduped, app_id, channel_id)
+        gen_ids = iter(got[: len(generated)])
+        return [e.event_id if e.event_id else next(gen_ids) for e in events]
+
+    def scan_ratings(self, *args, **kwargs) -> base.RatingsBatch:
+        try:
+            return super().scan_ratings(*args, **kwargs)
+        finally:
+            # the inherited scan reads through conn.execute directly;
+            # without this commit the shared connection would sit
+            # idle-in-transaction (pinning vacuum) until the next write
+            with self._c.lock:
+                self._c.conn.commit()
+
+    def change_token(
+        self, app_id: int, channel_id: int | None = None
+    ) -> object | None:
+        """WAL position + this client's DDL bump: any committed write to
+        the cluster advances the LSN (over-invalidation across apps is
+        allowed by the contract, like the sqlite token)."""
+        with self._c.lock:
+            row = self._c.query_one("SELECT pg_current_wal_lsn()::text")
+            return (row[0] if row else None, self._c.ddl_bump)
+
+
+DAOS = {
+    "Apps": PostgresApps,
+    "AccessKeys": PostgresAccessKeys,
+    "Channels": PostgresChannels,
+    "EngineInstances": PostgresEngineInstances,
+    "EvaluationInstances": PostgresEvaluationInstances,
+    "Models": PostgresModels,
+    "Events": PostgresEvents,
+}

@@ -636,6 +636,22 @@ class SQLiteEvents(base.Events):
                 raise
             return cur.rowcount > 0
 
+    @staticmethod
+    def _rating_value_col(rating_key: str) -> tuple[str, list]:
+        """(SELECT expression, its bound params) extracting the numeric
+        rating from the properties JSON — the SQL-dialect hook the
+        postgres backend overrides. JSON booleans extract as integers
+        1/0 in sqlite, but the base/jsonl backends reject booleans (fall
+        back to the event-name default) — parity requires the same."""
+        if '"' in rating_key:
+            raise ValueError("rating_key must not contain double quotes")
+        path_expr = f"properties, '$.\"{rating_key}\"'"
+        return (
+            f"CASE WHEN json_type({path_expr}) IN ('integer', 'real') "
+            f"THEN json_extract({path_expr}) ELSE NULL END",
+            [],
+        )
+
     def scan_ratings(
         self,
         app_id: int,
@@ -655,8 +671,6 @@ class SQLiteEvents(base.Events):
         storage/jdbc/.../JDBCPEvents.scala:91)."""
         import numpy as np
 
-        if rating_key is not None and '"' in rating_key:
-            raise ValueError("rating_key must not contain double quotes")
         t = self._table(app_id, channel_id)
         clauses, params = ["targetentityid IS NOT NULL"], []
         if entity_type is not None:
@@ -672,20 +686,14 @@ class SQLiteEvents(base.Events):
             clauses.append("event IN (" + ",".join("?" * len(event_names)) + ")")
             params.extend(event_names)
         if rating_key is None:
-            value_col = "NULL"  # pure implicit: event-name defaults only
+            value_col, vparams = "NULL", []  # implicit: name defaults only
         else:
-            # json_type filter: JSON booleans extract as integers 1/0 in
-            # sqlite, but the base/jsonl backends reject booleans (fall
-            # back to the event-name default) — parity requires the same
-            path_expr = f"properties, '$.\"{rating_key}\"'"
-            value_col = (
-                f"CASE WHEN json_type({path_expr}) IN ('integer', 'real') "
-                f"THEN json_extract({path_expr}) ELSE NULL END"
-            )
+            value_col, vparams = self._rating_value_col(rating_key)
         sql = (
             f"SELECT entityid, targetentityid, event, {value_col} "
             f"FROM {t} WHERE " + " AND ".join(clauses)
         )
+        params = vparams + params  # value_col placeholders come first
         user_map: dict[str, int] = {}
         item_map: dict[str, int] = {}
         rows: list[int] = []
