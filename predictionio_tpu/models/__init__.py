@@ -4,5 +4,10 @@ Parity targets (SURVEY §2.7): the reference's maintained template families
 — recommendation (explicit ALS), classification (NaiveBayes),
 similar-product (implicit ALS + item-item cosine), e-commerce
 recommendation (weighted implicit ALS + serve-time business rules) — all
-re-founded on the TPU ops in ``predictionio_tpu.ops``.
+re-founded on the TPU ops in ``predictionio_tpu.ops``; plus the
+experimental example engines: linear regression (OLS, scala-local/
+parallel-regression), friend recommendation (keyword similarity +
+dense-matmul SimRank, scala-local/parallel-friend-recommendation), and
+stock backtesting (vmapped per-ticker regressions + NAV accounting,
+scala-stock).
 """

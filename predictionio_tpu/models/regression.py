@@ -1,0 +1,235 @@
+"""Linear-regression engine template (the experimental example engines).
+
+Capability parity with the reference's regression examples:
+
+- ``examples/experimental/scala-local-regression/Run.scala`` —
+  LocalDataSource reads space-separated ``y x1 x2 ...`` lines from a
+  file; LocalPreparator drops every ``index % n == k`` row (the k-fold
+  hook); LocalAlgorithm fits ordinary least squares
+  (``LinearRegression.regress``) and predicts the dot product;
+  evaluated with ``MeanSquareError``.
+- ``examples/experimental/scala-parallel-regression`` — the same
+  pipeline on Spark RDDs.
+
+TPU-first: the OLS fit is a closed-form normal-equation solve —
+``X^T X`` is one ``[R, C] x [R, C]`` MXU matmul and the solve is a
+Cholesky on device; ``batch_predict`` scores all queries in one
+``[B, C] @ [C]`` matvec. Data comes from either the reference's file
+format (``filepath``) or the event store (``datapoint`` events carrying
+``label`` + ``features`` properties).
+
+Query: ``{"features": [d, ...]}`` -> ``{"prediction": d}``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.core.metrics import AverageMetric
+from predictionio_tpu.data import store
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Query:
+    features: list[float] = field(default_factory=list)
+
+
+@dataclass
+class PredictedResult:
+    prediction: float = 0.0
+
+
+@dataclass
+class DataSourceParams(Params):
+    # file mode: the reference's space-separated "y x1 x2 ..." lines
+    # (scala-local-regression Run.scala LocalDataSource)
+    filepath: str = ""
+    # event mode: one event per data point with label/features properties
+    app_name: str = ""
+    event_name: str = "datapoint"
+    label_name: str = "label"
+    features_name: str = "features"
+    seed: int = 9527
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    x: np.ndarray = field(default_factory=lambda: np.zeros((0, 0), np.float32))
+    y: np.ndarray = field(default_factory=lambda: np.zeros(0, np.float32))
+
+    def sanity_check(self) -> None:
+        if len(self.y) == 0:
+            raise ValueError("TrainingData has no data points")
+        if self.x.shape[0] != len(self.y):
+            raise ValueError("x/y row mismatch")
+
+
+class RegressionDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def _read_points(self) -> TrainingData:
+        if self.params.filepath:
+            xs, ys = [], []
+            with open(self.params.filepath) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    ys.append(float(parts[0]))
+                    xs.append([float(v) for v in parts[1:]])
+            return TrainingData(
+                x=np.asarray(xs, dtype=np.float32),
+                y=np.asarray(ys, dtype=np.float32),
+            )
+        events = store.find(
+            app_name=self.params.app_name,
+            event_names=[self.params.event_name],
+            limit=None,
+        )
+        xs, ys = [], []
+        for e in events:
+            try:
+                # parse BOTH before appending either: a valid label with
+                # malformed features must skip the event, not desync x/y
+                label = float(e.properties[self.params.label_name])
+                row = [float(v) for v in e.properties[self.params.features_name]]
+            except Exception:
+                logger.warning("skipping malformed datapoint %s", e.event_id)
+                continue
+            ys.append(label)
+            xs.append(row)
+        return TrainingData(
+            x=np.asarray(xs, dtype=np.float32),
+            y=np.asarray(ys, dtype=np.float32),
+        )
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        return self._read_points()
+
+    def read_eval(self, ctx: WorkflowContext):
+        # one eval set over the training points (the reference's
+        # LocalDataSource returns the same rows as (q, a) pairs and
+        # delegates fold selection to the Preparator's (n, k) rule)
+        td = self._read_points()
+        qa = [
+            (Query(features=row.tolist()), float(label))
+            for row, label in zip(td.x, td.y)
+        ]
+        return [(td, None, qa)]
+
+
+@dataclass
+class PreparatorParams(Params):
+    # drop rows with index % n == k (n = 0 keeps everything) — the
+    # reference LocalPreparator's leave-fold-out rule
+    n: int = 0
+    k: int = 0
+
+
+class RegressionPreparator(Preparator):
+    params_class = PreparatorParams
+
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> TrainingData:
+        # engine params may leave the preparator unparameterized
+        # (EmptyParams): keep everything, like n = 0
+        n = getattr(self.params, "n", 0)
+        k = getattr(self.params, "k", 0)
+        if n <= 0:
+            return td
+        idx = np.arange(len(td.y))
+        keep = (idx % n) != k
+        return TrainingData(x=td.x[keep], y=td.y[keep])
+
+
+@jax.jit
+def _ols_fit(x, y):
+    """Closed-form OLS via the normal equations: X^T X is the MXU
+    matmul, the solve a small Cholesky (ridge epsilon keeps rank-
+    deficient fixtures solvable)."""
+    xtx = x.T @ x + 1e-6 * jnp.eye(x.shape[1], dtype=x.dtype)
+    xty = x.T @ y
+    chol = jax.scipy.linalg.cho_factor(xtx, lower=True)
+    return jax.scipy.linalg.cho_solve(chol, xty)
+
+
+@dataclass
+class RegressionModel:
+    coefficients: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.float32)
+    )
+
+
+class OLSAlgorithm(Algorithm):
+    query_class = Query
+
+    def train(self, ctx: WorkflowContext, td: TrainingData) -> RegressionModel:
+        w = _ols_fit(td.x, td.y)
+        return RegressionModel(coefficients=np.asarray(w))
+
+    def predict(self, model: RegressionModel, query: Query) -> PredictedResult:
+        q = np.asarray(query.features, dtype=np.float32)
+        if q.shape != model.coefficients.shape:
+            raise ValueError(
+                f"query has {q.shape[0]} features; model expects "
+                f"{model.coefficients.shape[0]}"
+            )
+        return PredictedResult(
+            prediction=float(q @ model.coefficients)
+        )
+
+    def batch_predict(self, model: RegressionModel, indexed_queries):
+        queries = [q for _, q in indexed_queries]
+        qm = np.asarray([q.features for q in queries], dtype=np.float32)
+        if qm.size and qm.shape[1] == model.coefficients.shape[0]:
+            scores = qm @ model.coefficients  # one matvec for the batch
+            return [
+                (i, PredictedResult(prediction=float(s)))
+                for (i, _), s in zip(indexed_queries, scores)
+            ]
+        return [(i, self.predict(model, q)) for i, q in indexed_queries]
+
+
+class MeanSquareError(AverageMetric):
+    """Reference ``controller.MeanSquareError``: mean of squared errors,
+    lower is better (best-pick uses the metric's ordering)."""
+
+    smaller_is_better = True
+
+    def calculate_point(self, q, p, a) -> float:
+        err = p.prediction - float(a)
+        return err * err
+
+
+def engine() -> Engine:
+    """Reference RegressionEngineFactory (scala-local-regression
+    Run.scala: LocalDataSource -> LocalPreparator -> LocalAlgorithm ->
+    LFirstServing)."""
+    return Engine(
+        datasource_classes=RegressionDataSource,
+        preparator_classes=RegressionPreparator,
+        algorithm_classes={"ols": OLSAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+def evaluation() -> Evaluation:
+    """MSE evaluation (the reference Run.scala wires MeanSquareError)."""
+    return Evaluation(engine=engine(), metric=MeanSquareError())

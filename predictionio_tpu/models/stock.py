@@ -1,0 +1,509 @@
+"""Stock backtesting engine template (experimental scala-stock).
+
+Capability parity with ``examples/experimental/scala-stock``:
+
+- ``DataSource.scala`` — price/active frames per ticker aligned on the
+  market ticker's timeline, rolling (training window, testing window)
+  splits driven by ``fromIdx``/``untilIdx``/``trainingWindowSize``/
+  ``maxTestingWindowSize`` (DataSource.scala:56-62; Run.scala:120-127
+  uses SPY, fromIdx 300, window 200/20),
+- ``Indicators.scala`` — RSIIndicator (14-period RSI over log-price
+  returns, leading window filled with 50) and ShiftsIndicator
+  (period-day log return),
+- ``RegressionStrategy.scala`` — per-ticker OLS of the 1-day-forward
+  return on the indicator features plus a bias, predictions scored as
+  ``coef . latest-features``,
+- ``BackTestingMetrics.scala`` — enter/exit thresholds, bounded
+  position count, cash/NAV accounting, OverallStat(ret, vol, sharpe).
+
+TPU-first redesign: every indicator is a vectorized rolling op over the
+whole ``[days, tickers]`` log-price matrix, and ALL tickers' regressions
+solve in ONE batched normal-equation program (``vmap`` over the ticker
+axis — the MXU replaces the reference's per-ticker ``nak`` regress
+loop, RegressionStrategy.scala:72-86). The backtest's daily cash/
+position bookkeeping stays host-side Python — it is sequential
+accounting, not compute.
+
+Query: ``{"tickers": [...]}`` -> ``{"data": {ticker: predicted 1-day
+log return}}`` scored on the latest training window in the model.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data import store
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Query:
+    tickers: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PredictedResult:
+    data: dict = field(default_factory=dict)  # ticker -> predicted return
+
+
+@dataclass
+class DataSourceParams(Params):
+    """Reference DataSourceParams (DataSource.scala:56-62); data comes
+    from the event store instead of a Yahoo fetch: one ``$set`` per
+    ticker entity carrying parallel ``prices``/``ts`` arrays (the shape
+    YahooDataSource.scala builds before framing)."""
+
+    app_name: str = ""
+    entity_type: str = "yahoo"
+    market_ticker: str = "SPY"
+    ticker_list: tuple[str, ...] = ()
+    from_idx: int = 0  # first testing day
+    until_idx: int = 0  # last testing day (exclusive; 0 = end of data)
+    training_window_size: int = 200
+    max_testing_window_size: int = 20
+
+
+@dataclass
+class RawStockData(SanityCheck):
+    tickers: list[str] = field(default_factory=list)
+    times: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    price: np.ndarray = field(
+        default_factory=lambda: np.zeros((0, 0), np.float32)
+    )  # [days, tickers]
+    active: np.ndarray = field(
+        default_factory=lambda: np.zeros((0, 0), bool)
+    )  # [days, tickers]
+    market_ticker: str = "SPY"
+
+    def sanity_check(self) -> None:
+        if self.price.size == 0:
+            raise ValueError("no price data")
+        if self.market_ticker not in self.tickers:
+            raise ValueError(
+                f"market ticker {self.market_ticker!r} missing from data"
+            )
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    """A window view: train on [until_idx - window, until_idx)."""
+
+    raw: RawStockData = field(default_factory=RawStockData)
+    until_idx: int = 0
+    window: int = 0
+
+    def sanity_check(self) -> None:
+        self.raw.sanity_check()
+
+    def price_window(self) -> np.ndarray:
+        lo = max(0, self.until_idx - self.window)
+        return self.raw.price[lo : self.until_idx]
+
+    def active_window(self) -> np.ndarray:
+        lo = max(0, self.until_idx - self.window)
+        return self.raw.active[lo : self.until_idx]
+
+
+@dataclass
+class QueryDate:
+    """Backtest query: score day ``idx`` (reference QueryDate)."""
+
+    idx: int = 0
+
+
+class StockDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def _read_raw(self) -> RawStockData:
+        p = self.params
+        props = store.aggregate_properties(
+            app_name=p.app_name, entity_type=p.entity_type
+        )
+        series: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        for ticker, pm in props.items():
+            if p.ticker_list and ticker not in (
+                *p.ticker_list, p.market_ticker
+            ):
+                continue
+            try:
+                prices = np.asarray(pm.get_opt("prices", default=[]), np.float32)
+                ts = np.asarray(pm.get_opt("ts", default=[]), np.int64)
+            except Exception:
+                logger.warning("skipping malformed ticker %s", ticker)
+                continue
+            if len(prices) and len(prices) == len(ts):
+                series[ticker] = (ts, prices)
+        if p.market_ticker not in series:
+            raise ValueError(
+                f"market ticker {p.market_ticker!r} not found in app "
+                f"{p.app_name!r}"
+            )
+        # align every ticker on the MARKET ticker's timeline (reference
+        # YahooDataSource merge semantics): missing days are inactive
+        # and carry the last seen price
+        mkt_ts = series[p.market_ticker][0]
+        tickers = [p.market_ticker] + sorted(
+            t for t in series if t != p.market_ticker
+        )
+        days = len(mkt_ts)
+        price = np.ones((days, len(tickers)), np.float32)
+        active = np.zeros((days, len(tickers)), bool)
+        for j, t in enumerate(tickers):
+            ts, prices = series[t]
+            pos = {int(v): i for i, v in enumerate(ts)}
+            last = prices[0] if len(prices) else 1.0
+            for d, mv in enumerate(mkt_ts):
+                i = pos.get(int(mv))
+                if i is not None:
+                    last = prices[i]
+                    active[d, j] = True
+                price[d, j] = last
+        return RawStockData(
+            tickers=tickers,
+            times=mkt_ts,
+            price=price,
+            active=active,
+            market_ticker=p.market_ticker,
+        )
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        raw = self._read_raw()
+        p = self.params
+        until = p.until_idx if p.until_idx > 0 else len(raw.times)
+        return TrainingData(
+            raw=raw, until_idx=until, window=p.training_window_size
+        )
+
+    def read_eval(self, ctx: WorkflowContext):
+        """Rolling splits (DataSource.scala): testing sets step from
+        from_idx to until_idx by max_testing_window_size; each trains on
+        the preceding training_window_size days. Actuals are None — the
+        backtest evaluator scores the daily decisions."""
+        raw = self._read_raw()
+        p = self.params
+        until = p.until_idx if p.until_idx > 0 else len(raw.times)
+        sets = []
+        i = p.from_idx
+        while i < until:
+            hi = min(i + p.max_testing_window_size, until)
+            td = TrainingData(
+                raw=raw, until_idx=i, window=p.training_window_size
+            )
+            qa = [(QueryDate(idx=d), None) for d in range(i, hi)]
+            sets.append((td, raw, qa))
+            i = hi
+        return sets
+
+
+# ---------------------------------------------------------------------------
+# Indicators: vectorized over the whole [W, T] window
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Indicator:
+    """One feature column; ``kind`` picks the formula
+    (Indicators.scala RSIIndicator / ShiftsIndicator)."""
+
+    kind: str = "shifts"  # "rsi" | "shifts"
+    period: int = 5
+
+    @property
+    def min_window(self) -> int:
+        return self.period + 1
+
+
+def _shifts(logp, period):
+    """[W, T] period-day log return, leading rows 0
+    (ShiftsIndicator.getRet)."""
+    shifted = jnp.concatenate([logp[:period], logp[:-period]], axis=0) \
+        if period < logp.shape[0] else logp
+    out = logp - shifted
+    return out.at[:period].set(0.0)
+
+
+def _rsi(logp, period):
+    """[W, T] RSI over 1-day log returns, leading rows 50
+    (RSIIndicator: RS = avg gain / avg loss over the trailing period)."""
+    ret = _shifts(logp, 1)
+    gain = jnp.maximum(ret, 0.0)
+    loss = jnp.maximum(-ret, 0.0)
+    # trailing moving averages via cumulative sums
+    def trail(x):
+        c = jnp.cumsum(x, axis=0)
+        lead = jnp.concatenate([jnp.zeros_like(c[:period]), c[:-period]], 0)
+        return (c - lead) / period
+
+    rs = trail(gain) / jnp.maximum(trail(loss), 1e-9)
+    rsi = 100.0 - 100.0 / (1.0 + rs)
+    return rsi.at[: period + 1].set(50.0)
+
+
+def indicator_matrix(logp, indicators: tuple[Indicator, ...]):
+    """[W, T, F] feature stack for the window."""
+    cols = []
+    for ind in indicators:
+        if ind.kind == "rsi":
+            cols.append(_rsi(logp, ind.period))
+        elif ind.kind == "shifts":
+            cols.append(_shifts(logp, ind.period))
+        else:
+            raise ValueError(f"unknown indicator kind {ind.kind!r}")
+    return jnp.stack(cols, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Regression strategy: all tickers' OLS in one batched program
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RegressionStrategyParams(Params):
+    """RegressionStrategyParams (RegressionStrategy.scala:44-47); the
+    indicator tuples are (kind, period) pairs."""
+
+    indicators: tuple = (("rsi", 14), ("shifts", 1), ("shifts", 5))
+    max_training_window_size: int = 200
+
+
+@dataclass
+class StockModel:
+    raw: RawStockData
+    until_idx: int
+    window: int
+    indicators: tuple[Indicator, ...]
+    coef: np.ndarray  # [T, F+1] per-ticker OLS coefficients
+    trained_mask: np.ndarray  # [T] tickers active through the window
+
+
+@functools.partial(jax.jit, static_argnames=("indicators", "skip"))
+def _fit_all_tickers(logp, indicators: tuple[Indicator, ...], skip: int):
+    """Per-ticker OLS of the 1-day forward return on the indicator
+    features + bias — every ticker in ONE vmapped batched solve
+    (the reference regresses tickers serially,
+    RegressionStrategy.scala:101-112)."""
+    feats = indicator_matrix(logp, indicators)  # [W, T, F]
+    fwd = jnp.concatenate([logp[1:] - logp[:-1], jnp.zeros_like(logp[:1])], 0)
+    # rows: skip the indicator warmup and the last (no forward return)
+    x = feats[skip:-1]  # [W', T, F]
+    y = fwd[skip:-1]  # [W', T]
+    ones = jnp.ones_like(x[..., :1])
+    xb = jnp.concatenate([x, ones], axis=-1)  # [W', T, F+1]
+
+    def one(xt, yt):  # [W', F+1], [W']
+        a = xt.T @ xt + 1e-6 * jnp.eye(xt.shape[1], dtype=xt.dtype)
+        b = xt.T @ yt
+        chol = jax.scipy.linalg.cho_factor(a, lower=True)
+        return jax.scipy.linalg.cho_solve(chol, b)
+
+    return jax.vmap(one, in_axes=(1, 1))(xb, y)  # [T, F+1]
+
+
+@functools.partial(jax.jit, static_argnames=("indicators",))
+def _latest_features(logp, indicators: tuple[Indicator, ...]):
+    feats = indicator_matrix(logp, indicators)  # [W, T, F]
+    last = feats[-1]  # [T, F]
+    return jnp.concatenate([last, jnp.ones_like(last[:, :1])], axis=-1)
+
+
+class RegressionStrategy(Algorithm):
+    query_class = Query
+    params_class = RegressionStrategyParams
+
+    def _indicators(self) -> tuple[Indicator, ...]:
+        return tuple(
+            Indicator(kind=k, period=int(p)) for k, p in self.params.indicators
+        )
+
+    def train(self, ctx: WorkflowContext, td: TrainingData) -> StockModel:
+        indicators = self._indicators()
+        window = min(td.window, self.params.max_training_window_size)
+        td = TrainingData(raw=td.raw, until_idx=td.until_idx, window=window)
+        pw = td.price_window()
+        aw = td.active_window()
+        skip = max(i.min_window for i in indicators) + 2
+        if pw.shape[0] <= skip + 1:
+            raise ValueError(
+                f"window {pw.shape[0]} too short for indicators (need "
+                f"> {skip + 1} days)"
+            )
+        logp = jnp.log(jnp.asarray(pw))
+        coef = np.asarray(_fit_all_tickers(logp, indicators, skip))
+        # only tickers active through the whole window carry a model
+        # (RegressionStrategy.createModel's active filter)
+        return StockModel(
+            raw=td.raw,
+            until_idx=td.until_idx,
+            window=window,
+            indicators=indicators,
+            coef=coef,
+            trained_mask=aw.all(axis=0),
+        )
+
+    def _scores_at(self, model: StockModel, until_idx: int) -> dict[str, float]:
+        lo = max(0, until_idx - model.window)
+        logp = jnp.log(jnp.asarray(model.raw.price[lo:until_idx]))
+        feats = np.asarray(_latest_features(logp, model.indicators))
+        preds = (feats * model.coef).sum(axis=1)
+        return {
+            t: float(preds[j])
+            for j, t in enumerate(model.raw.tickers)
+            if model.trained_mask[j]
+        }
+
+    def predict(self, model: StockModel, query) -> PredictedResult:
+        if isinstance(query, QueryDate):  # backtest path
+            scores = self._scores_at(model, query.idx + 1)
+            return PredictedResult(data=scores)
+        scores = self._scores_at(model, model.until_idx)
+        keep = set(query.tickers) if query.tickers else None
+        return PredictedResult(
+            data={
+                t: s
+                for t, s in scores.items()
+                if keep is None or t in keep
+            }
+        )
+
+
+# ---------------------------------------------------------------------------
+# Backtesting (BackTestingMetrics.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BacktestingParams(Params):
+    enter_threshold: float = 0.001
+    exit_threshold: float = 0.0
+    max_positions: int = 3
+
+
+@dataclass
+class DailyStat:
+    time: int
+    nav: float
+    ret: float
+    market: float
+    position_count: int
+
+
+@dataclass
+class OverallStat:
+    ret: float
+    vol: float
+    sharpe: float
+    days: int
+
+
+@dataclass
+class BacktestingResult:
+    daily: list[DailyStat]
+    overall: OverallStat
+
+
+def backtest(
+    raw: RawStockData,
+    daily_predictions: list[tuple[int, dict[str, float]]],
+    params: BacktestingParams,
+) -> BacktestingResult:
+    """Cash/position bookkeeping over the predicted days
+    (BacktestingEvaluator.evaluateAll): enter the highest-scored tickers
+    above the enter threshold into at most ``max_positions`` equal-cash
+    slots, exit below the exit threshold, mark positions to market
+    daily, then summarize NAV returns (annualized vol/sharpe)."""
+    tix = {t: j for j, t in enumerate(raw.tickers)}
+    init_cash = 1_000_000.0
+    cash = init_cash
+    positions: dict[str, float] = {}
+    daily_stats: list[DailyStat] = []
+    for day_idx, preds in sorted(daily_predictions):
+        ranked = sorted(preds.items(), key=lambda kv: -kv[1])
+        to_enter = [t for t, p in ranked if p >= params.enter_threshold]
+        to_exit = [t for t, p in ranked if p <= params.exit_threshold]
+        if day_idx > 0:
+            for t in positions:
+                j = tix[t]
+                positions[t] *= float(
+                    raw.price[day_idx, j] / raw.price[day_idx - 1, j]
+                )
+        for t in to_exit:
+            if t in positions:
+                cash += positions.pop(t)
+        slack = params.max_positions - len(positions)
+        if slack > 0:
+            money = cash / slack
+            for t in [t for t in to_enter if t not in positions][:slack]:
+                cash -= money
+                positions[t] = money
+        nav = cash + sum(positions.values())
+        ret = (
+            0.0
+            if not daily_stats
+            else (nav - daily_stats[-1].nav) / daily_stats[-1].nav
+        )
+        daily_stats.append(
+            DailyStat(
+                time=int(raw.times[day_idx]),
+                nav=nav,
+                ret=ret,
+                market=float(raw.price[day_idx, tix[raw.market_ticker]]),
+                position_count=len(positions),
+            )
+        )
+    rets = np.asarray([d.ret for d in daily_stats[1:]], np.float64)
+    vol = float(rets.std()) if rets.size else 0.0
+    mean = float(rets.mean()) if rets.size else 0.0
+    overall = OverallStat(
+        ret=(daily_stats[-1].nav / init_cash - 1.0) if daily_stats else 0.0,
+        vol=float(vol * np.sqrt(252)),
+        sharpe=float(mean / vol * np.sqrt(252)) if vol > 0 else 0.0,
+        days=len(daily_stats),
+    )
+    return BacktestingResult(daily=daily_stats, overall=overall)
+
+
+def run_backtest(
+    ctx: WorkflowContext,
+    datasource_params: DataSourceParams,
+    strategy_params: RegressionStrategyParams,
+    backtesting_params: BacktestingParams,
+) -> BacktestingResult:
+    """The reference Run.scala flow: rolling retrain windows, daily
+    predictions, one accounting pass."""
+    ds = StockDataSource(datasource_params)
+    algo = RegressionStrategy(strategy_params)
+    daily: list[tuple[int, dict[str, float]]] = []
+    raw = None
+    for td, raw, qa in ds.read_eval(ctx):
+        model = algo.train(ctx, td)
+        for q, _ in qa:
+            daily.append((q.idx, algo.predict(model, q).data))
+    if raw is None:
+        raise ValueError("no evaluation windows (check from/until idx)")
+    return backtest(raw, daily, backtesting_params)
+
+
+def engine() -> Engine:
+    return Engine(
+        datasource_classes=StockDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"regression": RegressionStrategy},
+        serving_classes=FirstServing,
+    )

@@ -1,0 +1,226 @@
+"""Experimental example engines: regression and friend recommendation
+(reference examples/experimental/scala-local-regression,
+scala-local-friend-recommendation, scala-parallel-friend-recommendation)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineParams, Params
+from predictionio_tpu.core.workflow import run_train, prepare_deploy
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models import friendrecommendation as fr
+from predictionio_tpu.models import regression as reg
+
+
+class TestRegression:
+    def _file(self, tmp_path):
+        """The reference's "y x1 x2 ..." format with a known model:
+        y = 2*x1 - 3*x2 + 1*x3."""
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(80, 3))
+        y = x @ np.array([2.0, -3.0, 1.0])
+        path = tmp_path / "regression.txt"
+        with open(path, "w") as f:
+            for yi, xi in zip(y, x):
+                f.write(f"{yi} {xi[0]} {xi[1]} {xi[2]}\n")
+        return str(path), x, y
+
+    def test_ols_recovers_coefficients_from_file(self, tmp_path):
+        path, x, y = self._file(tmp_path)
+        ds = reg.RegressionDataSource(reg.DataSourceParams(filepath=path))
+        td = ds.read_training(None)
+        algo = reg.OLSAlgorithm()
+        model = algo.train(None, td)
+        np.testing.assert_allclose(
+            model.coefficients, [2.0, -3.0, 1.0], atol=1e-3
+        )
+        got = algo.predict(model, reg.Query(features=[1.0, 1.0, 1.0]))
+        assert abs(got.prediction - 0.0) < 1e-2
+
+    def test_predict_rejects_wrong_arity(self, tmp_path):
+        path, _, _ = self._file(tmp_path)
+        ds = reg.RegressionDataSource(reg.DataSourceParams(filepath=path))
+        model = reg.OLSAlgorithm().train(None, ds.read_training(None))
+        with pytest.raises(ValueError, match="features"):
+            reg.OLSAlgorithm().predict(model, reg.Query(features=[1.0]))
+
+    def test_preparator_drops_fold(self, tmp_path):
+        path, x, _ = self._file(tmp_path)
+        ds = reg.RegressionDataSource(reg.DataSourceParams(filepath=path))
+        td = ds.read_training(None)
+        prep = reg.RegressionPreparator(reg.PreparatorParams(n=4, k=1))
+        pd = prep.prepare(None, td)
+        assert len(pd.y) == len(td.y) - len(td.y) // 4
+        # n=0 keeps everything (reference LocalPreparator semantics)
+        assert len(
+            reg.RegressionPreparator(reg.PreparatorParams(n=0))
+            .prepare(None, td).y
+        ) == len(td.y)
+
+    def test_event_datasource_and_full_workflow(self, storage, tmp_path):
+        app_id = storage.get_metadata_apps().insert(App(0, "RegApp"))
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(1)
+        for _ in range(60):
+            x = rng.normal(size=2)
+            events.insert(
+                Event(
+                    event="datapoint", entity_type="point",
+                    entity_id=f"p{_}",
+                    properties={
+                        "label": float(3 * x[0] + 0.5 * x[1]),
+                        "features": [float(x[0]), float(x[1])],
+                    },
+                ),
+                app_id,
+            )
+        engine = reg.engine()
+        ep = EngineParams(
+            datasource=("", reg.DataSourceParams(app_name="RegApp")),
+            algorithms=[("ols", Params())],
+        )
+        run_train(engine, ep, engine_id="reg-test", storage=storage)
+        inst = storage.get_metadata_engine_instances().get_latest_completed(
+            "reg-test", "0", "default"
+        )
+        assert inst is not None
+        _, _, [model], _ = prepare_deploy(engine, inst, storage=storage)
+        np.testing.assert_allclose(model.coefficients, [3.0, 0.5], atol=1e-3)
+
+    def test_mse_evaluation_prefers_true_fold(self, tmp_path):
+        """MeanSquareError ordering: lower is better; the identity fit
+        beats a noisy fit in best-pick."""
+        path, _, _ = self._file(tmp_path)
+        from predictionio_tpu.core.engine import WorkflowParams
+        from predictionio_tpu.core.workflow import WorkflowContext
+
+        evaluation = reg.evaluation()
+        params = [
+            EngineParams(
+                datasource=("", reg.DataSourceParams(filepath=path)),
+                preparator=("", reg.PreparatorParams(n=3, k=0)),
+                algorithms=[("ols", Params())],
+            ),
+        ]
+        result = evaluation.run(
+            WorkflowContext(), engine_params_list=params,
+            workflow_params=WorkflowParams(),
+        )
+        assert result.best_score.score < 1e-3  # near-perfect linear fit
+        assert reg.MeanSquareError().compare(0.1, 0.5) > 0  # lower wins
+
+
+class TestFriendRecommendation:
+    def _td_from_files(self, tmp_path):
+        (tmp_path / "users.txt").write_text(
+            "10 a:1.0;b:0.5\n20 b:2.0\n30 c:1.0\n"
+        )
+        (tmp_path / "items.txt").write_text(
+            "100 1 a;c\n200 2 b\n"
+        )
+        (tmp_path / "actions.txt").write_text(
+            "10 20 x\n20 10 x\n10 30 x\n"
+        )
+        ds = fr.FriendRecommendationDataSource(
+            fr.DataSourceParams(
+                user_keyword_file=str(tmp_path / "users.txt"),
+                item_file=str(tmp_path / "items.txt"),
+                user_action_file=str(tmp_path / "actions.txt"),
+            )
+        )
+        return ds.read_training(None)
+
+    def test_file_datasource_parses_reference_formats(self, tmp_path):
+        td = self._td_from_files(tmp_path)
+        assert len(td.user_index) == 3 and len(td.item_index) == 2
+        assert td.user_keywords[td.user_index["10"]] == {"a": 1.0, "b": 0.5}
+        assert td.item_keywords[td.item_index["100"]] == {"a": 1.0, "c": 1.0}
+        assert len(td.edges) == 3
+
+    def test_keyword_similarity_matches_reference_formula(self, tmp_path):
+        td = self._td_from_files(tmp_path)
+        algo = fr.KeywordSimilarityAlgorithm(
+            fr.KeywordSimilarityParams(sim_weight=1.0, threshold=1.0)
+        )
+        model = algo.train(None, td)
+        # sum w_u(t) * w_i(t): user 10 {a:1, b:.5} x item 100 {a:1, c:1} = 1.0
+        got = algo.predict(model, fr.Query(user="10", item="100"))
+        assert got.confidence == pytest.approx(1.0)
+        assert got.acceptance  # 1.0 * 1.0 >= 1.0
+        # user 20 {b:2} x item 100 {a, c} = 0
+        got2 = algo.predict(model, fr.Query(user="20", item="100"))
+        assert got2.confidence == 0.0 and not got2.acceptance
+        # unseen ids -> confidence 0 (reference predict else-branch)
+        got3 = algo.predict(model, fr.Query(user="nope", item="100"))
+        assert got3.confidence == 0.0
+
+    def test_simrank_properties(self, tmp_path):
+        """SimRank invariants: S symmetric for symmetric graphs,
+        diag = 1, co-followed users more similar than unrelated ones."""
+        # 1 and 2 are both followed by 0 and 3 (strong co-citation);
+        # 4 hangs off alone
+        edges = [(0, 1), (0, 2), (3, 1), (3, 2), (4, 0)]
+        users = {str(i): i for i in range(5)}
+        from predictionio_tpu.data.bimap import BiMap
+
+        td = fr.TrainingData(
+            user_index=BiMap(users),
+            user_keywords=[{} for _ in range(5)],
+            edges=np.asarray(edges, np.int32),
+        )
+        algo = fr.SimRankAlgorithm(
+            fr.SimRankParams(num_iterations=6, decay=0.8, threshold=0.1)
+        )
+        model = algo.train(None, td)
+        s = model.scores
+        assert np.allclose(np.diag(s), 1.0)
+        sim_12 = algo.predict(model, fr.Query(user="1", item="2"))
+        sim_14 = algo.predict(model, fr.Query(user="1", item="4"))
+        # identical in-neighborhoods {0,3}: S(1,2) = decay*(1+S(0,3))/2
+        # with S(0,3) = 0 here -> exactly 0.4
+        assert sim_12.confidence == pytest.approx(0.4, abs=1e-5)
+        assert sim_12.confidence > sim_14.confidence
+        assert sim_12.acceptance
+
+    def test_random_baseline_deterministic(self, tmp_path):
+        td = self._td_from_files(tmp_path)
+        algo = fr.RandomAlgorithm(fr.RandomParams(seed=1))
+        model = algo.train(None, td)
+        a = algo.predict(model, fr.Query(user="10", item="100"))
+        b = algo.predict(model, fr.Query(user="10", item="100"))
+        assert a.confidence == b.confidence  # stable per (seed, pair)
+
+    def test_event_datasource_and_engine(self, storage):
+        app_id = storage.get_metadata_apps().insert(App(0, "FrApp"))
+        events = storage.get_events()
+        events.init(app_id)
+        for uid, kw in (("u1", {"x": 1.0}), ("u2", {"x": 2.0})):
+            events.insert(
+                Event(event="$set", entity_type="user", entity_id=uid,
+                      properties={"keywords": kw}), app_id)
+        events.insert(
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties={"keywords": {"x": 1.5}}), app_id)
+        events.insert(
+            Event(event="follow", entity_type="user", entity_id="u1",
+                  target_entity_type="user", target_entity_id="u2"), app_id)
+        engine = fr.engine()
+        ep = EngineParams(
+            datasource=("", fr.DataSourceParams(app_name="FrApp")),
+            algorithms=[("keyword", fr.KeywordSimilarityParams(threshold=1.0))],
+        )
+        run_train(engine, ep, engine_id="fr-test", storage=storage)
+        inst = storage.get_metadata_engine_instances().get_latest_completed(
+            "fr-test", "0", "default"
+        )
+        assert inst is not None
+        _, algorithms, [model], serving = prepare_deploy(
+            engine, inst, storage=storage
+        )
+        got = algorithms[0].predict(model, fr.Query(user="u1", item="i1"))
+        assert got.confidence == pytest.approx(1.5)
+        assert got.acceptance

@@ -1,0 +1,218 @@
+"""Stock backtesting template (reference examples/experimental/scala-stock)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineParams
+from predictionio_tpu.core.workflow import prepare_deploy, run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App
+from predictionio_tpu.models import stock
+
+
+def make_raw(days=300, seed=0, momentum_ticker=True):
+    """Synthetic market: random walks, plus one ticker whose next-day
+    return follows its 1-day return (a plantable momentum signal)."""
+    rng = np.random.default_rng(seed)
+    tickers = ["SPY", "AAA", "MOM"]
+    price = np.zeros((days, 3), np.float32)
+    price[0] = 100.0
+    mom_ret = 0.0
+    for d in range(1, days):
+        price[d, 0] = price[d - 1, 0] * np.exp(rng.normal(0, 0.01))
+        price[d, 1] = price[d - 1, 1] * np.exp(rng.normal(0, 0.01))
+        # MOM: AR(1) on returns — the shifts(1) indicator predicts it
+        mom_ret = 0.8 * mom_ret + rng.normal(0, 0.004)
+        price[d, 2] = price[d - 1, 2] * np.exp(mom_ret)
+    return stock.RawStockData(
+        tickers=tickers,
+        times=np.arange(days, dtype=np.int64),
+        price=price,
+        active=np.ones((days, 3), bool),
+        market_ticker="SPY",
+    )
+
+
+class TestIndicators:
+    def test_shifts_is_log_return(self):
+        import jax.numpy as jnp
+
+        logp = jnp.asarray(
+            np.log(np.linspace(100, 120, 10)).reshape(10, 1), jnp.float32
+        )
+        out = np.asarray(stock._shifts(logp, 3))
+        assert np.allclose(out[:3], 0.0)
+        expect = np.asarray(logp[5] - logp[2])
+        assert np.allclose(out[5], expect, atol=1e-6)
+
+    def test_rsi_bounds_and_direction(self):
+        import jax.numpy as jnp
+
+        up = jnp.asarray(np.log(np.linspace(100, 150, 40)).reshape(40, 1))
+        down = jnp.asarray(np.log(np.linspace(150, 100, 40)).reshape(40, 1))
+        rsi_up = np.asarray(stock._rsi(up.astype(jnp.float32), 14))
+        rsi_down = np.asarray(stock._rsi(down.astype(jnp.float32), 14))
+        assert np.all(rsi_up >= 0) and np.all(rsi_up <= 100)
+        assert rsi_up[14] == pytest.approx(50.0)  # warmup fill
+        assert rsi_up[-1] > 90  # pure gains
+        assert rsi_down[-1] < 10  # pure losses
+
+
+class TestRegressionStrategy:
+    def test_batched_fit_matches_per_ticker_numpy(self):
+        raw = make_raw()
+        td = stock.TrainingData(raw=raw, until_idx=250, window=200)
+        algo = stock.RegressionStrategy(
+            stock.RegressionStrategyParams(
+                indicators=(("shifts", 1), ("shifts", 5))
+            )
+        )
+        model = algo.train(None, td)
+        assert model.coef.shape == (3, 3)  # [T, F+1]
+        # per-ticker numpy OLS on the same rows must agree
+        import jax.numpy as jnp
+
+        logp = np.log(td.price_window())
+        inds = model.indicators
+        feats = np.asarray(
+            stock.indicator_matrix(jnp.asarray(logp), inds)
+        )
+        skip = max(i.min_window for i in inds) + 2
+        fwd = np.concatenate(
+            [logp[1:] - logp[:-1], np.zeros_like(logp[:1])], 0
+        )
+        for t in range(3):
+            x = feats[skip:-1, t, :]
+            xb = np.concatenate([x, np.ones_like(x[:, :1])], 1)
+            y = fwd[skip:-1, t]
+            ref = np.linalg.lstsq(xb, y, rcond=None)[0]
+            # f32 normal equations vs f64 lstsq: small-coefficient slack
+            np.testing.assert_allclose(model.coef[t], ref, atol=1e-3)
+
+    def test_momentum_signal_recovered(self):
+        """The planted AR(1) ticker must get a clearly positive
+        shifts(1) coefficient; the random walks must not."""
+        raw = make_raw()
+        td = stock.TrainingData(raw=raw, until_idx=290, window=250)
+        algo = stock.RegressionStrategy(
+            stock.RegressionStrategyParams(indicators=(("shifts", 1),))
+        )
+        model = algo.train(None, td)
+        mom = model.coef[raw.tickers.index("MOM"), 0]
+        spy = model.coef[raw.tickers.index("SPY"), 0]
+        assert mom > 0.5, mom  # AR coefficient ~0.8
+        assert abs(spy) < 0.4
+
+    def test_predict_serving_query_filters_tickers(self):
+        raw = make_raw()
+        td = stock.TrainingData(raw=raw, until_idx=250, window=200)
+        algo = stock.RegressionStrategy(stock.RegressionStrategyParams())
+        model = algo.train(None, td)
+        got = algo.predict(model, stock.Query(tickers=["MOM"]))
+        assert set(got.data) == {"MOM"}
+        everything = algo.predict(model, stock.Query())
+        assert set(everything.data) == {"SPY", "AAA", "MOM"}
+
+
+class TestBacktest:
+    def test_accounting_conserves_cash_without_signals(self):
+        raw = make_raw(days=50)
+        preds = [(i, {"AAA": -1.0}) for i in range(30, 40)]  # never enter
+        result = stock.backtest(
+            raw, preds, stock.BacktestingParams(enter_threshold=0.5)
+        )
+        assert result.overall.ret == pytest.approx(0.0)
+        assert all(d.position_count == 0 for d in result.daily)
+
+    def test_positions_marked_to_market(self):
+        """Hold one rising ticker: NAV must track its price ratio."""
+        days = 40
+        price = np.ones((days, 2), np.float32) * 100
+        price[:, 1] = 100 * (1.01 ** np.arange(days))  # +1%/day
+        raw = stock.RawStockData(
+            tickers=["SPY", "UP"],
+            times=np.arange(days, dtype=np.int64),
+            price=price,
+            active=np.ones((days, 2), bool),
+            market_ticker="SPY",
+        )
+        preds = [(i, {"UP": 1.0}) for i in range(10, 30)]
+        result = stock.backtest(
+            raw,
+            preds,
+            stock.BacktestingParams(
+                enter_threshold=0.5, exit_threshold=-1.0, max_positions=1
+            ),
+        )
+        # entered at day 10; 19 daily +1% marks through day 29
+        assert result.overall.ret == pytest.approx(1.01**19 - 1, rel=1e-3)
+        assert result.overall.sharpe > 0
+
+    def test_rolling_backtest_end_to_end(self):
+        raw = make_raw(days=320)
+        # monkeypatch-free: drive run_backtest through a datasource stub
+        ds_params = stock.DataSourceParams(
+            from_idx=260,
+            until_idx=310,
+            training_window_size=200,
+            max_testing_window_size=20,
+        )
+        algo_params = stock.RegressionStrategyParams(
+            indicators=(("shifts", 1), ("rsi", 14))
+        )
+
+        class _DS(stock.StockDataSource):
+            def _read_raw(self):
+                return raw
+
+        ds = _DS(ds_params)
+        algo = stock.RegressionStrategy(algo_params)
+        daily = []
+        for td, _raw, qa in ds.read_eval(None):
+            model = algo.train(None, td)
+            for q, _ in qa:
+                daily.append((q.idx, algo.predict(model, q).data))
+        assert len(daily) == 50  # every testing day scored
+        result = stock.backtest(raw, daily, stock.BacktestingParams())
+        assert result.overall.days == 50
+        assert result.daily[0].nav > 0
+
+
+class TestEngine:
+    def test_event_datasource_and_full_workflow(self, storage):
+        app_id = storage.get_metadata_apps().insert(App(0, "StockApp"))
+        events = storage.get_events()
+        events.init(app_id)
+        raw = make_raw(days=120)
+        for j, t in enumerate(raw.tickers):
+            events.insert(
+                Event(
+                    event="$set", entity_type="yahoo", entity_id=t,
+                    properties={
+                        "prices": [float(v) for v in raw.price[:, j]],
+                        "ts": [int(v) for v in raw.times],
+                    },
+                ),
+                app_id,
+            )
+        engine = stock.engine()
+        ep = EngineParams(
+            datasource=("", stock.DataSourceParams(
+                app_name="StockApp", training_window_size=100,
+            )),
+            algorithms=[("regression", stock.RegressionStrategyParams(
+                indicators=(("shifts", 1), ("shifts", 5)),
+            ))],
+        )
+        run_train(engine, ep, engine_id="stock-test", storage=storage)
+        inst = storage.get_metadata_engine_instances().get_latest_completed(
+            "stock-test", "0", "default"
+        )
+        assert inst is not None
+        _, algorithms, [model], _ = prepare_deploy(
+            engine, inst, storage=storage
+        )
+        got = algorithms[0].predict(model, stock.Query(tickers=["MOM"]))
+        assert "MOM" in got.data
