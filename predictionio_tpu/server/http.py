@@ -12,6 +12,7 @@ import logging
 import re
 import threading
 from dataclasses import dataclass, field
+from http.client import responses as _RESPONSES
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
@@ -179,21 +180,97 @@ class HTTPApp:
             disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # route to logging, not stderr
-                logger.debug("%s %s", self.address_string(), fmt % args)
+                if logger.isEnabledFor(logging.DEBUG):
+                    logger.debug("%s %s", self.address_string(), fmt % args)
 
-            def _handle(self):
-                parsed = urlparse(self.path)
+            def handle_one_request(self):
+                """Minimal HTTP/1.1 loop replacing the stdlib parse.
+
+                BaseHTTPRequestHandler routes headers through the email
+                parser and emits each response header as its own write —
+                ~60% of a keep-alive round trip's server cost on the
+                ingest/serving hot paths (measured: ~160 us/request
+                floor). This parses the request line + headers directly
+                and sends each response as ONE buffer. Scope matches
+                what the framework's clients speak: method line,
+                case-insensitive headers, Content-Length bodies,
+                keep-alive/close, Expect: 100-continue; no chunked
+                request bodies (the reference's spray server also
+                buffers full entities)."""
+                self.close_connection = True
+                try:
+                    line = self.rfile.readline(65537)
+                except OSError:
+                    return
+                if not line:
+                    return
+                if len(line) > 65536:
+                    self._send_simple(414, "URI Too Long")
+                    return
+                try:
+                    method, target, version = (
+                        line.decode("latin-1").rstrip("\r\n").split(" ")
+                    )
+                except ValueError:
+                    self._send_simple(400, "Bad Request")
+                    return
+                if not version.startswith("HTTP/"):
+                    self._send_simple(400, "Bad Request")
+                    return
+                # keep the BaseHTTPRequestHandler bookkeeping fields sane
+                # (error paths and socketserver logging read them)
+                self.command, self.path = method, target
+                self.request_version = version
+                self.requestline = f"{method} {target} {version}"
+                if method not in (
+                    "GET", "POST", "DELETE", "PUT", "OPTIONS"
+                ):
+                    # the method set the old do_* aliases dispatched; a
+                    # HEAD answered with a body would desync keep-alive
+                    self._send_simple(501, "Unsupported method")
+                    return
+                headers: dict[str, str] = {}
+                n_lines = 0
+                while True:
+                    h = self.rfile.readline(65537)
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    n_lines += 1  # count LINES, not dict entries: a
+                    # stream of repeated/colon-less lines must still
+                    # trip the cap (stdlib _MAXHEADERS analog)
+                    if len(h) > 65536 or n_lines > 256:
+                        self._send_simple(431, "Header Fields Too Large")
+                        return
+                    k, sep, v = h.decode("latin-1").partition(":")
+                    if sep:
+                        headers[k.strip().lower()] = v.strip()
+                conn = headers.get("connection", "").lower()
+                self.close_connection = conn == "close" or (
+                    version == "HTTP/1.0" and conn != "keep-alive"
+                )
+                if headers.get("expect", "").lower() == "100-continue":
+                    self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+                try:
+                    length = int(headers.get("content-length") or 0)
+                except ValueError:
+                    self._send_simple(400, "Bad Request")
+                    return
+                body = self.rfile.read(length) if length > 0 else b""
+                if length > 0 and len(body) < length:
+                    self.close_connection = True
+                    return  # client died mid-body
+                parsed = urlparse(target)
                 q = {
                     k: v[0]
-                    for k, v in parse_qs(parsed.query, keep_blank_values=True).items()
+                    for k, v in parse_qs(
+                        parsed.query, keep_blank_values=True
+                    ).items()
                 }
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
                 request = Request(
-                    method=self.command,
+                    method=method,
                     path=parsed.path,
                     query=q,
-                    headers={k.lower(): v for k, v in self.headers.items()},
+                    headers=headers,
                     body=body,
                 )
                 try:
@@ -201,9 +278,31 @@ class HTTPApp:
                 except json.JSONDecodeError:
                     response = Response.error("invalid JSON body", 400)
                 except Exception:
-                    logger.exception("unhandled error on %s %s", self.command, parsed.path)
+                    logger.exception(
+                        "unhandled error on %s %s", method, parsed.path
+                    )
                     response = Response.error("internal error", 500)
                 self._send(response)
+
+            def _send_simple(self, status: int, phrase: str) -> None:
+                self.wfile.write(
+                    (
+                        f"HTTP/1.1 {status} {phrase}\r\n"
+                        "Content-Length: 0\r\nConnection: close\r\n\r\n"
+                    ).encode("latin-1")
+                )
+                self.close_connection = True
+
+            def _head(self, response: Response, content_type: str,
+                      extra: str) -> bytes:
+                phrase = _RESPONSES.get(response.status, "")
+                head = (
+                    f"HTTP/1.1 {response.status} {phrase}\r\n"
+                    f"Content-Type: {content_type}\r\n{extra}"
+                )
+                for k, v in response.headers.items():
+                    head += f"{k}: {v}\r\n"
+                return (head + "\r\n").encode("latin-1")
 
             def _send(self, response: Response):
                 if (
@@ -215,12 +314,10 @@ class HTTPApp:
                     # stream (bulk export of multi-GB logs must not
                     # materialize in server RSS)
                     content_type, chunks = response.body
-                    self.send_response(response.status)
-                    self.send_header("Content-Type", content_type)
-                    self.send_header("Connection", "close")
-                    for k, v in response.headers.items():
-                        self.send_header(k, v)
-                    self.end_headers()
+                    self.wfile.write(
+                        self._head(response, content_type,
+                                   "Connection: close\r\n")
+                    )
                     for chunk in chunks:
                         if chunk:
                             self.wfile.write(chunk)
@@ -238,20 +335,18 @@ class HTTPApp:
                     payload = json.dumps(
                         response.body if response.body is not None else {}
                     ).encode("utf-8")
-                self.send_response(response.status)
-                self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(payload)))
-                for k, v in response.headers.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(payload)
+                self.wfile.write(
+                    self._head(
+                        response, content_type,
+                        f"Content-Length: {len(payload)}\r\n",
+                    )
+                    + payload
+                )
                 self.wfile.flush()
                 if response.after_send is not None:
                     threading.Thread(
                         target=response.after_send, daemon=True
                     ).start()
-
-            do_GET = do_POST = do_DELETE = do_PUT = do_OPTIONS = _handle
 
         if self.ssl_context is not None:
             ssl_context = self.ssl_context
