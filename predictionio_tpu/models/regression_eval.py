@@ -1,0 +1,66 @@
+"""Shipped evaluation for the regression template — a ready `pio eval`
+target.
+
+Mirrors the reference's Run.scala flow (examples/experimental/
+scala-local-regression/Run.scala: three leave-fold-out EngineParams over
+``PreparatorParams(n = 3, k)`` scored with ``MeanSquareError``). Run it
+with:
+
+    pio eval predictionio_tpu.models.regression_eval.evaluation \\
+             predictionio_tpu.models.regression_eval.param_grid
+
+Data comes from ``PIO_EVAL_REGRESSION_FILE`` (the reference's
+space-separated ``y x1 x2 ...`` format) or, when unset, the event store
+app ``PIO_EVAL_APP_NAME`` (default ``MyApp``, ``datapoint`` events).
+
+Both entry points are zero-arg factories (resolved lazily by
+``run_evaluation``), so importing this module never touches storage.
+"""
+
+from __future__ import annotations
+
+import os
+
+from predictionio_tpu.core import EngineParams, Params
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.core.params import EngineParamsGenerator
+from predictionio_tpu.models import regression
+
+FOLDS = 3
+
+
+def _datasource_params() -> regression.DataSourceParams:
+    filepath = os.environ.get("PIO_EVAL_REGRESSION_FILE", "")
+    if filepath:
+        return regression.DataSourceParams(filepath=filepath)
+    return regression.DataSourceParams(
+        app_name=os.environ.get("PIO_EVAL_APP_NAME", "MyApp")
+    )
+
+
+def _candidates() -> list[EngineParams]:
+    ds = _datasource_params()
+    return [
+        EngineParams(
+            datasource=("", ds),
+            preparator=("", regression.PreparatorParams(n=FOLDS, k=k)),
+            algorithms=[("ols", Params())],
+        )
+        for k in range(FOLDS)
+    ]
+
+
+def param_grid() -> EngineParamsGenerator:
+    """The three leave-fold-out candidates (Run.scala's engineParamsList)."""
+    gen = EngineParamsGenerator()
+    gen.engine_params_list = _candidates()
+    return gen
+
+
+def evaluation() -> Evaluation:
+    """MeanSquareError over the training points (lower is better)."""
+    return Evaluation(
+        engine=regression.engine(),
+        metric=regression.MeanSquareError(),
+        engine_params_generator=param_grid(),
+    )

@@ -248,11 +248,21 @@ class HTTPApp:
                 self.close_connection = conn == "close" or (
                     version == "HTTP/1.0" and conn != "keep-alive"
                 )
+                te = headers.get("transfer-encoding", "").lower()
+                if te and te != "identity":
+                    # chunked bodies are out of scope; treating them as
+                    # body-less would desync the keep-alive stream
+                    # (framing bytes parsed as the next request)
+                    self._send_simple(501, "Transfer-Encoding unsupported")
+                    return
                 if headers.get("expect", "").lower() == "100-continue":
                     self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
                 try:
                     length = int(headers.get("content-length") or 0)
                 except ValueError:
+                    self._send_simple(400, "Bad Request")
+                    return
+                if length < 0:
                     self._send_simple(400, "Bad Request")
                     return
                 body = self.rfile.read(length) if length > 0 else b""

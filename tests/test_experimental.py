@@ -113,6 +113,22 @@ class TestRegression:
         assert result.best_score.score < 1e-3  # near-perfect linear fit
         assert reg.MeanSquareError().compare(0.1, 0.5) > 0  # lower wins
 
+    def test_shipped_eval_target(self, tmp_path, monkeypatch):
+        """The regression_eval module is a ready `pio eval` target
+        (reference Run.scala: 3 leave-fold-out candidates + MSE)."""
+        path, _, _ = self._file(tmp_path)
+        monkeypatch.setenv("PIO_EVAL_REGRESSION_FILE", path)
+        from predictionio_tpu.core.engine import WorkflowParams
+        from predictionio_tpu.core.workflow import WorkflowContext
+        from predictionio_tpu.models import regression_eval
+
+        ev = regression_eval.evaluation()
+        result = ev.run(
+            WorkflowContext(), workflow_params=WorkflowParams()
+        )
+        assert len(result.engine_params_scores) == 3
+        assert result.best_score.score < 1e-3
+
 
 class TestFriendRecommendation:
     def _td_from_files(self, tmp_path):

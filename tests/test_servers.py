@@ -881,3 +881,61 @@ class TestReloadUnderLoad:
             for f in futures:
                 f.result(timeout=30)
         assert not errors, errors[:3]
+
+
+class TestHTTPParserFraming:
+    """The hand-rolled HTTP/1.1 parser must never desync a keep-alive
+    stream: unsupported framings are rejected with Connection: close."""
+
+    def _app(self):
+        from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+        router = Router()
+
+        @router.route("POST", "/echo")
+        def echo(request):
+            return Response.json({"n": len(request.body)})
+
+        return HTTPApp(router, host="127.0.0.1", port=0)
+
+    def test_chunked_request_rejected(self):
+        import socket
+
+        app = self._app()
+        port = app.start(background=True)
+        try:
+            s = socket.create_connection(("127.0.0.1", port))
+            s.sendall(
+                b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n5\r\nhello\r\n0\r\n\r\n"
+            )
+            assert s.recv(65536).decode().startswith("HTTP/1.1 501")
+        finally:
+            app.stop()
+
+    def test_negative_content_length_rejected(self):
+        import socket
+
+        app = self._app()
+        port = app.start(background=True)
+        try:
+            s = socket.create_connection(("127.0.0.1", port))
+            s.sendall(
+                b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: -5\r\n\r\nhello"
+            )
+            assert s.recv(65536).decode().startswith("HTTP/1.1 400")
+        finally:
+            app.stop()
+
+    def test_endless_header_lines_capped(self):
+        import socket
+
+        app = self._app()
+        port = app.start(background=True)
+        try:
+            s = socket.create_connection(("127.0.0.1", port))
+            s.sendall(b"POST /echo HTTP/1.1\r\n" + b"x: y\r\n" * 300)
+            assert s.recv(65536).decode().startswith("HTTP/1.1 431")
+        finally:
+            app.stop()
