@@ -301,9 +301,91 @@ def _load_server_config(args):
     return load_server_config(path=path)
 
 
+def _maybe_run_workers(args) -> int | None:
+    """The `--workers N` dispatch shared by deploy/eventserver: None
+    means "continue single-process"."""
+    if getattr(args, "workers", 1) <= 1:
+        return None
+    if args.port == 0:
+        print("--workers needs an explicit --port", file=sys.stderr)
+        return 1
+    return _run_workers(args)
+
+
+def _run_workers(args) -> int:
+    """Spawn N copies of this exact CLI invocation (each binding the
+    same port with SO_REUSEPORT) and supervise them: forward SIGTERM/
+    SIGINT, exit nonzero if ANY worker dies (an external supervisor
+    restarts the set). The reference's spray server scales with JVM
+    threads inside one process; CPython serving is GIL-bound, so the
+    scale-out unit here is the PROCESS."""
+    import signal
+    import subprocess
+    import time
+
+    # strip every --workers spelling (separate token, --workers=N, and
+    # argparse prefix abbreviations): a surviving flag would make each
+    # child spawn its own workers — a fork bomb
+    argv = []
+    tokens = iter(sys.argv[1:])
+    for tok in tokens:
+        if tok.startswith("--w") and "--workers".startswith(
+            tok.split("=", 1)[0]
+        ):
+            if "=" not in tok:
+                next(tokens, None)  # drop the value token too
+            continue
+        argv.append(tok)
+    if "--reuse-port" not in argv:
+        argv.append("--reuse-port")
+
+    procs: list = []
+
+    # install the forwarders BEFORE spawning: a SIGTERM landing in the
+    # spawn window must still reach (and not orphan) early workers
+    def forward(signum, _frame):
+        for pr in procs:
+            pr.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+    for _ in range(args.workers):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "predictionio_tpu.cli.main"] + argv
+            )
+        )
+    rc = 0
+    try:
+        # poll ALL workers: the death of any one must surface (waiting
+        # on one pid would let the set run degraded indefinitely)
+        while procs and rc == 0:
+            time.sleep(0.5)
+            for pr in list(procs):
+                code = pr.poll()
+                if code is None:
+                    continue
+                procs.remove(pr)
+                if code not in (0, -signal.SIGTERM, -signal.SIGINT):
+                    rc = code or 1
+    finally:
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except Exception:
+                pr.kill()
+    return rc
+
+
 def cmd_deploy(args) -> int:
     from predictionio_tpu.data.storage import get_storage
     from predictionio_tpu.server.engine_server import EngineServer
+
+    rc = _maybe_run_workers(args)
+    if rc is not None:
+        return rc
 
     engine, variant, factory = _engine_from_args(args)
     storage = get_storage()
@@ -353,6 +435,7 @@ def cmd_deploy(args) -> int:
         log_url=args.log_url,
         log_prefix=args.log_prefix,
         batch_window_ms=args.batch_window_ms,
+        reuse_port=args.reuse_port,
     )
     # foreground, like the reference: backgrounding is the caller's job
     # (shell &, supervisor); a daemon thread would die with this process
@@ -376,7 +459,13 @@ def cmd_undeploy(args) -> int:
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.server.event_server import EventServer
 
-    server = EventServer(host=args.ip, port=args.port, stats=args.stats)
+    rc = _maybe_run_workers(args)
+    if rc is not None:
+        return rc
+    server = EventServer(
+        host=args.ip, port=args.port, stats=args.stats,
+        reuse_port=args.reuse_port,
+    )
     server.start(background=False)
     return 0
 
@@ -684,6 +773,17 @@ def build_parser() -> argparse.ArgumentParser:
         "one batched device call (0 = per-request serving); amortizes "
         "per-call dispatch on TPU attachments",
     )
+    d.add_argument(
+        "--workers", type=int, default=1,
+        help="run this many server PROCESSES sharing the port via "
+        "SO_REUSEPORT (the kernel balances accepts); scales serving "
+        "past one interpreter's GIL. Needs an explicit --port.",
+    )
+    d.add_argument(
+        "--reuse-port", action="store_true",
+        help="bind with SO_REUSEPORT (set automatically for workers; "
+        "useful when an external supervisor runs the processes)",
+    )
     d.set_defaults(fn=cmd_deploy)
 
     u = sub.add_parser("undeploy")
@@ -695,6 +795,13 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
+    es.add_argument(
+        "--workers", type=int, default=1,
+        help="run this many ingest PROCESSES sharing the port via "
+        "SO_REUSEPORT (storage appends are cross-process safe); needs "
+        "an explicit --port",
+    )
+    es.add_argument("--reuse-port", action="store_true")
     es.set_defaults(fn=cmd_eventserver)
 
     ad = sub.add_parser("adminserver")

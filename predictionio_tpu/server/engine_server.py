@@ -244,6 +244,7 @@ class EngineServer:
         log_prefix: str | None = None,
         batch_window_ms: float = 0.0,
         dispatch_cost_s: float | None = None,
+        reuse_port: bool = False,
     ):
         self.engine = engine
         self.storage = storage or get_storage()
@@ -289,6 +290,7 @@ class EngineServer:
             ssl_context=(
                 server_config.ssl_context() if server_config is not None else None
             ),
+            reuse_port=reuse_port,
         )
 
     def _load(self, instance: EngineInstance) -> None:

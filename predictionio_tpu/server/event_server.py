@@ -68,6 +68,7 @@ class EventServer:
         port: int = 7070,
         stats: bool = False,
         connectors: dict | None = None,
+        reuse_port: bool = False,
     ):
         self.storage = storage or get_storage()
         self.stats_enabled = stats
@@ -79,7 +80,9 @@ class EventServer:
         self.plugin_context: dict[str, Any] = {"storage": self.storage}
         for p in self.plugins:
             p.start(self.plugin_context)
-        self.app = HTTPApp(self._router(), host=host, port=port)
+        self.app = HTTPApp(
+            self._router(), host=host, port=port, reuse_port=reuse_port
+        )
 
     # -- auth --------------------------------------------------------------
     def _auth(self, request: Request) -> AuthData | Response:

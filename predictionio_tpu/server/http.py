@@ -159,6 +159,7 @@ class HTTPApp:
         host: str = "0.0.0.0",
         port: int = 0,
         ssl_context=None,
+        reuse_port: bool = False,
     ):
         self.router = router
         self.host = host
@@ -166,6 +167,10 @@ class HTTPApp:
         # server-side TLS (reference SSLConfiguration sslContext wiring
         # into spray; here an ssl.SSLContext wrapping the listen socket)
         self.ssl_context = ssl_context
+        # SO_REUSEPORT: N worker PROCESSES bind the same port and the
+        # kernel load-balances accepts — the multi-process scale-out
+        # path (`--workers`) past the single-interpreter GIL
+        self.reuse_port = reuse_port
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -377,6 +382,33 @@ class HTTPApp:
             server_cls = _TLSServer
         else:
             server_cls = ThreadingHTTPServer
+        if self.reuse_port:
+            if self.port == 0:
+                raise ValueError(
+                    "reuse_port workers need an explicit --port (the "
+                    "kernel balances accepts across same-port listeners)"
+                )
+
+            base_cls = server_cls
+
+            def _bind_with_reuseport(srv):
+                # set SO_REUSEPORT explicitly rather than relying on
+                # socketserver.allow_reuse_port (3.11+ only)
+                import socket as _socket
+
+                try:
+                    srv.socket.setsockopt(
+                        _socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1
+                    )
+                except (AttributeError, OSError):  # pragma: no cover
+                    pass  # platform without SO_REUSEPORT
+                base_cls.server_bind(srv)
+
+            server_cls = type(
+                base_cls.__name__ + "ReusePort",
+                (base_cls,),
+                {"server_bind": _bind_with_reuseport},
+            )
         self._server = server_cls((self.host, self.port), _Handler)
         self.port = self._server.server_address[1]
         if background:

@@ -939,3 +939,71 @@ class TestHTTPParserFraming:
             assert s.recv(65536).decode().startswith("HTTP/1.1 431")
         finally:
             app.stop()
+
+
+class TestWorkerProcesses:
+    def test_eventserver_workers_share_port_without_loss(self, tmp_path):
+        """`pio eventserver --workers N`: N processes bind the same port
+        via SO_REUSEPORT; ingest across them must lose nothing and
+        duplicate nothing (storage appends are cross-process flocked).
+        This box is single-core so throughput cannot scale here — the
+        test is about correctness of the shared-port worker set."""
+        import os
+        import subprocess
+        import sys
+        import time
+        import urllib.request
+
+        env = dict(
+            os.environ,
+            PIO_STORAGE_SOURCES_DB_TYPE="sqlite",
+            PIO_STORAGE_SOURCES_DB_PATH=str(tmp_path / "pio.db"),
+            PIO_STORAGE_SOURCES_LOG_TYPE="jsonl",
+            PIO_STORAGE_SOURCES_LOG_PATH=str(tmp_path / "ev"),
+            PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="DB",
+            PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="LOG",
+            PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="DB",
+        )
+        from predictionio_tpu.data.storage import Storage
+
+        storage = Storage(env=env)
+        from predictionio_tpu.cli import commands
+
+        info = commands.app_new("WorkerApp", storage=storage)
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli.main",
+             "eventserver", "--ip", "127.0.0.1", "--port", str(port),
+             "--workers", "2"],
+            env=env,
+        )
+        try:
+            for _ in range(60):
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=2
+                    )
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            else:
+                raise AssertionError("workers never came up")
+            key = info["access_key"]
+            for i in range(60):
+                status, _ = http(
+                    "POST",
+                    f"http://127.0.0.1:{port}/events.json?accessKey={key}",
+                    dict(EVENT, entityId=f"u{i}"),
+                )
+                assert status == 201
+        finally:
+            sup.terminate()
+            sup.wait(timeout=15)
+        events = storage.get_events().find(info["id"], limit=None)
+        assert len(events) == 60
+        assert len({e.event_id for e in events}) == 60
