@@ -1028,6 +1028,13 @@ def main() -> None:
     # all storage for serving/e2e lives in one throwaway dir; configure
     # BEFORE the first get_storage() call binds the singleton
     tmpdir = tempfile.mkdtemp(prefix="pio_bench_")
+    # drop the throwaway storage on EVERY exit path (the 20M e2e writes
+    # ~10 GB of event logs; leaked tmpdirs — including from aborted
+    # runs — filled the build box's disk to 97% over repeated runs)
+    import atexit
+    import shutil
+
+    atexit.register(shutil.rmtree, tmpdir, ignore_errors=True)
     os.environ["BENCH_TMPDIR"] = tmpdir
     os.environ["PIO_FS_BASEDIR"] = os.path.join(tmpdir, "store")
     os.environ["PIO_STORAGE_SOURCES_DB_TYPE"] = "sqlite"
