@@ -6,10 +6,13 @@ and this box has no Spark and no network, so the measured comparator is
 the same blocked normal-equation ALS implemented in NumPy on the host
 CPU — the single-machine stand-in for the JVM baseline (BASELINE.md).
 
-One `python bench.py` run emits ONE JSON line:
+One `python bench.py` run emits TWO JSON lines: the full-detail object
   {"metric": "ml100k_als_train_wallclock", "value": <tpu seconds>,
    "unit": "s", "vs_baseline": <cpu_seconds / tpu_seconds>, ...}
-with extras covering the whole story:
+followed by a compact summary as the FINAL stdout line (so a bounded
+tail capture still parses with json.loads). `bench.py --smoke` is the
+seconds-scale CI probe: storage section only, tiny event count, same
+two-line contract. The extras cover the whole story:
   - "20m":     MovieLens-20M-shaped core train (seconds, RMSE)
   - "bf16":    same workload at compute_dtype=bfloat16 vs float32
   - "bf16_storage": bf16 factor STORAGE (halved HBM gather bytes)
@@ -19,12 +22,15 @@ with extras covering the whole story:
                e-commerce live-filter path
   - "e2e":     import -> train through the whole framework (jsonl event
                log, splice import, columnar scan) with peak RSS
+  - "storage": row-vs-columnar-cache scan and seq-vs-pooled import
+               throughput for BOTH event backends (jsonl, partitioned)
   - "pallas":  the round-3 kernel decision record (see BASELINE.md)
 
 Section failures degrade to an "error" entry instead of killing the run.
 Env knobs: BENCH_SCALES=100k,20m  BENCH_E2E_EVENTS=20000000
 BENCH_SERVING=1  BENCH_BASELINE=1  BENCH_PEAK_FLOPS=1.97e14
 BENCH_RANK_SWEEP=128  BENCH_E2E_BACKEND=jsonl|partitioned
+BENCH_STORAGE_EVENTS=2000000  BENCH_SMOKE_EVENTS=20000
 """
 
 from __future__ import annotations
@@ -707,28 +713,11 @@ def bench_e2e(extras: dict) -> None:
 
     rss_before_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
     n = E2E_EVENTS
-    scale = "20m" if n >= 20_000_000 else ("1m" if n >= 1_000_000 else "100k")
-    rows, cols, vals, num_u, num_i = make_ml_shaped(scale)
-    rows, cols, vals = rows[:n], cols[:n], vals[:n]
 
     tmpdir = os.environ["BENCH_TMPDIR"]
     path = os.path.join(tmpdir, "e2e_events.jsonl")
     t0 = time.perf_counter()
-    with open(path, "w") as f:
-        buf = []
-        for i in range(len(rows)):
-            buf.append(
-                '{"event":"rate","entityType":"user","entityId":"u%d",'
-                '"targetEntityType":"item","targetEntityId":"i%d",'
-                '"properties":{"rating":%.1f},'
-                '"eventTime":"2020-01-01T00:00:00.000Z"}'
-                % (rows[i], cols[i], vals[i])
-            )
-            if len(buf) == 200_000:
-                f.write("\n".join(buf) + "\n")
-                buf = []
-        if buf:
-            f.write("\n".join(buf) + "\n")
+    _write_events_file(path, n)
     gen_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
@@ -829,6 +818,110 @@ def bench_e2e(extras: dict) -> None:
         # the VERDICT r4 "e2e_20m" block: north-star-scale end-to-end in
         # the driver artifact every round (peak RSS bound is the claim)
         extras["e2e_20m"] = extras["e2e"]
+
+
+def _write_events_file(path: str, n: int) -> None:
+    """Synthetic rate-event jsonl at a MovieLens-shaped distribution
+    (shared by bench_e2e and bench_storage)."""
+    scale = "20m" if n >= 20_000_000 else ("1m" if n >= 1_000_000 else "100k")
+    rows, cols, vals, _, _ = make_ml_shaped(scale)
+    rows, cols, vals = rows[:n], cols[:n], vals[:n]
+    with open(path, "w") as f:
+        buf = []
+        for i in range(len(rows)):
+            buf.append(
+                '{"event":"rate","entityType":"user","entityId":"u%d",'
+                '"targetEntityType":"item","targetEntityId":"i%d",'
+                '"properties":{"rating":%.1f},'
+                '"eventTime":"2020-01-01T00:00:00.000Z"}'
+                % (rows[i], cols[i], vals[i])
+            )
+            if len(buf) == 200_000:
+                f.write("\n".join(buf) + "\n")
+                buf = []
+        if buf:
+            f.write("\n".join(buf) + "\n")
+
+
+def bench_storage(extras: dict, n_events: int | None = None) -> None:
+    """The columnar-segment-cache story for BOTH event backends:
+    row scan (cache off) vs cold scan (cache build) vs warm scan
+    (mmap'd column blocks), and sequential (--jobs 1) vs pooled bulk
+    import. Everything runs in-process against throwaway stores; the
+    ``PIO_COLUMNAR_CACHE`` kill switch is read per scan, so toggling
+    the env var around calls measures exactly the row path."""
+    import shutil
+
+    from predictionio_tpu.cli import commands
+    from predictionio_tpu.data.storage import App, Storage
+
+    n = n_events or int(os.environ.get("BENCH_STORAGE_EVENTS", "2000000"))
+    tmpdir = os.environ["BENCH_TMPDIR"]
+    path = os.path.join(tmpdir, "storage_bench.jsonl")
+    _write_events_file(path, n)
+    out: dict = {"events": n}
+    try:
+        for backend in ("jsonl", "partitioned"):
+            b: dict = {}
+            stores = {}
+            for mode, jobs in (("seq", 1), ("pooled", None)):
+                root = os.path.join(tmpdir, f"sb_{backend}_{mode}")
+                s = Storage(env={
+                    "PIO_STORAGE_SOURCES_DB_TYPE": "memory",
+                    "PIO_STORAGE_SOURCES_LOG_TYPE": backend,
+                    "PIO_STORAGE_SOURCES_LOG_PATH": root,
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+                    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+                    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+                })
+                s.get_metadata_apps().insert(App(0, "BenchStorage"))
+                t0 = time.perf_counter()
+                commands.import_events(
+                    "BenchStorage", path, storage=s, jobs=jobs
+                )
+                dt = time.perf_counter() - t0
+                b[f"import_{mode}_s"] = round(dt, 2)
+                b[f"import_{mode}_events_per_s"] = round(n / dt)
+                stores[mode] = s
+            b["import_speedup"] = round(
+                b["import_seq_s"] / b["import_pooled_s"], 2
+            )
+
+            s = stores["pooled"]
+            app = s.get_metadata_apps().get_by_name("BenchStorage")
+            ev = s.get_events()
+            prior = os.environ.get("PIO_COLUMNAR_CACHE")
+            os.environ["PIO_COLUMNAR_CACHE"] = "0"
+            try:
+                t0 = time.perf_counter()
+                row_batch = ev.scan_ratings(app.id, event_names=["rate"])
+                b["row_scan_s"] = round(time.perf_counter() - t0, 3)
+            finally:
+                if prior is None:
+                    os.environ.pop("PIO_COLUMNAR_CACHE", None)
+                else:
+                    os.environ["PIO_COLUMNAR_CACHE"] = prior
+            t0 = time.perf_counter()
+            ev.scan_ratings(app.id, event_names=["rate"])
+            b["cold_scan_s"] = round(time.perf_counter() - t0, 3)  # builds
+            t0 = time.perf_counter()
+            warm_batch = ev.scan_ratings(app.id, event_names=["rate"])
+            b["warm_scan_s"] = round(time.perf_counter() - t0, 3)  # mmap hit
+            b["scan_rows"] = len(warm_batch)
+            assert len(warm_batch) == len(row_batch)
+            b["scan_speedup"] = round(
+                b["row_scan_s"] / max(b["warm_scan_s"], 1e-9), 1
+            )
+            out[backend] = b
+            for mode in stores:
+                shutil.rmtree(
+                    os.path.join(tmpdir, f"sb_{backend}_{mode}"),
+                    ignore_errors=True,
+                )
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+    extras["storage"] = out
 
 
 def sharded_child() -> None:
@@ -935,9 +1028,96 @@ def sharded_child() -> None:
     print(json.dumps(out))
 
 
+def _compact_summary(result: dict) -> dict:
+    """One SMALL machine-readable line — always the LAST stdout line, so
+    a bounded tail capture (the driver keeps ~2,000 chars) still parses
+    with json.loads even when the full-detail line above it is huge."""
+    s: dict = {
+        "metric": result.get("metric"),
+        "value": result.get("value"),
+        "unit": result.get("unit"),
+    }
+    if "vs_baseline" in result:
+        s["vs_baseline"] = result["vs_baseline"]
+    if "rmse" in result:
+        s["rmse"] = result["rmse"]
+    dev = str(result.get("device", ""))
+    s["device"] = dev[:60]
+    if result.get("smoke"):
+        s["smoke"] = True
+    tm = result.get("20m")
+    if isinstance(tm, dict) and "train_s" in tm:
+        s["train_20m_s"] = tm["train_s"]
+    e2e = result.get("e2e")
+    if isinstance(e2e, dict) and "error" not in e2e:
+        s["e2e"] = {
+            k: e2e[k]
+            for k in ("events", "import_events_per_s", "train_s",
+                      "peak_rss_mb", "event_backend")
+            if k in e2e
+        }
+    st = result.get("storage")
+    if isinstance(st, dict) and "error" not in st:
+        s["storage"] = {"events": st.get("events")}
+        for bk in ("jsonl", "partitioned"):
+            if isinstance(st.get(bk), dict):
+                s["storage"][bk] = {
+                    k: st[bk][k]
+                    for k in ("row_scan_s", "warm_scan_s", "scan_speedup",
+                              "import_seq_events_per_s",
+                              "import_pooled_events_per_s",
+                              "import_speedup")
+                    if k in st[bk]
+                }
+    errors = sorted(
+        k for k, v in result.items()
+        if isinstance(v, dict) and "error" in v
+    )
+    if errors:
+        s["error_sections"] = errors
+    return s
+
+
+def smoke_main() -> None:
+    """--smoke: a seconds-scale CI probe. Forces CPU (no accelerator
+    probe), runs ONLY the storage section at a tiny event count, and
+    prints the full-detail line plus the compact summary line. Exit 0
+    with a parseable final line is the contract the smoke test checks."""
+    import atexit
+    import shutil
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from predictionio_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+    tmpdir = tempfile.mkdtemp(prefix="pio_bench_smoke_")
+    atexit.register(shutil.rmtree, tmpdir, ignore_errors=True)
+    os.environ["BENCH_TMPDIR"] = tmpdir
+    result: dict = {
+        "metric": "bench_smoke",
+        "value": None,
+        "unit": "s",
+        "device": "cpu (smoke)",
+        "smoke": True,
+    }
+    t0 = time.perf_counter()
+    try:
+        bench_storage(
+            result, int(os.environ.get("BENCH_SMOKE_EVENTS", "20000"))
+        )
+    except Exception as e:  # the smoke contract is exit 0 + JSON line
+        result["storage"] = {"error": f"{type(e).__name__}: {e}"}
+    result["value"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(result))
+    print(json.dumps(_compact_summary(result)))
+
+
 def main() -> None:
     import sys
 
+    if "--smoke" in sys.argv:
+        smoke_main()
+        return
     if "--sharded-child" in sys.argv:
         from predictionio_tpu.utils import apply_platform_env
 
@@ -1171,6 +1351,14 @@ def main() -> None:
     if _try_recover("after_ingest"):
         _run_core_scales()
 
+    # row-vs-columnar scan and seq-vs-pooled import for both backends
+    # (host-side section; runs fine degraded)
+    try:
+        bench_storage(extras)
+    except Exception as e:
+        extras["storage"] = {"error": f"{type(e).__name__}: {e}"}
+    _mark("storage")
+
     if E2E_EVENTS > 0:
         try:
             bench_e2e(extras)
@@ -1200,6 +1388,8 @@ def main() -> None:
 
     result.update(extras)
     print(json.dumps(result))
+    # compact summary LAST: bounded tail captures stay machine-readable
+    print(json.dumps(_compact_summary(result)))
 
 
 if __name__ == "__main__":

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 from typing import Any
 
 from predictionio_tpu.data.event import Event
@@ -396,7 +397,10 @@ def import_events(
     input_path: str,
     channel: str | None = None,
     storage: Storage | None = None,
+    jobs: int | None = None,
 ) -> int:
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
     from datetime import datetime, timezone
 
     from predictionio_tpu.data import store
@@ -407,69 +411,106 @@ def import_events(
     storage = storage or get_storage()
     app_name = _resolve_app_name(app_name, storage)
     app_id, channel_id = store.app_name_to_id(app_name, channel, storage)
-    count = 0
     events_dao = storage.get_events()
     # jsonl backends take the splice-through path: wire format == storage
     # format, so validated lines append verbatim (no Event round trip) —
     # the 10^7-events/minute bulk-load path (reference FileToEvents runs
     # this load as a Spark job, tools/.../imprt/FileToEvents.scala:34-106)
-    splice = getattr(events_dao, "append_jsonl", None)
+    # The dict holds it so the pooled workers below can demote to the
+    # slow path exactly once, without a shared nonlocal rebind race.
+    splice = {"fn": getattr(events_dao, "append_jsonl", None)}
     now_iso = (
         datetime.now(timezone.utc).isoformat(timespec="milliseconds")
         .replace("+00:00", "Z")
     )
+    if jobs is None:
+        jobs = int(os.environ.get("PIO_IMPORT_JOBS", "0") or 0)
+    if jobs <= 0:
+        # the chunk pipeline overlaps native splice parse (GIL released)
+        # with the storage appends' fsyncs; past a few workers the disk
+        # is the bottleneck, so the default stays modest
+        jobs = min(4, os.cpu_count() or 1)
 
-    def _flush_slow(data: bytes | list[bytes]) -> None:
-        nonlocal count
+    def _flush_slow(data: bytes | list[bytes]) -> int:
         if isinstance(data, list):
             data = b"\n".join(data)
         # native span-scanning codec decodes the fixed wire fields without
         # a per-line DOM parse (json fallback for flagged lines inside)
         events = native.parse_events_jsonl(data)
+        done = 0
         for start in range(0, len(events), 500):
             batch = events[start : start + 500]
             for event in batch:
                 validate(event)
             events_dao.batch_insert(batch, app_id, channel_id)
-            count += len(batch)
+            done += len(batch)
+        return done
 
-    def _flush(data: bytes) -> None:
-        nonlocal count, splice
-        if splice is None:
-            _flush_slow(data)
-            return
+    def _flush(data: bytes) -> int:
+        fn = splice["fn"]
+        if fn is None:
+            return _flush_slow(data)
+        done = 0
         blob, n_spliced, fallback = _splice_import_chunk(data, now_iso)
         if blob:
             try:
-                splice(blob, app_id, channel_id)
-                count += n_spliced
+                fn(blob, app_id, channel_id)
+                done += n_spliced
             except NotImplementedError:
                 # http backend whose storage service can't splice:
                 # degrade to per-event inserts for the rest of the run
-                splice = None
-                _flush_slow(blob)
+                splice["fn"] = None
+                done += _flush_slow(blob)
         if fallback:
-            _flush_slow(fallback)
+            done += _flush_slow(fallback)
+        return done
 
     # stream line-aligned chunks so peak memory stays bounded for
-    # multi-GB event files
+    # multi-GB event files; with jobs > 1 the chunks decode + append on
+    # a thread pool (append order across chunks is immaterial: replay is
+    # last-write-wins per event id and import lines carry unique ids),
+    # with in-flight submissions bounded so a fast reader can't buffer
+    # the whole file
     chunk_size = 8 << 20
     carry = b""
-    with open(input_path, "rb") as f:
-        while True:
-            chunk = f.read(chunk_size)
-            if not chunk:
-                break
-            chunk = carry + chunk
-            cut = chunk.rfind(b"\n")
-            if cut < 0:
-                carry = chunk
-                continue
-            carry = chunk[cut + 1 :]
-            _flush(chunk[: cut + 1])
-    if carry.strip():
-        _flush(carry)
-    return count
+    futures: list = []
+    inflight = threading.BoundedSemaphore(jobs * 2)
+
+    def _run(data: bytes) -> int:
+        try:
+            return _flush(data)
+        finally:
+            inflight.release()
+
+    def _submit(pool, data: bytes) -> None:
+        if pool is None:
+            futures.append(_flush(data))
+        else:
+            inflight.acquire()
+            futures.append(pool.submit(_run, data))
+
+    pool = ThreadPoolExecutor(max_workers=jobs) if jobs > 1 else None
+    try:
+        with open(input_path, "rb") as f:
+            while True:
+                chunk = f.read(chunk_size)
+                if not chunk:
+                    break
+                chunk = carry + chunk
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    carry = chunk
+                    continue
+                carry = chunk[cut + 1 :]
+                _submit(pool, chunk[: cut + 1])
+        if carry.strip():
+            _submit(pool, carry)
+        return sum(
+            f if isinstance(f, int) else f.result() for f in futures
+        )
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True)
 
 
 # -- status (commands/Management.scala:56-160) ------------------------------

@@ -235,6 +235,8 @@ def cmd_train(args) -> int:
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.core.workflow import run_train
 
+    if getattr(args, "no_columnar_cache", False):
+        os.environ["PIO_COLUMNAR_CACHE"] = "0"
     if getattr(args, "multihost", False):
         # join the global mesh BEFORE anything touches JAX: afterwards
         # jax.devices() is the pod-wide set and --mesh axes span hosts
@@ -522,11 +524,25 @@ def cmd_import(args) -> int:
     from predictionio_tpu.data.store import EventStoreError
 
     try:
-        n = commands.import_events(args.appid_or_name, args.input, channel=args.channel)
+        n = commands.import_events(
+            args.appid_or_name, args.input,
+            channel=args.channel, jobs=args.jobs,
+        )
     except (commands.CommandError, EventStoreError) as e:
         print(str(e), file=sys.stderr)
         return 1
     print(f"Imported {n} events.")
+    if getattr(args, "warm_cache", False):
+        from predictionio_tpu.data import store
+        from predictionio_tpu.data.storage import get_storage
+
+        storage = get_storage()
+        rows = store.warm_columnar_cache(
+            commands._resolve_app_name(args.appid_or_name, storage),
+            channel_name=args.channel,
+            storage=storage,
+        )
+        print(f"Columnar cache warmed ({rows} rating rows).")
     return 0
 
 
@@ -740,6 +756,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--coordinator", help="host:port of process 0")
     t.add_argument("--num-processes", type=int)
     t.add_argument("--process-id", type=int)
+    t.add_argument(
+        "--no-columnar-cache", action="store_true",
+        help="read training events from the row logs instead of the "
+        "columnar segment cache (sets PIO_COLUMNAR_CACHE=0 for this run)",
+    )
     t.set_defaults(fn=cmd_train)
 
     ev = sub.add_parser("eval")
@@ -832,6 +853,16 @@ def build_parser() -> argparse.ArgumentParser:
     im.add_argument("--appid-or-name", required=True)
     im.add_argument("--input", required=True)
     im.add_argument("--channel")
+    im.add_argument(
+        "--jobs", type=int, default=None,
+        help="decode/append worker threads for the bulk import "
+        "(default: PIO_IMPORT_JOBS env or min(4, cpus); 1 = sequential)",
+    )
+    im.add_argument(
+        "--warm-cache", action="store_true",
+        help="build the columnar segment cache right after the import "
+        "so the first train reads mmap'ed column blocks",
+    )
     im.set_defaults(fn=cmd_import)
 
     tpl = sub.add_parser("template")

@@ -365,6 +365,13 @@ class Events(abc.ABC):
     # where a filtered read costs a full replay anyway).
     entity_indexed = False
 
+    # True when scan_ratings can serve warm reads from a persisted
+    # columnar segment cache (see storage/columnar_cache.py). Tooling
+    # like store.warm_columnar_cache keys on this to decide whether a
+    # priming scan buys anything; the default row-walk below remains
+    # the correctness oracle either way.
+    supports_columnar_cache = False
+
     def change_token(
         self, app_id: int, channel_id: int | None = None
     ) -> object | None:

@@ -31,7 +31,7 @@ except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
     fcntl = None
 
 from predictionio_tpu.data.event import Event
-from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage import base, columnar_cache
 from predictionio_tpu.data.storage.memory import query_events
 
 logger = logging.getLogger(__name__)
@@ -263,6 +263,8 @@ class JSONLStorageClient:
 
 
 class JSONLEvents(base.Events):
+    supports_columnar_cache = True
+
     def __init__(self, client: JSONLStorageClient):
         self._c = client
 
@@ -417,6 +419,7 @@ class JSONLEvents(base.Events):
         with self._locked(app_id, channel_id) as path:
             existed = path.exists()
             path.unlink(missing_ok=True)
+            columnar_cache.drop(path)
             f = self._c.append_fds.pop(str(path), None)
             if f is not None:
                 f.close()
@@ -509,6 +512,10 @@ class JSONLEvents(base.Events):
             # would un-durable them for a crash window
             os.fsync(f.fileno())
         tmp.replace(path)
+        # the replaced log has a new (mtime_ns, size) so a cached
+        # columnar block could never serve stale — dropping it just
+        # reclaims the disk immediately
+        columnar_cache.drop(path)
         return len(table)
 
     def compact(self, app_id: int, channel_id: int | None = None) -> int:
@@ -594,6 +601,12 @@ class JSONLEvents(base.Events):
         inserts only, so the precondition is one cheap byte/span pass
         (reused for the ratings extraction — single scan when no
         compaction is needed).
+
+        A columnar cache (see columnar_cache.py) sits in front of the
+        whole path: a warm scan mmaps packed column blocks keyed by the
+        log's (mtime_ns, size) and never reads the row log at all; a
+        miss runs the row path below (the correctness oracle) and then
+        publishes fresh blocks for the next scan.
         """
         from predictionio_tpu import native
 
@@ -612,14 +625,46 @@ class JSONLEvents(base.Events):
             target_entity_type=target_entity_type,
             override_ratings=override_ratings,
         )
+        use_cache = columnar_cache.enabled(self._c.config)
+        if use_cache:
+            # probe under the lock (stat + mmap are cheap); decode
+            # outside it — the mapping snapshots the inode, so a
+            # concurrent compact replacing the file can't corrupt us,
+            # and its new stat just makes the next probe miss
+            with self._locked(app_id, channel_id) as path:
+                cb = None
+                if path.exists():
+                    st = _stat(path)
+                    if st[1] > 0:
+                        cb = columnar_cache.load(columnar_cache.cache_path(path))
+                        if cb is not None and not cb.valid_for(st):
+                            cb = None
+            if cb is not None:
+                try:
+                    hit = cb.ratings(**filters)
+                except Exception:  # corrupt payload bytes: fall back
+                    logger.warning(
+                        "columnar cache decode failed; using row scan",
+                        exc_info=True,
+                    )
+                    hit = None
+                if hit is not None:
+                    users, items, rows, cols, vals = hit
+                    return base.RatingsBatch(
+                        entity_ids=users, target_ids=items,
+                        rows=rows, cols=cols, vals=vals,
+                    )
+        served_stat = None
         with self._locked(app_id, channel_id) as path:
             buf = path.read_bytes() if path.exists() else b""
             snap_stat = _stat(path) if buf else None
+            served_stat = snap_stat
             # multi-GB logs prove cleanliness and extract in line-aligned
             # chunks OUTSIDE the lock: whole-buffer span tables
             # (~176 B/line) would rival the 20M-event e2e's entire RSS
             # budget. The snapshot is immutable, so proof + extraction
             # of it are race-free; small logs keep the single-lock flow.
+            scanned = None
             big = len(buf) > SCAN_CHUNK_BYTES
             if big:
                 clean_cached = self._c.clean_stat.get(path) == snap_stat
@@ -639,7 +684,8 @@ class JSONLEvents(base.Events):
                     # post-compact (or just-proven-clean) logs stay
                     # clean until the file changes; record the stat so
                     # the next read skips the uniqueness pass
-                    self._c.clean_stat[path] = _stat(path)
+                    served_stat = _stat(path)
+                    self._c.clean_stat[path] = served_stat
         if big:
             if clean_cached:
                 res = native.load_ratings_jsonl_chunked(
@@ -657,7 +703,8 @@ class JSONLEvents(base.Events):
                         self._compact_locked(app_id, channel_id, path)
                         buf = path.read_bytes()
                         if buf:
-                            self._c.clean_stat[path] = _stat(path)
+                            served_stat = _stat(path)
+                            self._c.clean_stat[path] = served_stat
                     # compact output is unique by construction
                     res = native.load_ratings_jsonl_chunked(
                         buf, chunk_bytes=SCAN_CHUNK_BYTES, **filters
@@ -669,6 +716,24 @@ class JSONLEvents(base.Events):
             users, items, rows, cols, vals = native.load_ratings_jsonl(
                 buf, scanned=scanned, **filters
             )
+        if use_cache and buf and served_stat is not None:
+            # publish column blocks for the bytes just served, keyed by
+            # the stat captured under the same lock as those bytes — a
+            # concurrent append after release changes the stat, so the
+            # pairing can never serve stale. Best-effort: a failed build
+            # only costs the next scan its shortcut.
+            try:
+                blocks = columnar_cache.build_blocks(
+                    buf, rating_key,
+                    scanned=None if big else scanned,
+                    chunk_bytes=SCAN_CHUNK_BYTES,
+                )
+                if blocks is not None:
+                    columnar_cache.store(
+                        columnar_cache.cache_path(path), served_stat, blocks
+                    )
+            except Exception:  # pragma: no cover - cache is optional
+                logger.warning("columnar cache build failed", exc_info=True)
         return base.RatingsBatch(
             entity_ids=users, target_ids=items, rows=rows, cols=cols, vals=vals
         )

@@ -7,6 +7,7 @@ model id under ``PIO_FS_BASEDIR``).
 
 from __future__ import annotations
 
+import os
 from pathlib import Path
 from urllib.parse import quote
 
@@ -30,7 +31,17 @@ class LocalFSModels(base.Models):
         return self._c.base_path / f"pio_model_{safe}.bin"
 
     def insert(self, model: base.Model) -> None:
-        self._path(model.id).write_bytes(model.models)
+        # tmp + fsync + rename: a deploy that re-reads the model mid-write
+        # (or a crash during a multi-GB publish) must never see a torn
+        # file — same publish discipline as the event segments and the
+        # columnar cache blocks
+        path = self._path(model.id)
+        tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            f.write(model.models)
+            f.flush()
+            os.fsync(f.fileno())
+        tmp.replace(path)
 
     def get(self, model_id: str) -> base.Model | None:
         p = self._path(model_id)

@@ -63,7 +63,7 @@ except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
     fcntl = None
 
 from predictionio_tpu.data.event import Event
-from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage import base, columnar_cache
 from predictionio_tpu.data.storage.jsonl import (
     SCAN_CHUNK_BYTES,
     _chunked_clean_extract,
@@ -141,6 +141,8 @@ class PartitionedStorageClient:
 class PartitionedEvents(base.Events):
     """Events DAO over hash-partitioned segment logs (capability subset:
     events only — like hbase in the reference, SURVEY §2.3)."""
+
+    supports_columnar_cache = True
 
     def __init__(self, client: PartitionedStorageClient):
         self._c = client
@@ -406,16 +408,23 @@ class PartitionedEvents(base.Events):
                 if not nonempty[i]:
                     continue
                 line = lines[i]
-                if line.startswith(b'{"$delete"'):
-                    delete_ids.add(json.loads(line)["$delete"])
-                    delete_idx.append(i)
-                    continue
-                eid = scanned.field_str(i, native.F_EVENT_ID)
+                eid = None
+                if not line.startswith(b'{"$delete"'):
+                    eid = scanned.field_str(i, native.F_EVENT_ID)
                 if eid is None:
+                    # one json.loads serves both probes: delete-marker
+                    # detection (incl. markers the byte-prefix check
+                    # missed, e.g. re-serialized with spaces) and the
+                    # eventId of a line the span scanner couldn't decode
                     try:
-                        eid = json.loads(line).get("eventId")
+                        rec = json.loads(line)
                     except ValueError:  # pragma: no cover - corrupt line
-                        eid = None
+                        continue
+                    if "$delete" in rec:
+                        delete_ids.add(rec["$delete"])
+                        delete_idx.append(i)
+                        continue
+                    eid = rec.get("eventId")
                 if eid is not None:
                     present.add(eid)
             supersedes = sorted(
@@ -456,6 +465,10 @@ class PartitionedEvents(base.Events):
         with open(active, "rb") as f:
             os.fsync(f.fileno())
         active.rename(seg)
+        # the rename preserves the file's bytes, size, and mtime, so a
+        # columnar cache built for the active log stays valid — carry it
+        # to the segment's name instead of rebuilding on the next scan
+        columnar_cache.move(active, seg)
         self._c.committers.get(active).mark_all_durable()
         # atomic: a torn sidecar would otherwise poison every windowed
         # find of this partition (replay parses it)
@@ -755,7 +768,7 @@ class PartitionedEvents(base.Events):
             per_part[int(pp)] = [
                 blob[starts[i]:ends[i]] for i in idx
             ]
-        for pp, lines in per_part.items():
+        def write_part(pp: int, lines: list[bytes]) -> None:
             pdir = self._pdir(ns, pp)
             with self._locked(pdir):
                 self._ensure_meta_locked(ns, n)
@@ -767,6 +780,22 @@ class PartitionedEvents(base.Events):
                     (pdir / "active.opaque").touch()
                 self._append_locked(pdir, b"".join(lines))
                 self._maybe_seal_locked(pdir)
+
+        if len(per_part) > 1:
+            # fan the per-partition appends out on threads: each append
+            # fsyncs its own active log, and P serial fsyncs (not the
+            # byte writes) dominate bulk-import wall clock. Partition
+            # locks keep each append's durability semantics identical
+            # to the sequential loop; list() re-raises worker errors.
+            with ThreadPoolExecutor(
+                max_workers=min(len(per_part), os.cpu_count() or 4)
+            ) as pool:
+                list(
+                    pool.map(lambda kv: write_part(*kv), per_part.items())
+                )
+        else:
+            for pp, lines in per_part.items():
+                write_part(pp, lines)
 
     def change_token(
         self, app_id: int, channel_id: int | None = None
@@ -925,6 +954,7 @@ class PartitionedEvents(base.Events):
 
         for seg in self._segments(pdir):
             (pdir / (seg.stem + ".meta.json")).unlink(missing_ok=True)
+            columnar_cache.drop(seg)
             seg.unlink()
         (pdir / "supersede.log").unlink(missing_ok=True)
         (pdir / "active.opaque").unlink(missing_ok=True)
@@ -960,6 +990,10 @@ class PartitionedEvents(base.Events):
         self._write_atomic(
             active, b"".join(lines[eid] for eid in chunk)
         )
+        # the rewritten active's columnar blocks (if any) describe the
+        # pre-compaction bytes; the fresh (mtime_ns, size) could never
+        # serve them stale, so dropping just reclaims the disk now
+        columnar_cache.drop(active)
         # every live record is now in a fsync'ed file (segments + active
         # via _write_atomic): release any group-commit waiters
         self._c.committers.get(active).mark_all_durable()
@@ -1037,11 +1071,14 @@ class PartitionedEvents(base.Events):
     @staticmethod
     def _read_partition_locked(pdir: Path) -> tuple[bytes, list]:
         """Concatenated newline-normalized segment+active bytes plus the
-        per-file stat triples; caller holds the partition lock. The
+        per-file pieces ``(path, mtime_ns, size, start, end)`` — stat for
+        clean_stat / columnar-cache keys, [start, end) the file's span in
+        the returned buffer; caller holds the partition lock. The
         replay-order invariant (segments sorted, active last) lives
         ONLY here — scan_ratings and export both read through it."""
         parts: list[bytes] = []
         stats: list = []
+        pos = 0
         files = list(PartitionedEvents._segments(pdir))
         active = pdir / "active.jsonl"
         if active.exists():
@@ -1051,7 +1088,10 @@ class PartitionedEvents(base.Events):
             if b and not b.endswith(b"\n"):
                 b += b"\n"
             st = path.stat()
-            stats.append((str(path), st.st_mtime_ns, st.st_size))
+            stats.append(
+                (str(path), st.st_mtime_ns, st.st_size, pos, pos + len(b))
+            )
+            pos += len(b)
             parts.append(b)
         return b"".join(parts), stats
 
@@ -1071,24 +1111,29 @@ class PartitionedEvents(base.Events):
         ``forbid_blank_lines``: additionally compact partitions whose
         buffers may contain empty/whitespace lines (the clean proof
         tolerates them; a verbatim export must not, or its record count
-        and output would include non-records). Returns (pbufs, scans)
-        where scans[pp] is a reusable span scan or None."""
+        and output would include non-records). Returns (pbufs, scans,
+        pieces) where scans[pp] is a reusable span scan or None and
+        pieces[pp] lists the partition's per-file
+        ``(path, mtime_ns, size, start, end)`` spans — the keys the
+        columnar cache is addressed by."""
         from predictionio_tpu import native
         from predictionio_tpu.data.storage.jsonl import _maybe_blank_lines
 
-        def read_all() -> tuple[list[bytes], tuple]:
+        def read_all() -> tuple[list[bytes], list[list], tuple]:
             pbufs: list[bytes] = []
+            pieces: list[list] = []
             stats: list = []
             for pp in range(n):
                 buf, st = self._read_partition_locked(self._pdir(ns, pp))
                 pbufs.append(buf)
+                pieces.append(st)
                 stats.extend(st)
-            return pbufs, tuple(stats)
+            return pbufs, pieces, tuple(stats)
 
-        pbufs, stat_key = read_all()
+        pbufs, pieces, stat_key = read_all()
         scans: list = [None] * n
         if not any(pbufs):
-            return pbufs, scans
+            return pbufs, scans, pieces
         dirty_blanks = forbid_blank_lines and any(
             _maybe_blank_lines(b) for b in pbufs if b
         )
@@ -1112,11 +1157,11 @@ class PartitionedEvents(base.Events):
                     self._compact_partition_locked(self._pdir(ns, pp))
                     compacted = True
             if compacted:
-                pbufs, stat_key = read_all()
+                pbufs, pieces, stat_key = read_all()
                 scans = [None] * n
         with self._c.lock:
             self._c.clean_stat[ns] = stat_key
-        return pbufs, scans
+        return pbufs, scans, pieces
 
     # -- columnar bulk read ------------------------------------------------
 
@@ -1152,8 +1197,9 @@ class PartitionedEvents(base.Events):
             return base.RatingsBatch.empty()
         n = self._n_partitions(ns)
 
+        use_cache = columnar_cache.enabled(self._c.config)
         with self._locked_all(ns, n):
-            pbufs, scans = self._proven_clean_buffers_locked(ns, n)
+            pbufs, scans, pieces = self._proven_clean_buffers_locked(ns, n)
         if not any(pbufs):
             return base.RatingsBatch.empty()
         # buffers are immutable snapshots: parse outside the locks
@@ -1173,6 +1219,8 @@ class PartitionedEvents(base.Events):
         def load_one(pp: int, n_threads: int = 0):
             buf = pbufs[pp]
             try:
+                if use_cache:
+                    return load_one_cached(pp, buf, n_threads)
                 if scans[pp] is None and len(buf) > SCAN_CHUNK_BYTES:
                     # big partition: extract through line-aligned chunks
                     # so the span arrays are O(chunk), not O(partition)
@@ -1191,6 +1239,50 @@ class PartitionedEvents(base.Events):
                 # the snapshot is parsed; release it before the other
                 # partitions finish (bounds peak RSS to live buffers)
                 pbufs[pp] = None
+
+        def load_one_cached(pp: int, buf: bytes, n_threads: int):
+            """Per-FILE columnar cache: segments are immutable, so a
+            sealed segment's blocks survive appends to active and only a
+            compaction (which rewrites the files) invalidates them. The
+            partition was just proven replay-clean as a whole, so each
+            file's records are a plain unique set and merging the
+            per-file results in replay order (segments sorted, active
+            last) reproduces the whole-buffer scan's first-appearance
+            dense id order exactly."""
+            merge_p = native.DenseMerge()
+            for (fpath, mtime_ns, size, s, e) in pieces[pp]:
+                if s == e:
+                    continue
+                piece_stat = (mtime_ns, size)
+                cpath = columnar_cache.cache_path(Path(fpath))
+                res = None
+                cb = columnar_cache.load(cpath)
+                if cb is not None and cb.valid_for(piece_stat):
+                    try:
+                        res = cb.ratings(**filters)
+                    except Exception:  # corrupt payload: row scan below
+                        res = None
+                if res is None:
+                    piece = buf[s:e]
+                    if len(piece) > SCAN_CHUNK_BYTES:
+                        res = native.load_ratings_jsonl_chunked(
+                            piece, chunk_bytes=SCAN_CHUNK_BYTES,
+                            n_threads=n_threads, **filters
+                        )
+                    else:
+                        res = native.load_ratings_jsonl(
+                            piece, n_threads=n_threads, **filters
+                        )
+                    try:
+                        blocks = columnar_cache.build_blocks(
+                            piece, rating_key, chunk_bytes=SCAN_CHUNK_BYTES
+                        )
+                        if blocks is not None:
+                            columnar_cache.store(cpath, piece_stat, blocks)
+                    except Exception:  # pragma: no cover - cache optional
+                        pass
+                merge_p.add(*res)
+            return merge_p.result()
 
         if len(live) == 1:
             results = [load_one(live[0])]

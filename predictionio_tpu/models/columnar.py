@@ -35,12 +35,17 @@ class IndexedRatings:
 
 def _merge_item_index(
     extra_items: Iterable[str], batch_item_ids: Sequence[str]
-) -> tuple[BiMap, np.ndarray]:
+) -> tuple[BiMap, np.ndarray | None]:
     """Item index covering property-only items (known from ``$set``
     entities, so they get factor slots) plus every item in the batch;
     returns it with a [len(batch_item_ids)] remap from batch-dense to
-    index-dense columns."""
-    item_index = BiMap.string_int(list(extra_items) + list(batch_item_ids))
+    index-dense columns (None = identity: batch ids are already dense
+    in first-seen order, so with no extra items the per-id Python remap
+    loop — millions of iterations at event-store scale — is pure waste)."""
+    extra = list(extra_items)
+    if not extra:
+        return BiMap.from_dense(list(batch_item_ids)), None
+    item_index = BiMap.string_int(extra + list(batch_item_ids))
     remap = np.fromiter(
         (item_index[i] for i in batch_item_ids),
         dtype=np.int32,
@@ -67,7 +72,7 @@ def aggregate_counts(
         user_index=BiMap.from_dense(batch.entity_ids),
         item_index=item_index,
         rows=rows,
-        cols=remap[cols_batch],
+        cols=cols_batch if remap is None else remap[cols_batch],
         vals=counts.astype(np.float32),
     )
 

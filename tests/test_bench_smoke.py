@@ -1,0 +1,35 @@
+"""bench.py --smoke: the CI contract is exit 0 and a machine-readable
+final stdout line (the driver keeps only a bounded tail of stdout, so
+the LAST line must parse with json.loads on its own)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+BENCH = Path(__file__).resolve().parent.parent / "bench.py"
+
+
+def test_smoke_exit_zero_and_final_line_is_json():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("BENCH_SMOKE_EVENTS", "5000")
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "--smoke"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(BENCH.parent),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.strip().splitlines()
+    assert lines, "smoke run printed nothing"
+    summary = json.loads(lines[-1])  # the tail-capture contract
+    assert summary["smoke"] is True
+    assert summary["metric"] == "bench_smoke"
+    # the storage section actually ran: both backends reported
+    st = summary.get("storage", {})
+    assert "error_sections" not in summary, summary
+    assert "jsonl" in st and "partitioned" in st
+    for bk in ("jsonl", "partitioned"):
+        assert st[bk]["scan_speedup"] > 0
+        assert st[bk]["import_pooled_events_per_s"] > 0
