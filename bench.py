@@ -63,6 +63,7 @@ RUN_SCALES = [
 RUN_CPU_BASELINE = os.environ.get("BENCH_BASELINE", "1") == "1"
 RUN_SERVING = os.environ.get("BENCH_SERVING", "1") == "1"
 RUN_INGEST = os.environ.get("BENCH_INGEST", "1") == "1"
+RUN_SCALING = os.environ.get("BENCH_SCALING", "1") == "1"
 E2E_EVENTS = int(os.environ.get("BENCH_E2E_EVENTS", "20000000"))
 # high-rank MFU sweep at the 20m scale (comma list; empty disables)
 RANK_SWEEP = [
@@ -150,6 +151,21 @@ def numpy_als(buckets_row, buckets_col, num_u, num_i, rank, iterations, reg, see
     return U, V
 
 
+def gather_bytes_per_iter(data, rank: int, storage_dtype: str) -> float:
+    """HBM bytes the factor gathers read per full iteration: each bucket
+    gathers ``col_ids.size`` rows of the opposite table per half-step.
+    int8 rows carry ``rank`` value bytes plus one f32 per-row scale."""
+    row_bytes = {
+        "float32": 4 * rank, "bfloat16": 2 * rank, "int8": rank + 4,
+    }[storage_dtype]
+    slots = sum(
+        b.col_ids.size
+        for bs in (data.row_buckets, data.col_buckets)
+        for b in bs
+    )
+    return float(slots * row_bytes)
+
+
 def als_flops(data, rank: int, iterations: int) -> float:
     """Statically-known model FLOPs of the fused training program: per
     bucket per half-step, the Gramian batched matmul (2*B*K*D^2), the rhs
@@ -168,15 +184,19 @@ def als_flops(data, rank: int, iterations: int) -> float:
 def time_train(als, data, params, repeats: int):
     import dataclasses
 
+    def ready(table):  # int8 tables are (values, scales) pairs
+        for leaf in table if isinstance(table, tuple) else (table,):
+            leaf.block_until_ready()
+
     warm = dataclasses.replace(params, iterations=1)
-    als.als_train(data, warm)[0].block_until_ready()
+    ready(als.als_train(data, warm)[0])
     times = []
     U = V = None
     for _ in range(repeats):
         t0 = time.perf_counter()
         U, V = als.als_train(data, params)
-        U.block_until_ready()
-        V.block_until_ready()
+        ready(U)
+        ready(V)
         times.append(time.perf_counter() - t0)
     return sorted(times)[len(times) // 2], U, V
 
@@ -195,8 +215,13 @@ def core_child(scale: str, dtype: str, rank: int = RANK) -> None:
     # dtype tokens: float32 | bfloat16 (compute only) | bf16_store
     # (bf16 compute AND bf16 factor storage — halves the HBM bytes of
     # the dominant gathers; f32 normal-equation accumulation throughout)
+    # | int8_store (int8 factor storage with per-row f32 scales:
+    # ~rank/(4*rank) of the f32 gather bytes + 4 scale bytes/row; the
+    # Gramian/solve stay f32 — ops/als.py quantize_rows)
     compute = "bfloat16" if dtype in ("bfloat16", "bf16_store") else "float32"
-    storage = "bfloat16" if dtype == "bf16_store" else "float32"
+    storage = {"bf16_store": "bfloat16", "int8_store": "int8"}.get(
+        dtype, "float32"
+    )
     params = als.ALSParams(
         rank=rank, iterations=ITERATIONS, reg=REG, seed=SEED,
         compute_dtype=compute, storage_dtype=storage,
@@ -207,6 +232,9 @@ def core_child(scale: str, dtype: str, rank: int = RANK) -> None:
         "train_s": round(tpu_s, 4),
         "rmse": round(als.rmse(U, V, rows, cols, vals), 4),
         "model_flops": als_flops(data, rank, ITERATIONS),
+        "gather_mb_per_iter": round(
+            gather_bytes_per_iter(data, rank, storage) / 2**20, 2
+        ),
     }))
 
 
@@ -225,12 +253,29 @@ def _run_core_child(scale: str, dtype: str, rank: int | None = None) -> dict:
 
 
 def bench_core(scale: str, extras: dict, result: dict) -> None:
-    """Core fused-training benchmark at one MovieLens scale, f32 (+bf16
-    and MFU at the 20m north-star scale). Each measurement runs in a
-    fresh subprocess (see core_child)."""
+    """Core fused-training benchmark at one MovieLens scale: an
+    f32/bf16/int8 factor-STORAGE dtype sweep (train_s + gather bytes at
+    each dtype; the quantization perf story in one table), plus bf16
+    compute and MFU at the 20m north-star scale. Each measurement runs
+    in a fresh subprocess (see core_child)."""
     child = _run_core_child(scale, "float32")
     tpu_s, rmse, flops = child["train_s"], child["rmse"], child["model_flops"]
     entry = {"train_s": tpu_s, "rmse": rmse}
+
+    sweep = {"f32": {
+        "train_s": tpu_s, "rmse": rmse,
+        "gather_mb_per_iter": child.get("gather_mb_per_iter"),
+    }}
+    for token, key in (("bf16_store", "bf16"), ("int8_store", "int8")):
+        d = _run_core_child(scale, token)
+        sweep[key] = {
+            "train_s": d["train_s"],
+            "rmse": d["rmse"],
+            "gather_mb_per_iter": d.get("gather_mb_per_iter"),
+            "speedup_vs_f32": round(tpu_s / d["train_s"], 2),
+            "rmse_delta_vs_f32": round(d["rmse"] - rmse, 4),
+        }
+    extras.setdefault("dtype_sweep", {})[scale] = sweep
 
     if scale == "100k":
         result.update(value=tpu_s, rmse=rmse)
@@ -267,14 +312,28 @@ def bench_core(scale: str, extras: dict, result: dict) -> None:
             "f32_rmse": rmse,
         }
         # bf16 factor STORAGE: halves the gather-side HBM traffic the
-        # rank-20 north star is bound by (VERDICT r3 item 2)
-        bs = _run_core_child(scale, "bf16_store")
+        # rank-20 north star is bound by (VERDICT r3 item 2); measured
+        # in the dtype sweep above
+        bs = sweep["bf16"]
         entry["bf16_storage_train_s"] = bs["train_s"]
         entry["bf16_storage_rmse"] = bs["rmse"]
         extras["bf16_storage"] = {
             "train_s": bs["train_s"],
             "rmse": bs["rmse"],
-            "speedup_vs_f32": round(tpu_s / bs["train_s"], 2),
+            "speedup_vs_f32": bs["speedup_vs_f32"],
+            "f32_train_s": tpu_s,
+            "f32_rmse": rmse,
+        }
+        # int8 factor STORAGE halves it AGAIN (rank+4 bytes/row vs
+        # 2*rank bf16); RMSE-parity bar is tested in tests/test_als.py
+        i8 = sweep["int8"]
+        entry["int8_storage_train_s"] = i8["train_s"]
+        entry["int8_storage_rmse"] = i8["rmse"]
+        extras["int8_storage"] = {
+            "train_s": i8["train_s"],
+            "rmse": i8["rmse"],
+            "speedup_vs_f32": i8["speedup_vs_f32"],
+            "gather_mb_per_iter": i8["gather_mb_per_iter"],
             "f32_train_s": tpu_s,
             "f32_rmse": rmse,
         }
@@ -346,6 +405,23 @@ _CLIENT_PREAMBLE = (
     "c.connect()\n"
     "sys.stdout.write('R'); sys.stdout.flush()\n"
     "sys.stdin.readline()\n"
+)
+
+
+# one event per request over a persistent connection; `off` (the 5th
+# client arg) keys entity ids so concurrent clients never collide
+_SINGLE_EVENT_CLIENT_BODY = (
+    "import json\n"
+    "for j in range(n):\n"
+    "    p={'event':'rate','entityType':'user',\n"
+    "       'entityId':f'cu{off}_{j}','targetEntityType':'item',\n"
+    "       'targetEntityId':f'i{j%97}',\n"
+    "       'properties':{'rating':float(j%5+1)},\n"
+    "       'eventTime':'2020-01-01T00:00:00.000Z'}\n"
+    "    c.request('POST',path,body=json.dumps(p),\n"
+    "              headers={'Content-Type':'application/json'})\n"
+    "    r=c.getresponse(); r.read()\n"
+    "    assert r.status==201, r.status\n"
 )
 
 
@@ -613,19 +689,7 @@ def bench_ingest(extras: dict) -> None:
         # client, not the server). Subprocess keeps the client off this
         # process's GIL. Each request pays its own commit wait — the
         # sequential floor, no coalescing possible in sync=always mode.
-        ingest_body = (
-            "import json\n"
-            "for j in range(n):\n"
-            "    p={'event':'rate','entityType':'user',\n"
-            "       'entityId':f'cu{off}_{j}','targetEntityType':'item',\n"
-            "       'targetEntityId':f'i{j%97}',\n"
-            "       'properties':{'rating':float(j%5+1)},\n"
-            "       'eventTime':'2020-01-01T00:00:00.000Z'}\n"
-            "    c.request('POST',path,body=json.dumps(p),\n"
-            "              headers={'Content-Type':'application/json'})\n"
-            "    r=c.getresponse(); r.read()\n"
-            "    assert r.status==201, r.status\n"
-        )
+        ingest_body = _SINGLE_EVENT_CLIENT_BODY
         n_single = 300
         single_s = _run_gated_clients(
             ingest_body, "127.0.0.1", port,
@@ -698,15 +762,119 @@ def bench_ingest(extras: dict) -> None:
         server.stop()
 
 
+def bench_scaling(extras: dict) -> None:
+    """Scaling-curve harness: event-server ingest throughput vs
+    ``--workers {1,2,4}`` (SO_REUSEPORT process fan-out — the
+    multi-process path past the GIL) and the partitioned scanner's
+    native thread count. On a 1-core box every curve is flat by
+    construction; the machine-readable ``cores`` field says so and the
+    numbers then validate per-worker overhead, not scaling."""
+    import shutil
+    import socket
+    import subprocess
+    import sys as _sys
+
+    from predictionio_tpu.data.storage import AccessKey, App, Storage
+
+    cores = os.cpu_count() or 1
+    out: dict = {"cores": cores, "flat_by_construction": cores == 1}
+    tmpdir = os.environ["BENCH_TMPDIR"]
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    workers_out: dict = {}
+    n_procs = 4
+    per_proc = int(os.environ.get("BENCH_SCALING_EVENTS_PER_CLIENT", "100"))
+    for w in (1, 2, 4):
+        root = os.path.join(tmpdir, f"scaling_w{w}")
+        os.makedirs(root, exist_ok=True)
+        env = dict(
+            os.environ,
+            PIO_STORAGE_SOURCES_DB_TYPE="sqlite",
+            PIO_STORAGE_SOURCES_DB_PATH=os.path.join(root, "pio.db"),
+            PIO_STORAGE_SOURCES_LOG_TYPE="jsonl",
+            PIO_STORAGE_SOURCES_LOG_PATH=os.path.join(root, "ev"),
+            PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="DB",
+            PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="LOG",
+            PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="DB",
+            JAX_PLATFORMS="cpu",  # workers never touch the accelerator
+        )
+        storage = Storage(env=env)
+        app_id = storage.get_metadata_apps().insert(App(0, "BenchScale"))
+        key = storage.get_metadata_access_keys().insert(
+            AccessKey("", app_id, [])
+        )
+        storage.get_events().init(app_id)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        sup = subprocess.Popen(
+            [_sys.executable, "-m", "predictionio_tpu.cli.main",
+             "eventserver", "--ip", "127.0.0.1", "--port", str(port),
+             "--workers", str(w)],
+            env=env, cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            for _ in range(240):
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=2
+                    )
+                    break
+                except Exception:
+                    time.sleep(0.25)
+            else:
+                raise RuntimeError(
+                    f"eventserver --workers {w} never came up"
+                )
+            dt = _run_gated_clients(
+                _SINGLE_EVENT_CLIENT_BODY, "127.0.0.1", port,
+                f"/events.json?accessKey={key}", n_procs, per_proc,
+            )
+            total = n_procs * per_proc
+            workers_out[f"workers{w}"] = {
+                "events_per_s": round(total / dt),
+                "events_per_s_per_worker": round(total / dt / w),
+            }
+        finally:
+            sup.terminate()
+            sup.wait(timeout=15)
+            shutil.rmtree(root, ignore_errors=True)
+    out["eventserver_workers"] = {"clients": n_procs, **workers_out}
+
+    # partitioned-scan native threads: the per-buffer codec fan-out the
+    # partitioned backend hands each pooled worker (ctypes releases the
+    # GIL, so these are real threads)
+    from predictionio_tpu import native
+
+    n = int(os.environ.get("BENCH_SCALING_SCAN_EVENTS", "200000"))
+    path = os.path.join(tmpdir, "scaling_scan.jsonl")
+    _write_events_file(path, n)
+    with open(path, "rb") as f:
+        buf = f.read()
+    os.unlink(path)
+    native.load_ratings_jsonl(buf, event_names=["rate"], n_threads=1)  # warm
+    threads_out: dict = {"events": n}
+    for t in ((1,) if cores == 1 else (1, 2, 4)):
+        t0 = time.perf_counter()
+        res = native.load_ratings_jsonl(
+            buf, event_names=["rate"], n_threads=t
+        )
+        threads_out[f"threads{t}"] = {
+            "scan_s": round(time.perf_counter() - t0, 3),
+            "rows": len(res[2]),
+        }
+    out["partitioned_scan_threads"] = threads_out
+    extras["scaling"] = out
+
+
 def bench_e2e(extras: dict) -> None:
     """import -> train through the whole framework at event-store scale:
     splice import into the jsonl log, columnar native scan, fused device
     train — with peak-RSS accounting (VERDICT r2 item 3)."""
     from predictionio_tpu.cli import commands
-    from predictionio_tpu.core.engine import WorkflowParams
-    from predictionio_tpu.core.workflow import run_train
     from predictionio_tpu.data.storage import App, get_storage
-    from predictionio_tpu.models import recommendation
 
     storage = get_storage()
     storage.get_metadata_apps().insert(App(0, "BenchE2E"))
@@ -784,7 +952,6 @@ def bench_e2e(extras: dict) -> None:
         other["error"] = f"{type(e).__name__}: {e}"
     os.unlink(path)
 
-    engine = recommendation.engine()
     variant = {
         "id": "bench-e2e",
         "engineFactory": "predictionio_tpu.models.recommendation.engine",
@@ -792,23 +959,57 @@ def bench_e2e(extras: dict) -> None:
         "algorithms": [{"name": "als",
                         "params": {"rank": RANK, "num_iterations": ITERATIONS}}],
     }
-    t0 = time.perf_counter()
-    run_train(
-        engine, engine.params_from_variant(variant), engine_id="bench-e2e",
-        engine_factory="predictionio_tpu.models.recommendation.engine",
-        workflow_params=WorkflowParams(batch="bench"), storage=storage,
+    # the TRAIN phase (columnar scan + bucketing + device train) runs in
+    # its OWN subprocess: ru_maxrss is a process-wide high-water mark, so
+    # only separate processes yield separately-attributable storage-side
+    # vs train-side peak RSS (the 20M RSS-bound claim needs both). The
+    # child inherits this process's storage env (same sqlite/log tmpdir).
+    train_code = (
+        "import json, resource, sys, time\n"
+        "from predictionio_tpu.utils import apply_platform_env\n"
+        "apply_platform_env()\n"
+        "from predictionio_tpu.core.engine import WorkflowParams\n"
+        "from predictionio_tpu.core.workflow import run_train\n"
+        "from predictionio_tpu.models import recommendation\n"
+        "variant = json.loads(sys.argv[1])\n"
+        "engine = recommendation.engine()\n"
+        "t0 = time.perf_counter()\n"
+        "run_train(engine, engine.params_from_variant(variant),\n"
+        "          engine_id='bench-e2e',\n"
+        "          engine_factory="
+        "'predictionio_tpu.models.recommendation.engine',\n"
+        "          workflow_params=WorkflowParams(batch='bench'))\n"
+        "print(json.dumps({\n"
+        "    'train_s': round(time.perf_counter() - t0, 1),\n"
+        "    'train_peak_rss_mb': resource.getrusage(\n"
+        "        resource.RUSAGE_SELF).ru_maxrss // 1024,\n"
+        "}))\n"
     )
-    train_s = time.perf_counter() - t0
+    import subprocess as _subprocess
+    import sys as _sys2
+
+    proc = _subprocess.run(
+        [_sys2.executable, "-c", train_code, json.dumps(variant)],
+        capture_output=True, text=True, timeout=6000,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "e2e train child failed: " + proc.stderr.strip()[-500:]
+        )
+    train_child = json.loads(proc.stdout.strip().splitlines()[-1])
 
     extras["e2e"] = {
         "events": imported,
         "gen_s": round(gen_s, 1),
         "import_s": round(import_s, 1),
         "import_events_per_s": round(imported / import_s),
-        "train_s": round(train_s, 1),  # columnar scan + bucketing + device
-        # ru_maxrss is a process-wide high-water mark; the phase marks
-        # localize it (rss_before_mb predates this section entirely)
-        "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024,
+        "train_s": train_child["train_s"],  # scan + bucketing + device
+        # separate processes => separately-attributable high-water marks:
+        # storage side (this process: import) vs train side (the child)
+        "storage_peak_rss_mb": resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss // 1024,
+        "train_peak_rss_mb": train_child["train_peak_rss_mb"],
         "rss_after_import_mb": rss_after_import_mb,
         "rss_before_mb": rss_before_mb,
         "event_backend": E2E_BACKEND,
@@ -1004,6 +1205,35 @@ def sharded_child() -> None:
     )
     out["ring_halfstep"] = ring_entry
 
+    # factor-storage dtype sweep on the sharded trainer (same 5-bucket
+    # data, 8-shard mesh): train_s + the gathered bytes each dtype moves
+    # per iteration — the ICI-traffic claim behind storage_dtype
+    def ready(table):  # int8 tables are (values, scales) pairs
+        for leaf in table if isinstance(table, tuple) else (table,):
+            leaf.block_until_ready()
+
+    dt_sweep = {}
+    for sd, key in (("float32", "f32"), ("bfloat16", "bf16"), ("int8", "int8")):
+        params = als.ALSParams(
+            rank=16, iterations=2, reg=0.05, seed=SEED, storage_dtype=sd
+        )
+        U, V = sharded_als_train(data, params, mesh8)  # compile+warm
+        ready(U)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            U, V = sharded_als_train(data, params, mesh8)
+            ready(U)
+            ready(V)
+            times.append(time.perf_counter() - t0)
+        dt_sweep[key] = {
+            "train_s": round(sorted(times)[1], 4),
+            "gather_mb_per_iter": round(
+                gather_bytes_per_iter(data, 16, sd) / 2**20, 2
+            ),
+        }
+    out["dtype_sweep"] = dt_sweep
+
     # the documented memory model, quantified for the north-star shape
     d = RANK
     out["all_gather_working_set"] = {
@@ -1015,12 +1245,24 @@ def sharded_child() -> None:
         "ml20m_users_gather_mb_bf16_storage": round(
             SCALES["20m"][0] * d * 2 / 2**20, 2
         ),
+        # int8 rows: d value bytes + one f32 per-row scale (the scale
+        # rides the same all_gather/ppermute as the values)
+        "ml20m_items_gather_mb_int8_storage": round(
+            SCALES["20m"][1] * (d + 4) / 2**20, 2
+        ),
+        "ml20m_users_gather_mb_int8_storage": round(
+            SCALES["20m"][0] * (d + 4) / 2**20, 2
+        ),
         "ceiling_rows_at_rank20_half_hbm_v5e": int(8 * 2**30 / (20 * 4)),
         "ceiling_rows_at_rank20_half_hbm_v5e_bf16_storage": int(
             8 * 2**30 / (20 * 2)
         ),
+        "ceiling_rows_at_rank20_half_hbm_v5e_int8_storage": int(
+            8 * 2**30 / (20 + 4)
+        ),
         "note": "gathered opposite factors do not shrink with mesh size; "
-        "bf16 storage_dtype halves both the gather and the ICI bytes; "
+        "bf16 storage_dtype halves the gather and ICI bytes, int8 "
+        "storage_dtype (values + per-row f32 scale) halves them again; "
         "catalogs past sharded_gather_budget_bytes auto-switch to the "
         "ring half-step whose per-chip working set DOES shrink — "
         "see parallel/als_sharded.py docstring",
@@ -1048,12 +1290,37 @@ def _compact_summary(result: dict) -> dict:
     tm = result.get("20m")
     if isinstance(tm, dict) and "train_s" in tm:
         s["train_20m_s"] = tm["train_s"]
+    ds = result.get("dtype_sweep")
+    if isinstance(ds, dict):
+        s["dtype_sweep"] = {
+            scale: {
+                dt: {
+                    k: row[k]
+                    for k in ("train_s", "gather_mb_per_iter")
+                    if row.get(k) is not None
+                }
+                for dt, row in sweeps.items()
+            }
+            for scale, sweeps in ds.items()
+            if isinstance(sweeps, dict)
+        }
+    sc = result.get("scaling")
+    if isinstance(sc, dict) and "error" not in sc:
+        s["scaling"] = {"cores": sc.get("cores")}
+        ew = sc.get("eventserver_workers")
+        if isinstance(ew, dict):
+            s["scaling"]["eventserver_workers"] = {
+                k: v["events_per_s"]
+                for k, v in ew.items()
+                if isinstance(v, dict) and "events_per_s" in v
+            }
     e2e = result.get("e2e")
     if isinstance(e2e, dict) and "error" not in e2e:
         s["e2e"] = {
             k: e2e[k]
             for k in ("events", "import_events_per_s", "train_s",
-                      "peak_rss_mb", "event_backend")
+                      "storage_peak_rss_mb", "train_peak_rss_mb",
+                      "event_backend")
             if k in e2e
         }
     st = result.get("storage")
@@ -1345,6 +1612,13 @@ def main() -> None:
         except Exception as e:
             extras["ingest"] = {"error": f"{type(e).__name__}: {e}"}
         _mark("ingest")
+
+    if RUN_SCALING:
+        try:
+            bench_scaling(extras)
+        except Exception as e:
+            extras["scaling"] = {"error": f"{type(e).__name__}: {e}"}
+        _mark("scaling")
 
     # second chance a few minutes in: serving+ingest are host-heavy, so
     # a tunnel that came up during them still buys TPU core rows

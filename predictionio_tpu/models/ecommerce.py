@@ -146,20 +146,43 @@ class ECommAlgorithmParams(Params):
 class ECommModel:
     user_index: BiMap
     item_index: BiMap
-    user_factors: np.ndarray
-    item_factors: np.ndarray
+    user_factors: np.ndarray  # int8 values when user_scales set
+    item_factors: np.ndarray  # int8 values when item_scales set
     categories: dict[str, list[str]]
+    user_scales: np.ndarray | None = None  # [U] f32, int8 storage only
+    item_scales: np.ndarray | None = None  # [I] f32, int8 storage only
 
     def __post_init__(self):
         self._device = None
 
+    def user_rows(self, ixs):
+        """Dense f32 user vectors (dequantizes int8 storage)."""
+        rows = self.user_factors[ixs]
+        if self.user_scales is not None:
+            return rows.astype(np.float32) * self.user_scales[ixs][..., None]
+        return np.asarray(rows, dtype=np.float32)
+
+    def item_rows(self, ixs):
+        """Dense f32 item vectors (dequantizes int8 storage)."""
+        rows = self.item_factors[ixs]
+        if self.item_scales is not None:
+            return rows.astype(np.float32) * self.item_scales[ixs][..., None]
+        return np.asarray(rows, dtype=np.float32)
+
     def device_factors(self):
+        """(U_dev, V_dev); quantized tables stay (values, scales) pairs
+        on device — ops.topk scores them without densifying."""
         if self._device is None:
             import jax.numpy as jnp
 
+            def put(values, scales):
+                if scales is not None:
+                    return (jnp.asarray(values), jnp.asarray(scales))
+                return jnp.asarray(values)
+
             self._device = (
-                jnp.asarray(self.user_factors),
-                jnp.asarray(self.item_factors),
+                put(self.user_factors, self.user_scales),
+                put(self.item_factors, self.item_scales),
             )
         return self._device
 
@@ -214,12 +237,16 @@ class ECommAlgorithm(Algorithm):
             ctx,
             sharded=self.params.sharded_train,
         )
+        uf, us = als_ops.host_factors(U)
+        vf, vs = als_ops.host_factors(V)
         return ECommModel(
             user_index=user_index,
             item_index=item_index,
-            user_factors=np.asarray(U),
-            item_factors=np.asarray(V),
+            user_factors=uf,
+            item_factors=vf,
             categories=dict(td.items),
+            user_scales=us,
+            item_scales=vs,
         )
 
     # -- live business rules (host-side, before the device call) ----------
@@ -375,7 +402,7 @@ class ECommAlgorithm(Algorithm):
         ]
         if not ixs:
             return None
-        return model.item_factors[ixs].mean(axis=0)
+        return model.item_rows(ixs).mean(axis=0)
 
     def _category_members(self, model: ECommModel, category: str) -> np.ndarray:
         """Item indices carrying ``category`` — built once per (model,
@@ -457,7 +484,12 @@ class ECommAlgorithm(Algorithm):
                     for iid in group.get("items", []):
                         if iid in model.item_index:
                             weights[model.item_index[iid]] = w
-                weighted = V * jnp.asarray(weights)[:, None]
+                if isinstance(V, tuple):
+                    # per-row weight folds into the per-row scale: the
+                    # weighted catalog stays int8
+                    weighted = (V[0], V[1] * jnp.asarray(weights))
+                else:
+                    weighted = V * jnp.asarray(weights)[:, None]
             else:
                 weighted = V
             cache[key] = weighted
@@ -468,10 +500,11 @@ class ECommAlgorithm(Algorithm):
 
         from predictionio_tpu.ops.topk import top_k_items
 
-        U, V = model.device_factors()
         known = query.user in model.user_index
         if known:
-            user_vec = U[model.user_index[query.user]]
+            user_vec = jnp.asarray(
+                model.user_rows(model.user_index[query.user])
+            )
         else:
             recent = self._recent_item_vector(model, query.user)
             if recent is None:

@@ -185,7 +185,8 @@ class ALSAlgorithmParams(Params):
     compute_dtype: str = "float32"
     # dtype the factors are stored in between solves — "bfloat16" halves
     # the HBM gather / ICI all_gather traffic of this HBM-bound op at
-    # parity RMSE (solves still accumulate float32; ops/als.py)
+    # parity RMSE, "int8" quarters it (values + per-row f32 scale,
+    # dequantized at gather; solves still accumulate float32; ops/als.py)
     storage_dtype: str = "float32"
     # serve with item factors sharded over the device mesh (ring top-k) —
     # the TPU answer to the reference's PAlgorithm "model bigger than one
@@ -208,25 +209,55 @@ class ALSAlgorithmParams(Params):
 
 @dataclass
 class ALSModel:
-    """Host-persistable factor model; device arrays materialized lazily."""
+    """Host-persistable factor model; device arrays materialized lazily.
+
+    With ``storage_dtype="int8"`` the factor arrays hold the quantized
+    values and ``user_scales``/``item_scales`` the per-row f32 scales
+    (``row = values * scale``, ops/als.py quantize_rows) — the persisted
+    MODELDATA blob stays 4x smaller than f32, and scoring dequantizes
+    inside the jitted top-k programs. Dense models keep scales None.
+    """
 
     user_index: BiMap
     item_index: BiMap
-    user_factors: np.ndarray  # [U, D] float32
-    item_factors: np.ndarray  # [I, D] float32
+    user_factors: np.ndarray  # [U, D] float32/bf16, or int8 values
+    item_factors: np.ndarray  # [I, D] float32/bf16, or int8 values
+    user_scales: np.ndarray | None = None  # [U] float32 when int8
+    item_scales: np.ndarray | None = None  # [I] float32 when int8
 
     def __post_init__(self):
         self._device = None
         self._ring = None
 
+    def user_rows(self, ixs):
+        """Dense f32 user vectors for the given indices (dequantizes
+        int8 storage) — the per-query [*, D] gather, done host-side."""
+        rows = self.user_factors[ixs]
+        if self.user_scales is not None:
+            return rows.astype(np.float32) * self.user_scales[ixs][..., None]
+        return np.asarray(rows, dtype=np.float32)
+
+    def item_table(self):
+        """The item factor table in scorer form: the (int8 values, f32
+        scales) pair for quantized models, else the dense array."""
+        if self.item_scales is not None:
+            return (self.item_factors, self.item_scales)
+        return self.item_factors
+
     def device_factors(self):
-        """(U_dev, V_dev) cached on current default device."""
+        """(U_dev, V_dev) cached on current default device; quantized
+        tables stay (values, scales) pairs on device."""
         if self._device is None:
             import jax.numpy as jnp
 
+            def put(values, scales):
+                if scales is not None:
+                    return (jnp.asarray(values), jnp.asarray(scales))
+                return jnp.asarray(values)
+
             self._device = (
-                jnp.asarray(self.user_factors),
-                jnp.asarray(self.item_factors),
+                put(self.user_factors, self.user_scales),
+                put(self.item_factors, self.item_scales),
             )
         return self._device
 
@@ -237,7 +268,7 @@ class ALSModel:
             from predictionio_tpu.parallel.mesh import make_mesh
             from predictionio_tpu.parallel.ring_topk import RingCatalog
 
-            self._ring = RingCatalog(self.item_factors, make_mesh())
+            self._ring = RingCatalog(self.item_table(), make_mesh())
         return self._ring
 
     def __getstate__(self):
@@ -289,11 +320,15 @@ class ALSAlgorithm(Algorithm):
             self.params.rank,
             als_ops.rmse(U, V, rows, cols, vals),
         )
+        uf, us = als_ops.host_factors(U)
+        vf, vs = als_ops.host_factors(V)
         return ALSModel(
             user_index=user_index,
             item_index=item_index,
-            user_factors=np.asarray(U),
-            item_factors=np.asarray(V),
+            user_factors=uf,
+            item_factors=vf,
+            user_scales=us,
+            item_scales=vs,
         )
 
     def train_sweep(
@@ -346,15 +381,21 @@ class ALSAlgorithm(Algorithm):
             "(%d users x %d items, rank %d)",
             len(candidates), len(user_index), len(item_index), base.rank,
         )
-        return [
-            ALSModel(
-                user_index=user_index,
-                item_index=item_index,
-                user_factors=np.asarray(U),
-                item_factors=np.asarray(V),
+        out = []
+        for U, V in results:
+            uf, us = als_ops.host_factors(U)
+            vf, vs = als_ops.host_factors(V)
+            out.append(
+                ALSModel(
+                    user_index=user_index,
+                    item_index=item_index,
+                    user_factors=uf,
+                    item_factors=vf,
+                    user_scales=us,
+                    item_scales=vs,
+                )
             )
-            for U, V in results
-        ]
+        return out
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         from predictionio_tpu.ops.topk import top_k_items
@@ -366,12 +407,14 @@ class ALSAlgorithm(Algorithm):
         uix = model.user_index[query.user]
         if self.params.sharded_serving:
             scores, ids = model.ring_catalog().top_k(
-                model.user_factors[uix : uix + 1], int(query.num)
+                model.user_rows([uix]), int(query.num)
             )
             scores, ids = scores[0], ids[0]
         else:
-            U, V = model.device_factors()
-            scores, ids = top_k_items(U[uix], V, k=int(query.num))
+            _, V = model.device_factors()
+            scores, ids = top_k_items(
+                model.user_rows(uix), V, k=int(query.num)
+            )
         inv = model.item_index.inverse
         return PredictedResult(
             itemScores=[
@@ -405,11 +448,11 @@ class ALSAlgorithm(Algorithm):
             k = 1 << max(0, k - 1).bit_length()
             if self.params.sharded_serving:
                 scores, ids = model.ring_catalog().top_k(
-                    model.user_factors[uixs], k
+                    model.user_rows(uixs), k
                 )
             else:
-                U, V = model.device_factors()
-                scores, ids = top_k_items_batch(U[uixs], V, k=k)
+                _, V = model.device_factors()
+                scores, ids = top_k_items_batch(model.user_rows(uixs), V, k=k)
             scores, ids = np.asarray(scores), np.asarray(ids)
             inv = model.item_index.inverse
             for row, (ix, q) in enumerate(known):

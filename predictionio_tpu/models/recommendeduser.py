@@ -109,6 +109,7 @@ class ALSAlgorithmParams(Params):
 class RecommendedUserModel:
     followed_index: BiMap  # followed-user id <-> column index
     followed_factors: np.ndarray  # [F, D] row-normalized at device load
+    followed_scales: np.ndarray | None = None  # [F] f32, int8 storage only
 
     def __post_init__(self):
         self._device = None
@@ -117,7 +118,13 @@ class RecommendedUserModel:
         if self._device is None:
             from predictionio_tpu.models.filters import normalized_device_factors
 
-            self._device = normalized_device_factors(self.followed_factors)
+            factors = self.followed_factors
+            if self.followed_scales is not None:
+                factors = (
+                    factors.astype(np.float32)
+                    * self.followed_scales[:, None]
+                )
+            self._device = normalized_device_factors(factors)
         return self._device
 
     def __getstate__(self):
@@ -157,8 +164,11 @@ class ALSAlgorithm(Algorithm):
         from predictionio_tpu.parallel.als_sharded import train_for_context
 
         _, V = train_for_context(data, params, ctx, sharded=self.params.sharded_train)
+        vf, vs = als_ops.host_factors(V)
         return RecommendedUserModel(
-            followed_index=followed_index, followed_factors=np.asarray(V)
+            followed_index=followed_index,
+            followed_factors=vf,
+            followed_scales=vs,
         )
 
     def predict(self, model: RecommendedUserModel, query: Query) -> PredictedResult:

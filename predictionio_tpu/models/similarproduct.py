@@ -143,8 +143,9 @@ class ALSAlgorithmParams(Params):
 @dataclass
 class SimilarProductModel:
     item_index: BiMap
-    item_factors: np.ndarray  # [I, D]
+    item_factors: np.ndarray  # [I, D]; int8 values when item_scales set
     categories: dict[str, list[str]]
+    item_scales: np.ndarray | None = None  # [I] f32, int8 storage only
 
     def __post_init__(self):
         self._device = None
@@ -153,7 +154,15 @@ class SimilarProductModel:
         if self._device is None:
             from predictionio_tpu.models.filters import normalized_device_factors
 
-            self._device = normalized_device_factors(self.item_factors)
+            factors = self.item_factors
+            if self.item_scales is not None:
+                # dequantize before row-normalizing (the persisted blob
+                # stays int8; only this device cache is dense)
+                factors = (
+                    factors.astype(np.float32)
+                    * self.item_scales[:, None]
+                )
+            self._device = normalized_device_factors(factors)
         return self._device
 
     def __getstate__(self):
@@ -242,10 +251,12 @@ class ALSAlgorithm(Algorithm):
         from predictionio_tpu.parallel.als_sharded import train_for_context
 
         _, V = train_for_context(data, params, ctx, sharded=self.params.sharded_train)
+        vf, vs = als_ops.host_factors(V)
         return SimilarProductModel(
             item_index=item_index,
-            item_factors=np.asarray(V),
+            item_factors=vf,
             categories=dict(td.items),
+            item_scales=vs,
         )
 
     def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:

@@ -230,9 +230,9 @@ def solve_bucket_explicit(
     ``A_u = sum v v^T + reg * (n_u if weighted_reg else 1) * I``,
     ``b_u = sum r v``; returns x [B, D] in float32.
     """
-    D = factors_other.shape[1]
+    D = table_dim(factors_other)
     dt = jnp.dtype(compute_dtype)
-    vg = factors_other[col_ids].astype(dt)  # [B, K, D]
+    vg = _read_rows(factors_other, col_ids, dt)  # [B, K, D]
     w = mask.astype(dt)
     r = (ratings * mask).astype(dt)
     A, b = _gramian_rhs(vg, w, r)
@@ -264,9 +264,9 @@ def solve_bucket_implicit(
     ``A_u = Y^T Y + sum alpha*r * v v^T + reg I``,
     ``b_u = sum (1 + alpha*r) v``.
     """
-    D = factors_other.shape[1]
+    D = table_dim(factors_other)
     dt = jnp.dtype(compute_dtype)
-    vg = factors_other[col_ids].astype(dt)  # [B, K, D]
+    vg = _read_rows(factors_other, col_ids, dt)  # [B, K, D]
     conf_minus_1 = (alpha * ratings * mask).astype(dt)
     rhs_w = ((1.0 + alpha * ratings) * mask).astype(dt)
     A_c, b = _gramian_rhs(vg, conf_minus_1, rhs_w)
@@ -290,9 +290,9 @@ def _gramian_rhs_gathered(factors_other, col_ids, w, r, dt, budget_bytes):
     the choice costs nothing at runtime.
     """
     B, K = col_ids.shape
-    D = factors_other.shape[1]
+    D = table_dim(factors_other)
     if B * K * D * jnp.dtype(dt).itemsize <= budget_bytes or B <= 1:
-        vg = factors_other[col_ids].astype(dt)
+        vg = _read_rows(factors_other, col_ids, dt)
         return _gramian_rhs(vg, w, r)
     rows_per_chunk = max(1, budget_bytes // (K * D * jnp.dtype(dt).itemsize))
     n_chunks = -(-B // rows_per_chunk)
@@ -305,7 +305,7 @@ def _gramian_rhs_gathered(factors_other, col_ids, w, r, dt, budget_bytes):
 
     def one_chunk(chunk):
         c_ids, c_w, c_r = chunk
-        return _gramian_rhs(factors_other[c_ids].astype(dt), c_w, c_r)
+        return _gramian_rhs(_read_rows(factors_other, c_ids, dt), c_w, c_r)
 
     A, b = jax.lax.map(
         one_chunk,
@@ -368,9 +368,99 @@ def _psd_solve(A, b):
     return jax.scipy.linalg.cho_solve(chol, b)
 
 
+# ---------------------------------------------------------------------------
+# int8 factor storage: per-row symmetric quantization
+# ---------------------------------------------------------------------------
+#
+# ``storage_dtype="int8"`` stores a factor table as the pair
+# ``(values int8 [N, D], scales float32 [N])`` with
+# ``row_f32 = values * scales[:, None]`` — per-row max-abs/127 symmetric
+# quantization (the Tensor Casting trade, PAPERS.md: compressed factor
+# traffic, full-precision accumulation). Every function below that takes
+# a factor table accepts either a plain array (f32/bf16 path, unchanged)
+# or this pair; the choice is static at trace time, so f32/bf16 programs
+# are byte-identical to before.
+
+
+def quantize_rows(x):
+    """f32 factors ``[..., N, D]`` -> ``(int8 [..., N, D], f32 [..., N])``
+    per-row scales. All-zero rows get scale 1 (quantize to exact zeros)."""
+    x = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x), axis=-1) / 127.0
+    scale = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.round(x / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q, scale, dt=jnp.float32):
+    """Inverse of :func:`quantize_rows` in dtype ``dt``."""
+    return q.astype(dt) * scale[..., None].astype(dt)
+
+
+def to_storage(x, storage_dtype: str):
+    """f32 factors -> their storage representation (array or int8 pair)."""
+    if storage_dtype == "int8":
+        return quantize_rows(x)
+    return x.astype(jnp.dtype(storage_dtype))
+
+
+def dense_factors(table, dt=jnp.float32):
+    """A whole factor table as a dense array of dtype ``dt``."""
+    if isinstance(table, tuple):
+        return dequantize_rows(table[0], table[1], dt)
+    return table.astype(dt)
+
+
+def host_factors(table):
+    """Factor table -> host arrays ``(values, scales)``: scales is the
+    [N] f32 per-row array for the int8 pair representation, None for
+    dense dtypes. The model classes persist exactly this split, keeping
+    quantized MODELDATA blobs 4x smaller than f32."""
+    if isinstance(table, tuple):
+        return np.asarray(table[0]), np.asarray(table[1])
+    return np.asarray(table), None
+
+
+def table_rows(table) -> int:
+    """Row count of a factor table in either representation."""
+    return (table[0] if isinstance(table, tuple) else table).shape[0]
+
+
+def table_dim(table) -> int:
+    """Factor dimension (rank) of a table in either representation."""
+    return (table[0] if isinstance(table, tuple) else table).shape[1]
+
+
+def slice_rows(table, n: int):
+    """First ``n`` rows of a factor table, preserving representation."""
+    if isinstance(table, tuple):
+        return (table[0][:n], table[1][:n])
+    return table[:n]
+
+
+def _read_rows(table, ids, dt):
+    """Gather ``table[ids]`` as dtype ``dt``, dequantizing int8 tables
+    (the quant->f32 transition happens at gather time, so only int8
+    bytes move out of HBM/over ICI)."""
+    if isinstance(table, tuple):
+        q, s = table
+        return dequantize_rows(q[ids], s[ids], dt)
+    return table[ids].astype(dt)
+
+
+def _scatter_rows(target, row_ids, x):
+    """Write freshly solved f32 rows ``x`` back into the storage-format
+    table (requantizing each half-iteration for int8 storage)."""
+    if isinstance(target, tuple):
+        tq, ts = target
+        q, s = quantize_rows(x)
+        return (tq.at[row_ids].set(q), ts.at[row_ids].set(s))
+    return target.at[row_ids].set(x.astype(target.dtype))
+
+
 def compute_gram(factors, compute_dtype: str = "float32"):
     """Y^T Y for the implicit-feedback term (float32 accumulate)."""
-    y = factors.astype(jnp.dtype(compute_dtype))
+    y = dense_factors(factors, jnp.dtype(compute_dtype))
     prec = "highest" if y.dtype == jnp.float32 else "default"
     return jax.lax.dot_general(
         y,
@@ -405,6 +495,9 @@ class ALSParams:
     # (preferred_element_type) and the Cholesky solves run in float32,
     # so the quantization acts as per-iteration noise on the factors —
     # the ALX trade (PAPERS.md), measured at parity RMSE.
+    # "int8" halves it AGAIN: tables become (int8 values, f32 per-row
+    # scale) pairs (see quantize_rows), dequantized at gather time and
+    # requantized on each half-iteration's write-back; solves stay f32.
     storage_dtype: str = "float32"
     bucket_widths: tuple[int, ...] = DEFAULT_BUCKETS
     # HBM budget for one bucket's [B, K, D] factor-gather temp: buckets
@@ -465,9 +558,7 @@ def _half_step(factors_self, factors_other, buckets, params: ALSParams, gram):
             params,
             len(bucket.row_ids),
         )
-        factors_self = factors_self.at[bucket.row_ids].set(
-            x.astype(factors_self.dtype)
-        )
+        factors_self = _scatter_rows(factors_self, bucket.row_ids, x)
     return factors_self
 
 
@@ -571,7 +662,9 @@ def _train_fused(U, V, row_arrays, col_arrays, params: ALSParams, iterations):
                 num_solved_rows=row_ids.shape[0],
             )
             # solves come back float32; factors persist in storage_dtype
-            target = target.at[row_ids].set(x.astype(target.dtype))
+            # (int8 storage requantizes here, computing fresh per-row
+            # scales from the f32 solutions each half-iteration)
+            target = _scatter_rows(target, row_ids, x)
         return target
 
     def step(_, carry):
@@ -604,9 +697,8 @@ def als_train(data: RatingsData, params: ALSParams):
     compile per unique set of bucket shapes; see _train_fused).
     """
     key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
-    sd = jnp.dtype(params.storage_dtype)
-    U = init_factors(data.num_rows, params.rank, key_u).astype(sd)
-    V = init_factors(data.num_cols, params.rank, key_v).astype(sd)
+    U = to_storage(init_factors(data.num_rows, params.rank, key_u), params.storage_dtype)
+    V = to_storage(init_factors(data.num_cols, params.rank, key_v), params.storage_dtype)
     # iterations rides as a dynamic loop bound; normalize it out of the
     # static params key so runs differing only in iteration count share
     # one compiled program
@@ -652,7 +744,7 @@ def _train_fused_sweep(
                     reg=reg,
                     alpha=alpha,
                 )
-                target = target.at[row_ids].set(x.astype(target.dtype))
+                target = _scatter_rows(target, row_ids, x)
             return target
 
         def step(_, carry):
@@ -740,24 +832,19 @@ def als_train_sweep(
         return out
     U0 = []
     V0 = []
-    sd = jnp.dtype(base.storage_dtype)
     for p in params_list:
         key_u, key_v = jax.random.split(jax.random.PRNGKey(p.seed))
         pad = ((0, 0), (0, rank_max - p.rank))
-        U0.append(
-            jnp.pad(init_factors(data.num_rows, p.rank, key_u), pad).astype(sd)
-        )
-        V0.append(
-            jnp.pad(init_factors(data.num_cols, p.rank, key_v), pad).astype(sd)
-        )
+        U0.append(jnp.pad(init_factors(data.num_rows, p.rank, key_u), pad))
+        V0.append(jnp.pad(init_factors(data.num_cols, p.rank, key_v), pad))
     regs = jnp.asarray([p.reg for p in params_list], jnp.float32)
     alphas = jnp.asarray([p.alpha for p in params_list], jnp.float32)
     static_params = dataclasses.replace(
         base, iterations=0, reg=0.0, alpha=0.0, rank=rank_max
     )
     U, V = _train_fused_sweep(
-        jnp.stack(U0),
-        jnp.stack(V0),
+        to_storage(jnp.stack(U0), base.storage_dtype),
+        to_storage(jnp.stack(V0), base.storage_dtype),
         regs,
         alphas,
         _device_bucket_arrays(data.row_buckets),
@@ -765,8 +852,15 @@ def als_train_sweep(
         static_params,
         base.iterations,
     )
+
+    def cand(table, c, r):
+        # per-candidate slice at its own rank, keeping the representation
+        if isinstance(table, tuple):
+            return (table[0][c, :, :r], table[1][c])
+        return table[c, :, :r]
+
     return [
-        (U[c, :, : p.rank], V[c, :, : p.rank])
+        (cand(U, c, p.rank), cand(V, c, p.rank))
         for c, p in enumerate(params_list)
     ]
 
@@ -775,9 +869,8 @@ def als_train_stepwise(data: RatingsData, params: ALSParams):
     """Step-by-step variant (one jitted call per bucket solve): same math
     as als_train, useful for debugging / profiling individual solves."""
     key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
-    sd = jnp.dtype(params.storage_dtype)
-    U = init_factors(data.num_rows, params.rank, key_u).astype(sd)
-    V = init_factors(data.num_cols, params.rank, key_v).astype(sd)
+    U = to_storage(init_factors(data.num_rows, params.rank, key_u), params.storage_dtype)
+    V = to_storage(init_factors(data.num_cols, params.rank, key_v), params.storage_dtype)
 
     for it in range(params.iterations):
         gram_v = compute_gram(V, params.compute_dtype) if params.implicit else None
@@ -790,10 +883,11 @@ def als_train_stepwise(data: RatingsData, params: ALSParams):
 
 def predict_pairs(U, V, rows: np.ndarray, cols: np.ndarray):
     """Scores for explicit (row, col) pairs: sum(U[r] * V[c], -1).
-    Gathers cast to float32 so bf16-stored factors score/evaluate at
-    full accumulation precision."""
-    u = U[jnp.asarray(rows)].astype(jnp.float32)
-    v = V[jnp.asarray(cols)].astype(jnp.float32)
+    Gathers cast (or dequantize, for int8 storage) to float32 so
+    reduced-precision factors score/evaluate at full accumulation
+    precision."""
+    u = _read_rows(U, jnp.asarray(rows), jnp.float32)
+    v = _read_rows(V, jnp.asarray(cols), jnp.float32)
     return jnp.sum(u * v, axis=-1)
 
 

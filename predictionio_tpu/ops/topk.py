@@ -19,33 +19,67 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def catalog_rows(item_factors) -> int:
+    """Row count of a factor table in either representation: a dense
+    [I, D] array, or the int8 (values [I, D], per-row f32 scales [I])
+    pair of ``storage_dtype="int8"`` (ops/als.py quantize_rows)."""
+    table = item_factors[0] if isinstance(item_factors, tuple) else item_factors
+    return table.shape[0]
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def top_k_items(user_vector, item_factors, k: int, exclude_mask=None):
     """Scores one user vector against all items; returns (scores, ids).
 
+    ``item_factors`` is a dense [I, D] array or the int8 (values,
+    scales) pair — quantized catalogs score inside this jitted program
+    (the deployed blob stays 4x smaller than f32 end to end; the per-row
+    scale factors out of the dot product, so the dense f32 catalog is
+    never materialized).
+
     ``exclude_mask``: optional [num_items] bool/0-1 array; masked items
     can never appear in the result.
     """
-    # f32 scores regardless of factor storage dtype (bf16-stored factors
-    # still rank and report at full accumulation precision)
-    scores = jnp.matmul(
-        item_factors, user_vector, preferred_element_type=jnp.float32
-    )  # [I]
+    # f32 scores regardless of factor storage dtype (bf16/int8-stored
+    # factors still rank and report at full accumulation precision)
+    if isinstance(item_factors, tuple):
+        q, s = item_factors
+        scores = (
+            jnp.matmul(
+                q, user_vector.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            * s
+        )  # [I]
+    else:
+        scores = jnp.matmul(
+            item_factors, user_vector, preferred_element_type=jnp.float32
+        )  # [I]
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask.astype(bool), NEG_INF, scores)
-    k = min(k, item_factors.shape[0])
+    k = min(k, catalog_rows(item_factors))
     return jax.lax.top_k(scores, k)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def top_k_items_batch(user_vectors, item_factors, k: int, exclude_mask=None):
     """Batched variant: [B, D] user vectors -> ([B, k] scores, [B, k] ids)."""
-    scores = jnp.matmul(
-        user_vectors, item_factors.T, preferred_element_type=jnp.float32
-    )  # [B, I]
+    if isinstance(item_factors, tuple):
+        q, s = item_factors
+        scores = (
+            jnp.matmul(
+                user_vectors.astype(jnp.float32), q.T,
+                preferred_element_type=jnp.float32,
+            )
+            * s[None, :]
+        )  # [B, I]
+    else:
+        scores = jnp.matmul(
+            user_vectors, item_factors.T, preferred_element_type=jnp.float32
+        )  # [B, I]
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask.astype(bool)[None, :], NEG_INF, scores)
-    k = min(k, item_factors.shape[0])
+    k = min(k, catalog_rows(item_factors))
     return jax.lax.top_k(scores, k)
 
 
@@ -54,11 +88,16 @@ def top_k_similar(item_vector, item_factors, k: int, exclude_mask=None):
     """Cosine item-item similarity top-k (similarproduct template's scoring,
     examples/scala-parallel-similarproduct/multi/src/main/scala/
     ALSAlgorithm.scala:147,193,244)."""
-    f32 = item_factors.astype(jnp.float32)
+    if isinstance(item_factors, tuple):
+        # cosine is scale-invariant per row, so the per-row scale drops
+        # out entirely: normalize the int8 values directly
+        f32 = item_factors[0].astype(jnp.float32)
+    else:
+        f32 = item_factors.astype(jnp.float32)
     v32 = item_vector.astype(jnp.float32)
     norms = jnp.linalg.norm(f32, axis=1) * jnp.linalg.norm(v32)
     scores = (f32 @ v32) / jnp.maximum(norms, 1e-12)
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask.astype(bool), NEG_INF, scores)
-    k = min(k, item_factors.shape[0])
+    k = min(k, catalog_rows(item_factors))
     return jax.lax.top_k(scores, k)

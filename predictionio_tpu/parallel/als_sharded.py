@@ -74,6 +74,7 @@ from __future__ import annotations
 
 import functools
 import heapq
+import logging
 from dataclasses import dataclass
 from typing import Sequence
 
@@ -83,6 +84,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops import als as als_ops
+from predictionio_tpu.parallel.compat import pcast_varying, shard_map
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -200,6 +204,83 @@ def upload_sharded_buckets(
     )
 
 
+def resegment_skewed_rows(
+    sb: ShardedBucket, opp_rows_loc: int, shards: int
+) -> ShardedBucket:
+    """Split table rows whose entries concentrate on one opposite-slab
+    owner, BEFORE ring partitioning.
+
+    ``ring_partition_bucket`` pads every (table row, owner) cell to the
+    bucket-wide max ``K_sub``, so a single row with ~K entries on one
+    owner drives ``K_sub -> K`` and the whole bucket to S x the flat
+    bytes (its docstring's adversarial case). Splitting just the
+    offending rows into sub-rows of at most ``ceil(K / S)`` entries per
+    owner — more segments of the same solved row, scatter-added by
+    ``seg_row`` exactly like hot-row segments — caps ``K_sub`` at the
+    spread-case value, so only the skewed rows grow (by their segment
+    count) instead of every row paying the padding.
+    """
+    S, B, K = sb.shards, sb.table_rows_per_shard, sb.col_ids.shape[1]
+    T = max(1, -(-K // shards))
+    col3 = sb.col_ids.reshape(S, B, K)
+    rat3 = sb.ratings.reshape(S, B, K)
+    msk3 = sb.mask.reshape(S, B, K)
+    seg2 = sb.seg_row.reshape(S, B)
+    per_shard: list[list[tuple]] = []
+    for s in range(S):
+        out_rows: list[tuple] = []
+        for b in range(B):
+            m = msk3[s, b] > 0
+            n = int(m.sum())
+            if n == 0:
+                continue  # padding slot; re-padded below
+            own = col3[s, b][m].astype(np.int64) // opp_rows_loc
+            if np.bincount(own, minlength=shards).max() <= T:
+                out_rows.append((col3[s, b], rat3[s, b], msk3[s, b], seg2[s, b]))
+                continue
+            # within-owner rank -> sub-row index; each sub-row holds at
+            # most T entries of any one owner
+            order = np.argsort(own, kind="stable")
+            oo = own[order]
+            starts = np.concatenate([[0], np.nonzero(np.diff(oo))[0] + 1])
+            counts = np.diff(np.concatenate([starts, [len(oo)]]))
+            rank = np.arange(len(oo)) - np.repeat(starts, counts)
+            sub = rank // T
+            cols_m = col3[s, b][m][order]
+            rats_m = rat3[s, b][m][order]
+            for i in range(int(sub.max()) + 1):
+                pick = sub == i
+                c = np.zeros(K, np.int32)
+                r = np.zeros(K, np.float32)
+                mk = np.zeros(K, np.float32)
+                c[: pick.sum()] = cols_m[pick]
+                r[: pick.sum()] = rats_m[pick]
+                mk[: pick.sum()] = 1.0
+                out_rows.append((c, r, mk, seg2[s, b]))
+        per_shard.append(out_rows)
+    B2 = max(1, max(len(rows) for rows in per_shard))
+    col_ids = np.zeros((S, B2, K), np.int32)
+    ratings = np.zeros((S, B2, K), np.float32)
+    mask = np.zeros((S, B2, K), np.float32)
+    seg_row = np.zeros((S, B2), np.int32)
+    for s, rows in enumerate(per_shard):
+        for j, (c, r, mk, sg) in enumerate(rows):
+            col_ids[s, j] = c
+            ratings[s, j] = r
+            mask[s, j] = mk
+            seg_row[s, j] = sg
+    return ShardedBucket(
+        row_ids=sb.row_ids,
+        col_ids=col_ids.reshape(S * B2, K),
+        ratings=ratings.reshape(S * B2, K),
+        mask=mask.reshape(S * B2, K),
+        seg_row=seg_row.reshape(-1),
+        shards=S,
+        rows_per_shard=sb.rows_per_shard,
+        table_rows_per_shard=B2,
+    )
+
+
 def ring_partition_bucket(
     sb: ShardedBucket, opp_rows_loc: int, shards: int
 ) -> ShardedBucket:
@@ -304,7 +385,14 @@ def init_sharded_factors(
     # float32 (ops/als.py ALSParams.storage_dtype)
     U_dev = jax.device_put(U, sharding)
     V_dev = jax.device_put(V, sharding)
-    if params.storage_dtype != "float32":
+    if params.storage_dtype == "int8":
+        # per-row quantization reduces over the (unsharded) rank dim
+        # only, so the row sharding of both values and scales is
+        # preserved; the all_gather/ppermute'd working set becomes the
+        # (int8 values, f32 scales) pair — ~4x fewer ICI bytes than f32
+        U_dev = als_ops.quantize_rows(U_dev)
+        V_dev = als_ops.quantize_rows(V_dev)
+    elif params.storage_dtype != "float32":
         sd = jnp.dtype(params.storage_dtype)
         U_dev = U_dev.astype(sd)  # elementwise: sharding preserved
         V_dev = V_dev.astype(sd)
@@ -362,7 +450,11 @@ def _train_fused_sharded(
     dt = jnp.dtype(params.compute_dtype)
 
     def gather_shard_fn(rows_per, other_shard, *flat):
-        other_full = jax.lax.all_gather(other_shard, axis, tiled=True)
+        # int8 storage: other_shard is the (values, scales) pair; gather
+        # both leaves so the ICI collective moves quantized bytes
+        other_full = jax.tree_util.tree_map(
+            lambda t: jax.lax.all_gather(t, axis, tiled=True), other_shard
+        )
         gram = None
         if params.implicit:
             gram = jax.lax.psum(
@@ -389,8 +481,8 @@ def _train_fused_sharded(
         # opposite factor row lives on shard s — each rotation slices out
         # exactly the sub-table the passing slab can serve, keeping ring
         # compute at parity with gather mode.
-        slab_rows = other_shard.shape[0]
-        D = other_shard.shape[1]
+        slab_rows = als_ops.table_rows(other_shard)
+        D = als_ops.table_dim(other_shard)
         me = jax.lax.axis_index(axis)
         gram = None
         if params.implicit:
@@ -400,7 +492,7 @@ def _train_fused_sharded(
         nb = len(flat) // 4
         # zero accumulators are constants; mark them device-varying so
         # they sit in the fori_loop carry beside the ppermute'd slab
-        varying = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+        varying = lambda x: pcast_varying(x, axis)
         buckets3 = [flat[bi * 4 : bi * 4 + 3] for bi in range(nb)]
         accs = tuple(
             (
@@ -442,7 +534,10 @@ def _train_fused_sharded(
         def rotate(t, carry):
             slab, accs = carry
             accs = accumulate(jnp.mod(me - t, shards), slab, accs)
-            slab = jax.lax.ppermute(slab, axis, perm)
+            # int8 slabs rotate as (values, scales) — quantized ICI hops
+            slab = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, axis, perm), slab
+            )
             return slab, accs
 
         # S-1 rotate-and-accumulate steps, then the final slab's
@@ -477,15 +572,22 @@ def _train_fused_sharded(
         flat = []
         for _row_ids, col_ids, ratings, mask, seg_row in buckets:
             flat += [col_ids, ratings, mask, seg_row]
-        xs = jax.shard_map(
+        # int8 factor tables are (values, scales) pairs: spell out the
+        # matching spec structure (both leaves row-sharded over axis)
+        other_spec = (
+            (P(axis), P(axis)) if isinstance(other, tuple) else P(axis)
+        )
+        xs = shard_map(
             functools.partial(shard_fn, rows_per),
             mesh=mesh,
-            in_specs=(P(axis),) + (P(axis),) * len(flat),
+            in_specs=(other_spec,) + (P(axis),) * len(flat),
             out_specs=(P(axis),) * len(buckets),
         )(other, *flat)
         for x, (row_ids, *_rest) in zip(xs, buckets):
-            target = target.at[row_ids].set(x.astype(target.dtype))
-        return jax.lax.with_sharding_constraint(target, factor_spec)
+            target = als_ops._scatter_rows(target, row_ids, x)
+        return jax.tree_util.tree_map(
+            lambda t: jax.lax.with_sharding_constraint(t, factor_spec), target
+        )
 
     def step(_, carry):
         U, V = carry
@@ -502,13 +604,27 @@ def choose_sharded_mode(
     """Pick the half-step variant for a run: ``gather`` while the larger
     gathered side fits ``params.sharded_gather_budget_bytes`` per chip,
     ``ring`` past it (module docstring, "Memory model")."""
-    itemsize = jnp.dtype(params.storage_dtype).itemsize
-    gathered = (
-        max(_padded_len(data.num_rows, shards), _padded_len(data.num_cols, shards))
-        * params.rank
-        * itemsize
+    rows = max(
+        _padded_len(data.num_rows, shards), _padded_len(data.num_cols, shards)
     )
+    gathered = rows * _factor_row_bytes(params)
     return "ring" if gathered > params.sharded_gather_budget_bytes else "gather"
+
+
+def _factor_row_bytes(params: als_ops.ALSParams) -> int:
+    """Bytes one gathered factor row costs in storage form (int8 rows
+    carry their f32 per-row scale alongside the quantized values)."""
+    if params.storage_dtype == "int8":
+        return params.rank + 4
+    return params.rank * jnp.dtype(params.storage_dtype).itemsize
+
+
+def _table_bytes_per_chip(sbs: Sequence[ShardedBucket], shards: int) -> int:
+    """Per-chip bytes of a bucket-table set (col_ids/ratings/mask at 12
+    bytes per slot) — same formula for the flat ``[S*B, K]`` layout and
+    the ring-partitioned ``[S*B, S, K_sub]`` one, so the two layouts are
+    directly comparable."""
+    return sum(sb.col_ids.size * 12 for sb in sbs) // max(1, shards)
 
 
 def sharded_als_train(
@@ -540,23 +656,60 @@ def sharded_als_train(
     elif mode not in ("gather", "ring"):
         raise ValueError(f"mode must be auto|gather|ring, got {mode!r}")
     state = init_sharded_factors(data, params, mesh, axis)
-    row_sb = [
-        shard_bucket(b, shards, state.U.shape[0] - 1) for b in data.row_buckets
-    ]
-    col_sb = [
-        shard_bucket(b, shards, state.V.shape[0] - 1) for b in data.col_buckets
-    ]
+    u_len = als_ops.table_rows(state.U)
+    v_len = als_ops.table_rows(state.V)
+    row_sb = [shard_bucket(b, shards, u_len - 1) for b in data.row_buckets]
+    col_sb = [shard_bucket(b, shards, v_len - 1) for b in data.col_buckets]
     if mode == "ring":
         # partition each table by opposite-slab owner so every rotation
         # consumes only the sub-table the passing slab can serve
-        row_sb = [
-            ring_partition_bucket(sb, state.V.shape[0] // shards, shards)
-            for sb in row_sb
-        ]
-        col_sb = [
-            ring_partition_bucket(sb, state.U.shape[0] // shards, shards)
-            for sb in col_sb
-        ]
+        def partition(rsb, csb):
+            return (
+                [ring_partition_bucket(sb, v_len // shards, shards) for sb in rsb],
+                [ring_partition_bucket(sb, u_len // shards, shards) for sb in csb],
+            )
+
+        flat_bytes = _table_bytes_per_chip(row_sb + col_sb, shards)
+        row_rp, col_rp = partition(row_sb, col_sb)
+        part_bytes = _table_bytes_per_chip(row_rp + col_rp, shards)
+        budget = params.sharded_gather_budget_bytes
+        if part_bytes > 2 * flat_bytes and part_bytes > budget:
+            # adversarial owner skew: some (row, owner) pair concentrates
+            # most of a row's entries, so K_sub -> K and EVERY table row
+            # pays S * K_sub slots (ring_partition_bucket docstring).
+            # Re-segment just the offending rows through the hot-row
+            # machinery (seg_row scatter-add): splitting them into
+            # sub-rows capped at ceil(K/S) entries per owner restores
+            # K_sub to the spread-case value, so only the skewed rows
+            # grow (extra segments) instead of the whole table.
+            logger.warning(
+                "ring-mode bucket tables blow up under owner skew: %d "
+                "bytes/chip partitioned vs %d flat (budget %d); "
+                "re-segmenting skewed rows",
+                part_bytes, flat_bytes, budget,
+            )
+            row_sb2 = [
+                resegment_skewed_rows(sb, v_len // shards, shards)
+                for sb in row_sb
+            ]
+            col_sb2 = [
+                resegment_skewed_rows(sb, u_len // shards, shards)
+                for sb in col_sb
+            ]
+            row_rp2, col_rp2 = partition(row_sb2, col_sb2)
+            part2 = _table_bytes_per_chip(row_rp2 + col_rp2, shards)
+            if part2 < part_bytes:
+                # narrower segments contained the skew (only the
+                # offending rows multiplied, the rest shrank)
+                row_rp, col_rp, part_bytes = row_rp2, col_rp2, part2
+            if part_bytes > budget:
+                raise ValueError(
+                    f"ring-mode bucket tables need {part_bytes} bytes/chip "
+                    f"even after re-segmentation (flat layout: {flat_bytes}), "
+                    f"over sharded_gather_budget_bytes={budget}; raise the "
+                    "budget, add chips, or thin the skewed rows"
+                )
+        row_sb, col_sb = row_rp, col_rp
     row_arrays = upload_sharded_buckets(row_sb, mesh, axis)
     col_arrays = upload_sharded_buckets(col_sb, mesh, axis)
     # iterations rides as a dynamic loop bound (shared compile across
@@ -573,7 +726,10 @@ def sharded_als_train(
         axis,
         mode,
     )
-    return U[: data.num_rows], V[: data.num_cols]
+    return (
+        als_ops.slice_rows(U, data.num_rows),
+        als_ops.slice_rows(V, data.num_cols),
+    )
 
 
 def train_for_context(

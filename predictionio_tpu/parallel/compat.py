@@ -1,0 +1,32 @@
+"""jax API compatibility for the shard_map-based parallel paths.
+
+The trainers target the current ``jax.shard_map`` API, where loop
+carries that mix ppermute'd shard data with fresh constants need the
+constants marked device-varying (``jax.lax.pcast(..., to="varying")``).
+Older jax (< 0.5) ships shard_map under ``jax.experimental`` without
+the varying/replication type system; there the equivalent is
+``check_rep=False`` (no replication tracking, so nothing to mark) —
+the compiled collectives are identical either way.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+
+    def pcast_varying(x, axis: str):
+        return jax.lax.pcast(x, (axis,), to="varying")
+
+else:  # jax < 0.5
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+    def pcast_varying(x, axis: str):
+        return x

@@ -160,6 +160,7 @@ class HTTPApp:
         port: int = 0,
         ssl_context=None,
         reuse_port: bool = False,
+        read_timeout: float = 120.0,
     ):
         self.router = router
         self.host = host
@@ -167,6 +168,10 @@ class HTTPApp:
         # server-side TLS (reference SSLConfiguration sslContext wiring
         # into spray; here an ssl.SSLContext wrapping the listen socket)
         self.ssl_context = ssl_context
+        # per-connection socket timeout: a client that stops sending
+        # mid-request (slowloris) releases its worker thread instead of
+        # pinning it forever; applies to plain TCP and TLS alike
+        self.read_timeout = read_timeout
         # SO_REUSEPORT: N worker PROCESSES bind the same port and the
         # kernel load-balances accepts — the multi-process scale-out
         # path (`--workers`) past the single-interpreter GIL
@@ -183,6 +188,10 @@ class HTTPApp:
             # TCP_NODELAY: Nagle held small JSON responses back ~5ms a
             # request (measured 171 -> 1287 rps on keep-alive ingest)
             disable_nagle_algorithm = True
+            # StreamRequestHandler.setup() applies this to the accepted
+            # socket — plain TCP gets the same slow-client bound the TLS
+            # accept path sets below
+            timeout = self.read_timeout
 
             def log_message(self, fmt, *args):  # route to logging, not stderr
                 if logger.isEnabledFor(logging.DEBUG):
@@ -237,7 +246,10 @@ class HTTPApp:
                 headers: dict[str, str] = {}
                 n_lines = 0
                 while True:
-                    h = self.rfile.readline(65537)
+                    try:
+                        h = self.rfile.readline(65537)
+                    except OSError:  # read timeout / client reset
+                        return
                     if h in (b"\r\n", b"\n", b""):
                         break
                     n_lines += 1  # count LINES, not dict entries: a
@@ -248,7 +260,14 @@ class HTTPApp:
                         return
                     k, sep, v = h.decode("latin-1").partition(":")
                     if sep:
-                        headers[k.strip().lower()] = v.strip()
+                        key, val = k.strip().lower(), v.strip()
+                        if key == "content-length" and headers.get(key, val) != val:
+                            # conflicting duplicate framing headers are
+                            # the classic smuggling vector (RFC 9112
+                            # §6.3): never silently pick one
+                            self._send_simple(400, "Bad Request")
+                            return
+                        headers[key] = val
                 conn = headers.get("connection", "").lower()
                 self.close_connection = conn == "close" or (
                     version == "HTTP/1.0" and conn != "keep-alive"
@@ -270,7 +289,10 @@ class HTTPApp:
                 if length < 0:
                     self._send_simple(400, "Bad Request")
                     return
-                body = self.rfile.read(length) if length > 0 else b""
+                try:
+                    body = self.rfile.read(length) if length > 0 else b""
+                except OSError:  # read timeout mid-body
+                    return
                 if length > 0 and len(body) < length:
                     self.close_connection = True
                     return  # client died mid-body
@@ -365,6 +387,7 @@ class HTTPApp:
 
         if self.ssl_context is not None:
             ssl_context = self.ssl_context
+            read_timeout = self.read_timeout
 
             class _TLSServer(ThreadingHTTPServer):
                 def get_request(self):
@@ -373,7 +396,7 @@ class HTTPApp:
                     # thread, so a silent client (TCP health probe) can't
                     # stall the accept loop
                     sock, addr = self.socket.accept()
-                    sock.settimeout(120)
+                    sock.settimeout(read_timeout)
                     tls = ssl_context.wrap_socket(
                         sock, server_side=True, do_handshake_on_connect=False
                     )

@@ -331,6 +331,60 @@ class TestTraining:
         # parity bar mirroring the north-star gate (RMSE within ~2%)
         assert e16 < e32 * 1.05 + 0.01, (e32, e16)
 
+    def test_int8_storage_close_to_f32(self):
+        """Factors STORED as (int8 values, per-row f32 scale) — the 4x
+        gather-traffic mode — train to RMSE parity with f32: quant error
+        is per-row-bounded (max-abs/127) and solves re-derive each factor
+        from f32 normal equations, so it never accumulates."""
+        rows, cols, vals = synthetic_ratings(
+            num_u=60, num_i=40, rank=3, density=0.4, noise=0.05
+        )
+        data = als.build_ratings_data(rows, cols, vals, 60, 40, bucket_widths=(8, 32))
+        base = als.ALSParams(rank=6, iterations=10, reg=0.01)
+        f32 = als.als_train(data, base)
+        i8 = als.als_train(
+            data,
+            als.ALSParams(rank=6, iterations=10, reg=0.01, storage_dtype="int8"),
+        )
+        # pair representation: int8 values + f32 per-row scales
+        assert isinstance(i8[0], tuple) and isinstance(i8[1], tuple)
+        assert i8[0][0].dtype == jnp.int8 and i8[0][1].dtype == jnp.float32
+        assert i8[0][0].shape == (60, 6) and i8[0][1].shape == (60,)
+        e32 = als.rmse(*f32, rows, cols, vals)
+        e8 = als.rmse(*i8, rows, cols, vals)
+        # the ISSUE's parity gate: <=1% RMSE delta (plus an absolute floor
+        # for near-zero errors)
+        assert e8 < e32 * 1.01 + 0.01, (e32, e8)
+
+    def test_int8_quantize_roundtrip_bounded(self):
+        rng = np.random.default_rng(12)
+        x = jnp.asarray(rng.normal(size=(17, 6)).astype(np.float32)) * 3.0
+        q, s = als.quantize_rows(x)
+        back = als.dequantize_rows(q, s)
+        # max-abs/127 scale bounds per-element error by scale/2
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        assert (err <= np.asarray(s)[:, None] * 0.5 + 1e-7).all()
+        # all-zero rows survive exactly (scale clamps to 1)
+        qz, sz = als.quantize_rows(jnp.zeros((3, 6)))
+        assert (np.asarray(qz) == 0).all()
+        assert (np.asarray(als.dequantize_rows(qz, sz)) == 0).all()
+
+    def test_int8_storage_sweep_matches_single_trainings(self):
+        rows, cols, vals = synthetic_ratings(num_u=30, num_i=20, rank=2, density=0.5)
+        data = als.build_ratings_data(rows, cols, vals, 30, 20, bucket_widths=(16,))
+        cands = [
+            als.ALSParams(rank=4, iterations=4, reg=r, storage_dtype="int8")
+            for r in (0.01, 0.1)
+        ]
+        swept = als.als_train_sweep(data, cands)
+        for p, (U, V) in zip(cands, swept):
+            U1, V1 = als.als_train(data, p)
+            np.testing.assert_allclose(
+                np.asarray(als.dense_factors(U)),
+                np.asarray(als.dense_factors(U1)),
+                rtol=0.05, atol=0.02,
+            )
+
     def test_bf16_storage_sweep_matches_single_trainings(self):
         rows, cols, vals = synthetic_ratings(num_u=30, num_i=20, rank=2, density=0.5)
         data = als.build_ratings_data(rows, cols, vals, 30, 20, bucket_widths=(16,))
@@ -551,6 +605,230 @@ class TestShardedALS:
         assert U16.dtype == jnp.bfloat16
         e16 = als.rmse(U16, V16, rows, cols, vals)
         assert e16 < 0.15, e16
+
+    def test_sharded_int8_storage_parity(self, mesh):
+        """int8-stored factors all_gather as (int8 values, f32 scales) —
+        ~4x fewer ICI bytes than f32 — and must (a) exactly match the
+        single-chip int8 trajectory and (b) hold the <=1% RMSE-parity bar
+        vs f32."""
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+
+        rows, cols, vals = synthetic_ratings(num_u=48, num_i=32, rank=3, density=0.5)
+        data = als.build_ratings_data(rows, cols, vals, 48, 32, bucket_widths=(8, 32))
+        f32 = als.ALSParams(rank=6, iterations=8, reg=0.005)
+        i8 = als.ALSParams(rank=6, iterations=8, reg=0.005, storage_dtype="int8")
+        U32, V32 = sharded_als_train(data, f32, mesh, mode="gather")
+        U8, V8 = sharded_als_train(data, i8, mesh, mode="gather")
+        assert isinstance(U8, tuple) and U8[0].dtype == jnp.int8
+        U1, V1 = als.als_train(data, i8)
+        np.testing.assert_allclose(
+            np.asarray(als.dense_factors(U1)), np.asarray(als.dense_factors(U8)),
+            rtol=5e-3, atol=5e-4,
+        )
+        e32 = als.rmse(U32, V32, rows, cols, vals)
+        e8 = als.rmse(U8, V8, rows, cols, vals)
+        assert e8 < e32 * 1.01 + 0.01, (e32, e8)
+
+    def test_ring_int8_storage_parity(self, mesh):
+        """Ring slabs rotate as (int8, scales) pairs: quantized ICI hops,
+        same parity bars as gather mode."""
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+
+        rows, cols, vals = synthetic_ratings(num_u=48, num_i=32, rank=3, density=0.5)
+        data = als.build_ratings_data(rows, cols, vals, 48, 32, bucket_widths=(8, 32))
+        f32 = als.ALSParams(rank=6, iterations=8, reg=0.005)
+        i8 = als.ALSParams(rank=6, iterations=8, reg=0.005, storage_dtype="int8")
+        U32, V32 = sharded_als_train(data, f32, mesh, mode="ring")
+        U8, V8 = sharded_als_train(data, i8, mesh, mode="ring")
+        assert isinstance(U8, tuple) and U8[0].dtype == jnp.int8
+        e32 = als.rmse(U32, V32, rows, cols, vals)
+        e8 = als.rmse(U8, V8, rows, cols, vals)
+        assert e8 < e32 * 1.01 + 0.01, (e32, e8)
+
+    def test_ring_int8_hot_rows_parity(self, mesh):
+        """Segmented hot rows under int8 storage: the ISSUE's parity gate
+        explicitly covers this combination in ring mode."""
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+
+        rng = np.random.default_rng(6)
+        hot = 85  # > 10x max bucket width -> segments
+        rows = np.concatenate(
+            [np.zeros(hot, np.int32), rng.integers(1, 30, 300).astype(np.int32)]
+        )
+        cols = np.concatenate(
+            [
+                np.arange(hot, dtype=np.int32) % 40,
+                rng.integers(0, 40, 300).astype(np.int32),
+            ]
+        )
+        vals = (1 + 4 * rng.random(len(rows))).astype(np.float32)
+        data = als.build_ratings_data(rows, cols, vals, 30, 40, bucket_widths=(4, 8))
+        assert any(b.seg_row is not None for b in data.row_buckets)
+        i8 = als.ALSParams(rank=4, iterations=3, reg=0.1, storage_dtype="int8")
+        U1, V1 = als.als_train(data, i8)
+        Ur, Vr = sharded_als_train(data, i8, mesh, mode="ring")
+        np.testing.assert_allclose(
+            np.asarray(als.dense_factors(U1)),
+            np.asarray(als.dense_factors(Ur)),
+            rtol=5e-3, atol=5e-4,
+        )
+        f32 = als.ALSParams(rank=4, iterations=3, reg=0.1)
+        e32 = als.rmse(*als.als_train(data, f32), rows, cols, vals)
+        e8 = als.rmse(Ur, Vr, rows, cols, vals)
+        assert e8 < e32 * 1.01 + 0.01, (e32, e8)
+
+    def _skewed_data(self):
+        """40 users of degree 128 rating ONLY shard-0-owned items, plus
+        spread users of similar degree sharing their width-128 bucket:
+        the adversarial case where ring partitioning's per-bucket K_sub
+        balloons to K and every cohabiting row pays S x K_sub slots."""
+        rng = np.random.default_rng(0)
+        n_u, n_i, S = 400, 1100, 8
+        rows, cols, vals = [], [], []
+        slab = n_i // S  # items [0, slab) are owned by shard 0
+        for u in range(40):
+            ids = rng.choice(slab, size=128, replace=False)
+            rows += [u] * 128
+            cols += list(ids)
+            vals += list(rng.uniform(1, 5, 128))
+        for u in range(40, n_u):
+            deg = int(rng.integers(90, 110))
+            ids = rng.choice(n_i, size=deg, replace=False)
+            rows += [u] * deg
+            cols += list(ids)
+            vals += list(rng.uniform(1, 5, deg))
+        return (
+            np.array(rows, np.int32),
+            np.array(cols, np.int32),
+            np.array(vals, np.float32),
+            n_u,
+            n_i,
+        )
+
+    def test_ring_skew_guard_resegments_to_parity(self, mesh):
+        """Adversarial owner skew: the guard detects the partitioned-table
+        blowup, re-segments just the offending rows through the hot-row
+        scatter-add machinery, fits the budget again, and the ring result
+        still matches single-chip f32."""
+        import dataclasses
+
+        from predictionio_tpu.parallel import als_sharded as sh
+
+        rows, cols, vals, n_u, n_i = self._skewed_data()
+        widths = (8, 32, 128)
+        data = als.build_ratings_data(
+            rows, cols, vals, n_u, n_i, bucket_widths=widths, segment=True
+        )
+        params = als.ALSParams(
+            rank=8, iterations=2, reg=0.05, seed=3, bucket_widths=widths
+        )
+        S = 8
+        u_len = sh._padded_len(n_u, S)
+        v_len = sh._padded_len(n_i, S)
+        row_sb = [sh.shard_bucket(b, S, u_len - 1) for b in data.row_buckets]
+        col_sb = [sh.shard_bucket(b, S, v_len - 1) for b in data.col_buckets]
+        flat = sh._table_bytes_per_chip(row_sb + col_sb, S)
+        part = sh._table_bytes_per_chip(
+            [sh.ring_partition_bucket(sb, v_len // S, S) for sb in row_sb]
+            + [sh.ring_partition_bucket(sb, u_len // S, S) for sb in col_sb],
+            S,
+        )
+        assert part > 2 * flat, (part, flat)  # the blowup is real
+        # budget below the blown-up layout but above what re-segmentation
+        # achieves -> the guard must trigger AND succeed
+        budget = int(part * 0.75)
+        guarded = dataclasses.replace(
+            params, sharded_gather_budget_bytes=budget
+        )
+        U1, V1 = als.als_train(data, params)
+        Ur, Vr = sh.sharded_als_train(data, guarded, mesh, mode="ring")
+        np.testing.assert_allclose(
+            np.asarray(U1), np.asarray(Ur), rtol=5e-3, atol=5e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(V1), np.asarray(Vr), rtol=5e-3, atol=5e-4
+        )
+
+    def test_ring_skew_guard_sizing_error_names_knob(self, mesh):
+        """When even the re-segmented layout exceeds the budget, the
+        guard fails fast with a sizing error naming the knob instead of
+        silently allocating S x the expected table bytes."""
+        import dataclasses
+
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+
+        rows, cols, vals, n_u, n_i = self._skewed_data()
+        widths = (8, 32, 128)
+        data = als.build_ratings_data(
+            rows, cols, vals, n_u, n_i, bucket_widths=widths, segment=True
+        )
+        params = als.ALSParams(
+            rank=8, iterations=1, reg=0.05, bucket_widths=widths,
+            sharded_gather_budget_bytes=1,
+        )
+        with pytest.raises(ValueError, match="sharded_gather_budget_bytes"):
+            sharded_als_train(data, params, mesh, mode="ring")
+
+    def test_resegment_skewed_rows_preserves_entries(self, mesh):
+        """The split rewrites table rows only: every (solved row, col,
+        rating) triple survives, per-(sub-row, owner) counts are capped,
+        and seg_row keeps pointing sub-rows at their solved row."""
+        from predictionio_tpu.parallel.als_sharded import (
+            resegment_skewed_rows,
+            shard_bucket,
+        )
+
+        rng = np.random.default_rng(3)
+        # one hot row concentrated on owner 0, rest spread
+        rows = np.concatenate(
+            [np.zeros(60, np.int32), rng.integers(1, 20, 200).astype(np.int32)]
+        )
+        cols = np.concatenate(
+            [
+                rng.choice(10, 60, replace=True).astype(np.int32),
+                rng.integers(0, 40, 200).astype(np.int32),
+            ]
+        )
+        vals = (1 + rng.random(260)).astype(np.float32)
+        [bucket] = als.build_padded_buckets(rows, cols, vals, bucket_widths=(64,))
+        sb = shard_bucket(bucket, 4, dummy_row=99)
+        opp_loc = 10
+        rs = resegment_skewed_rows(sb, opp_loc, 4)
+        T = -(-64 // 4)
+        S, B2 = rs.shards, rs.table_rows_per_shard
+        col3 = rs.col_ids.reshape(S, B2, -1)
+        msk3 = rs.mask.reshape(S, B2, -1)
+        seg2 = rs.seg_row.reshape(S, B2)
+        for s in range(S):
+            for b in range(B2):
+                m = msk3[s, b] > 0
+                if not m.any():
+                    continue
+                owners = col3[s, b][m] // opp_loc
+                assert np.bincount(owners, minlength=S).max() <= T
+
+        def triples(colf, ratf, mskf, segf, shards, bloc):
+            c3 = colf.reshape(shards, bloc, -1)
+            r3 = ratf.reshape(shards, bloc, -1)
+            m3 = mskf.reshape(shards, bloc, -1)
+            s2 = segf.reshape(shards, bloc)
+            return sorted(
+                (s, int(s2[s, b]), int(c3[s, b, k]), float(r3[s, b, k]))
+                for s in range(shards)
+                for b in range(bloc)
+                for k in range(c3.shape[2])
+                if m3[s, b, k] > 0
+            )
+
+        before = triples(
+            sb.col_ids, sb.ratings, sb.mask, sb.seg_row,
+            sb.shards, sb.table_rows_per_shard,
+        )
+        after = triples(
+            rs.col_ids, rs.ratings, rs.mask, seg2, S, B2
+        )
+        assert before == after
+        assert (rs.row_ids == sb.row_ids).all()
 
     def test_auto_mode_selects_ring_past_budget(self, mesh):
         """A catalog whose gathered opposite side exceeds the per-chip

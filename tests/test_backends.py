@@ -959,3 +959,183 @@ class TestExportSplice:
         n = commands.export_events("FastExp", str(out), storage=s)
         assert n == 10
         assert out.read_bytes().count(b"\n") == 10
+
+
+# -- differential fuzz across every Events backend ---------------------------
+
+
+class TestDifferentialFuzz:
+    """One randomized op sequence applied to EVERY Events backend —
+    memory, jsonl, sqlite, partitioned, and the postgres DAO driven
+    through the fake sqlite-backed DB-API driver (test_postgres.py) —
+    must leave identical observable state: find() contents, get()/
+    delete() results, and scan_ratings() triples. Any backend that
+    diverges on replace semantics, rating extraction, or filter
+    behavior fails against the other four."""
+
+    APP = 11
+
+    def _daos(self, tmp_path):
+        from test_postgres import FakePgConnection
+
+        from predictionio_tpu.data.storage.memory import (
+            MemoryEvents,
+            MemoryStorageClient,
+        )
+        from predictionio_tpu.data.storage.partitioned import (
+            PartitionedEvents,
+            PartitionedStorageClient,
+        )
+        from predictionio_tpu.data.storage.postgres import (
+            DAOS,
+            PostgresStorageClient,
+        )
+        from predictionio_tpu.data.storage.sqlite import (
+            SQLiteEvents,
+            SQLiteStorageClient,
+        )
+
+        return {
+            "memory": MemoryEvents(MemoryStorageClient()),
+            "jsonl": JSONLEvents(
+                JSONLStorageClient({"path": str(tmp_path / "jl")})
+            ),
+            "sqlite": SQLiteEvents(
+                SQLiteStorageClient({"path": str(tmp_path / "ev.db")})
+            ),
+            "partitioned": PartitionedEvents(
+                PartitionedStorageClient(
+                    {"path": str(tmp_path / "parts"), "partitions": 2}
+                )
+            ),
+            "postgres": DAOS["Events"](
+                PostgresStorageClient(connection=FakePgConnection())
+            ),
+        }
+
+    def _rand_event(self, rng, i):
+        name = ("rate", "buy", "view")[rng.randrange(3)]
+        r = rng.random()
+        if r < 0.6:
+            props = {"rating": float(rng.randrange(1, 6))}
+        elif r < 0.7:
+            # boolean ratings must be rejected by rating extraction on
+            # every backend (defaults win) — the sqlite regression class
+            props = {"rating": bool(rng.randrange(2))}
+        else:
+            props = {}
+        return Event(
+            event_id=f"ev{i}",
+            event=name,
+            entity_type="user",
+            entity_id=f"u{rng.randrange(9)}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.randrange(13)}",
+            properties=props,
+            event_time=T0 + timedelta(minutes=i),
+        )
+
+    @staticmethod
+    def _obs(e):
+        """Order-free observable identity of a stored event."""
+        return (
+            e.event_id, e.event, e.entity_id, e.target_entity_id,
+            json.dumps(dict(e.properties or {}), sort_keys=True),
+            e.event_time.isoformat(),
+        )
+
+    def test_random_op_sequence_identical_state(self, tmp_path):
+        import random
+
+        rng = random.Random(0)
+        daos = self._daos(tmp_path)
+        for dao in daos.values():
+            dao.init(self.APP)
+
+        live = []
+        for i in range(120):
+            op = rng.random()
+            if op < 0.55 or not live:
+                e = self._rand_event(rng, i)
+                for dao in daos.values():
+                    dao.insert(e, self.APP)
+                live.append(e)
+            elif op < 0.75:
+                # reinsert an existing id with a new rating: every
+                # backend must replace, last write wins
+                old = live[rng.randrange(len(live))]
+                e = Event(
+                    event_id=old.event_id, event=old.event,
+                    entity_type="user", entity_id=old.entity_id,
+                    target_entity_type="item",
+                    target_entity_id=old.target_entity_id,
+                    properties={"rating": float(rng.randrange(1, 6))},
+                    event_time=old.event_time,
+                )
+                for dao in daos.values():
+                    dao.insert(e, self.APP)
+                live[live.index(old)] = e
+            elif op < 0.9:
+                victim = live.pop(rng.randrange(len(live)))
+                results = {
+                    n: dao.delete(victim.event_id, self.APP)
+                    for n, dao in daos.items()
+                }
+                assert all(results.values()), results
+            else:
+                batch = [self._rand_event(rng, 1000 * (i + 1) + j)
+                         for j in range(3)]
+                for dao in daos.values():
+                    dao.batch_insert(list(batch), self.APP)
+                live.extend(batch)
+
+        # full-state find() parity (order-free)
+        states = {
+            n: sorted(self._obs(e) for e in dao.find(self.APP, limit=None))
+            for n, dao in daos.items()
+        }
+        ref = states.pop("memory")
+        assert len(ref) == len(live)
+        for n, got in states.items():
+            assert got == ref, f"{n} diverged from memory on find()"
+
+        # filtered find() parity: entity filter and a time window
+        for kwargs in (
+            dict(entity_type="user", entity_id="u3", limit=None),
+            dict(start_time=T0 + timedelta(minutes=20),
+                 until_time=T0 + timedelta(minutes=60), limit=None),
+        ):
+            flt = {
+                n: sorted(self._obs(e) for e in dao.find(self.APP, **kwargs))
+                for n, dao in daos.items()
+            }
+            fref = flt.pop("memory")
+            for n, got in flt.items():
+                assert got == fref, f"{n} diverged on find({kwargs})"
+
+        # scan_ratings parity: numeric ratings, boolean rejection, and
+        # per-event-name defaults/overrides all at once
+        kwargs = dict(
+            event_names=["rate", "buy"],
+            default_ratings={"rate": 9.0, "buy": 4.0},
+            override_ratings={"buy": 4.0},
+        )
+        scans = {}
+        for n, dao in daos.items():
+            b = dao.scan_ratings(self.APP, **kwargs)
+            scans[n] = sorted(
+                (b.entity_ids[b.rows[k]], b.target_ids[b.cols[k]],
+                 float(b.vals[k]))
+                for k in range(len(b))
+            )
+        sref = scans.pop("memory")
+        assert sref  # the op mix always leaves rate/buy events behind
+        for n, got in scans.items():
+            assert got == sref, f"{n} diverged on scan_ratings()"
+
+        # point lookups: one live id, one deleted id
+        probe = live[0].event_id
+        for n, dao in daos.items():
+            assert dao.get(probe, self.APP) is not None, n
+            assert dao.get("never-inserted", self.APP) is None, n
+            assert dao.delete("never-inserted", self.APP) is False, n

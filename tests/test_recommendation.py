@@ -108,6 +108,71 @@ class TestTrainPredict:
         scores = [s.score for s in result.itemScores]
         assert scores == sorted(scores, reverse=True)
 
+    def test_int8_lifecycle_roundtrip(self, seeded_app):
+        """storage_dtype="int8" through the full framework path: the
+        persisted MODELDATA blob carries (int8 values, per-row f32
+        scales), deserializes intact, and serves the same preference
+        structure as f32."""
+        engine = rec.engine()
+        instance_id = run_train(
+            engine,
+            make_ep(storage_dtype="int8"),
+            engine_id="rec-i8",
+            storage=seeded_app,
+        )
+        inst = seeded_app.get_metadata_engine_instances().get_latest_completed(
+            "rec-i8", "0", "default"
+        )
+        assert inst.id == instance_id
+        _, algos, models, serving = prepare_deploy(
+            engine, inst, storage=seeded_app
+        )
+        [algo], [model] = algos, models
+        assert model.user_factors.dtype == np.int8
+        assert model.item_factors.dtype == np.int8
+        assert model.user_scales is not None and model.user_scales.dtype == np.float32
+        assert model.item_scales is not None
+        assert model.user_scales.shape == (model.user_factors.shape[0],)
+        q = rec.Query(user="u0", num=4)
+        result = serving.serve(q, [algo.predict(model, q)])
+        assert len(result.itemScores) == 4
+        # preference structure recovered through quantized storage
+        assert int(result.itemScores[0].item[1:]) % 2 == 0
+        scores = [s.score for s in result.itemScores]
+        assert scores == sorted(scores, reverse=True)
+        # batch path scores the same items
+        [(_, batch_res)] = algo.batch_predict(model, [(0, q)])
+        assert [s.item for s in batch_res.itemScores] == [
+            s.item for s in result.itemScores
+        ]
+
+    def test_int8_model_blob_shrinks_4x(self, seeded_app):
+        """The point of quantized serving blobs: int8 factor payload is
+        ~4x smaller than f32 (less one f32 scale per row)."""
+        engine = rec.engine()
+        run_train(engine, make_ep(), engine_id="rec-f32", storage=seeded_app)
+        run_train(
+            engine, make_ep(storage_dtype="int8"), engine_id="rec-i8b",
+            storage=seeded_app,
+        )
+        instances = seeded_app.get_metadata_engine_instances()
+
+        def model_of(engine_id):
+            inst = instances.get_latest_completed(engine_id, "0", "default")
+            _, _, [model], _ = prepare_deploy(engine, inst, storage=seeded_app)
+            return model
+
+        m32, m8 = model_of("rec-f32"), model_of("rec-i8b")
+
+        def factor_bytes(m):
+            arrs = [m.user_factors, m.item_factors]
+            if m.user_scales is not None:
+                arrs += [m.user_scales, m.item_scales]
+            return sum(a.nbytes for a in arrs)
+
+        # values shrink 4x; per-row scales add one f32 per row back
+        assert factor_bytes(m8) < factor_bytes(m32) / 2
+
     def test_sharded_train_via_run_train_matches_single_chip(self, seeded_app):
         """`pio train` with shardedTrain trains over the mesh through the
         full framework path (run_train -> Engine -> ALSAlgorithm) and

@@ -384,6 +384,42 @@ class TestEngineServer:
         assert status == 200
         assert deployed_engine["server"].instance.id != old_id
 
+    def test_reload_onto_int8_instance_serves(self, deployed_engine):
+        """An int8-trained instance round-trips through persistence and
+        /reload: the hot-swapped model carries quantized factors + scales
+        and answers queries."""
+        import numpy as np
+
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.models import recommendation as rec
+
+        base = deployed_engine["base"]
+        old_id = deployed_engine["server"].instance.id
+        ep_i8 = EngineParams(
+            datasource=("", rec.DataSourceParams(app_name="ServeApp")),
+            algorithms=[(
+                "als",
+                rec.ALSAlgorithmParams(
+                    rank=4, num_iterations=3, storage_dtype="int8"
+                ),
+            )],
+        )
+        run_train(
+            deployed_engine["engine"], ep_i8, engine_id="serve",
+            storage=deployed_engine["storage"],
+        )
+        status, _ = http("POST", f"{base}/reload?accessKey=secret")
+        assert status == 200
+        server = deployed_engine["server"]
+        assert server.instance.id != old_id
+        [model] = server.models
+        assert model.user_factors.dtype == np.int8
+        assert model.user_scales is not None
+        status, body = http("POST", f"{base}/queries.json", {"user": "u1", "num": 3})
+        assert status == 200
+        assert len(body["itemScores"]) == 3
+
     def test_plugins_endpoint(self, deployed_engine):
         status, body = http("GET", deployed_engine["base"] + "/plugins.json")
         assert status == 200 and "plugins" in body
@@ -937,6 +973,98 @@ class TestHTTPParserFraming:
             s = socket.create_connection(("127.0.0.1", port))
             s.sendall(b"POST /echo HTTP/1.1\r\n" + b"x: y\r\n" * 300)
             assert s.recv(65536).decode().startswith("HTTP/1.1 431")
+        finally:
+            app.stop()
+
+    def test_conflicting_duplicate_content_length_rejected(self):
+        import socket
+
+        app = self._app()
+        port = app.start(background=True)
+        try:
+            s = socket.create_connection(("127.0.0.1", port))
+            s.sendall(
+                b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 5\r\nContent-Length: 11\r\n\r\nhello"
+            )
+            assert s.recv(65536).decode().startswith("HTTP/1.1 400")
+        finally:
+            app.stop()
+
+    def test_identical_duplicate_content_length_accepted(self):
+        import json
+        import socket
+
+        app = self._app()
+        port = app.start(background=True)
+        try:
+            s = socket.create_connection(("127.0.0.1", port))
+            s.sendall(
+                b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 5\r\nContent-Length: 5\r\n\r\nhello"
+            )
+            raw = s.recv(65536).decode()
+            assert raw.startswith("HTTP/1.1 200")
+            assert json.loads(raw.split("\r\n\r\n", 1)[1]) == {"n": 5}
+        finally:
+            app.stop()
+
+    def test_pipelined_request_after_reject_not_parsed(self):
+        """A smuggled second request riding behind a rejected framing
+        must never be dispatched: the 400 closes the connection and the
+        trailing bytes die with it."""
+        import socket
+
+        app = self._app()
+        port = app.start(background=True)
+        try:
+            s = socket.create_connection(("127.0.0.1", port))
+            s.sendall(
+                b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 5\r\nContent-Length: 11\r\n\r\n"
+                b"hello"
+                b"POST /echo HTTP/1.1\r\nHost: x\r\nContent-Length: 0\r\n\r\n"
+            )
+            raw = s.recv(65536).decode()
+            assert raw.startswith("HTTP/1.1 400")
+            assert "Connection: close" in raw
+            # only the 400 ever comes back; the pipelined request is dead
+            assert raw.count("HTTP/1.1") == 1
+            s.settimeout(5)
+            assert s.recv(65536) == b""  # server closed
+        finally:
+            app.stop()
+
+    def test_slow_client_read_timeout_frees_connection(self):
+        """A client that stalls mid-request is cut loose after
+        read_timeout instead of pinning a worker thread forever."""
+        import socket
+        import time
+
+        from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+        router = Router()
+
+        @router.route("POST", "/echo")
+        def echo(request):
+            return Response.json({"n": len(request.body)})
+
+        app = HTTPApp(router, host="127.0.0.1", port=0, read_timeout=0.5)
+        port = app.start(background=True)
+        try:
+            s = socket.create_connection(("127.0.0.1", port))
+            # headers promise a body that never arrives
+            s.sendall(b"POST /echo HTTP/1.1\r\nHost: x\r\nContent-Length: 5\r\n\r\n")
+            s.settimeout(10)
+            start = time.monotonic()
+            assert s.recv(65536) == b""  # server dropped us, no response
+            assert time.monotonic() - start < 8
+            # server is still healthy for well-behaved clients
+            s2 = socket.create_connection(("127.0.0.1", port))
+            s2.sendall(
+                b"POST /echo HTTP/1.1\r\nHost: x\r\nContent-Length: 2\r\n\r\nhi"
+            )
+            assert s2.recv(65536).decode().startswith("HTTP/1.1 200")
         finally:
             app.stop()
 
