@@ -64,6 +64,7 @@ RUN_CPU_BASELINE = os.environ.get("BENCH_BASELINE", "1") == "1"
 RUN_SERVING = os.environ.get("BENCH_SERVING", "1") == "1"
 RUN_INGEST = os.environ.get("BENCH_INGEST", "1") == "1"
 RUN_SCALING = os.environ.get("BENCH_SCALING", "1") == "1"
+RUN_REALTIME = os.environ.get("BENCH_REALTIME", "1") == "1"
 E2E_EVENTS = int(os.environ.get("BENCH_E2E_EVENTS", "20000000"))
 # high-rank MFU sweep at the 20m scale (comma list; empty disables)
 RANK_SWEEP = [
@@ -1270,6 +1271,112 @@ def sharded_child() -> None:
     print(json.dumps(out))
 
 
+def bench_realtime(
+    extras: dict,
+    n_users: int = 2000,
+    n_items: int = 500,
+    batches: int = 5,
+    batch_events: int = 1000,
+) -> None:
+    """Speed-layer fold-in: latency per 1k-event batch, sustained
+    events/s through tail->fold, and the max events_behind backlog while
+    a burst lands mid-fold. Runs in-process against a memory store and a
+    synthetic rank-16 model (fold-in cost depends on shapes, not factor
+    quality), so the section works on any attachment."""
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.memory import (
+        MemoryEvents,
+        MemoryStorageClient,
+    )
+    from predictionio_tpu.models.recommendation import ALSModel
+    from predictionio_tpu.realtime import ALSFoldIn, EventTailer, FoldInConfig
+
+    rank = 16
+    rng = np.random.default_rng(SEED)
+    model = ALSModel(
+        user_index=BiMap.from_dense([f"u{i}" for i in range(n_users)]),
+        item_index=BiMap.from_dense([f"i{i}" for i in range(n_items)]),
+        user_factors=rng.normal(size=(n_users, rank)).astype(np.float32),
+        item_factors=rng.normal(size=(n_items, rank)).astype(np.float32),
+    )
+    events = MemoryEvents(MemoryStorageClient({}))
+    app_id = 1
+
+    def make_batch(k):
+        return [
+            Event(
+                event="rate",
+                entity_type="user",
+                # half the events touch NEW users (worst case: append)
+                entity_id=(
+                    f"new{k}_{j % 100}" if j % 2 else f"u{j % n_users}"
+                ),
+                target_entity_type="item",
+                target_entity_id=f"i{int(rng.integers(0, n_items))}",
+                properties={"rating": float(rng.integers(1, 6))},
+            )
+            for j in range(batch_events)
+        ]
+
+    tailer = EventTailer(events, app_id, batch_limit=batch_events * 2)
+    foldin = ALSFoldIn(events, app_id, config=FoldInConfig())
+
+    # warm the jit cache so the steady-state numbers exclude compiles
+    for e in make_batch(-1):
+        events.insert(e, app_id)
+    warm, _ = foldin.fold(model, tailer.poll())
+    if warm is not None:
+        model = warm
+
+    lat = []
+    total_events = 0
+    t_total0 = time.perf_counter()
+    for k in range(batches):
+        for e in make_batch(k):
+            events.insert(e, app_id)
+        t0 = time.perf_counter()
+        batch = tailer.poll()
+        patched, stats = foldin.fold(model, batch)
+        lat.append(time.perf_counter() - t0)
+        total_events += stats.events
+        if patched is not None:
+            model = patched
+    sustained = time.perf_counter() - t_total0
+
+    # staleness under load: a burst lands, then drains poll-by-poll
+    burst = 5 * batch_events
+    for k in range(5):
+        for e in make_batch(100 + k):
+            events.insert(e, app_id)
+    max_behind = tailer.events_behind() or 0
+    drain_t0 = time.perf_counter()
+    while True:
+        batch = tailer.poll()
+        if not batch:
+            break
+        patched, _ = foldin.fold(model, batch)
+        if patched is not None:
+            model = patched
+        behind = tailer.events_behind() or 0
+        max_behind = max(max_behind, behind)
+    drain_s = time.perf_counter() - drain_t0
+
+    lat.sort()
+    extras["realtime"] = {
+        "model_shape": f"{n_users}x{n_items} rank {rank}",
+        "batch_events": batch_events,
+        "batches": batches,
+        "foldin_latency_s": round(lat[len(lat) // 2], 4),
+        "foldin_latency_max_s": round(lat[-1], 4),
+        "events_per_s": round(total_events / sustained),
+        "burst_events": burst,
+        "max_events_behind": int(max_behind),
+        "burst_drain_s": round(drain_s, 3),
+        "users_in_model": len(model.user_index),
+    }
+
+
 def _compact_summary(result: dict) -> dict:
     """One SMALL machine-readable line — always the LAST stdout line, so
     a bounded tail capture (the driver keeps ~2,000 chars) still parses
@@ -1336,6 +1443,13 @@ def _compact_summary(result: dict) -> dict:
                               "import_speedup")
                     if k in st[bk]
                 }
+    rt = result.get("realtime")
+    if isinstance(rt, dict) and "error" not in rt:
+        s["realtime"] = {
+            k: rt[k]
+            for k in ("foldin_latency_s", "events_per_s", "max_events_behind")
+            if k in rt
+        }
     errors = sorted(
         k for k, v in result.items()
         if isinstance(v, dict) and "error" in v
@@ -1347,9 +1461,10 @@ def _compact_summary(result: dict) -> dict:
 
 def smoke_main() -> None:
     """--smoke: a seconds-scale CI probe. Forces CPU (no accelerator
-    probe), runs ONLY the storage section at a tiny event count, and
-    prints the full-detail line plus the compact summary line. Exit 0
-    with a parseable final line is the contract the smoke test checks."""
+    probe), runs the storage section at a tiny event count plus a tiny
+    realtime fold-in, and prints the full-detail line plus the compact
+    summary line. Exit 0 with a parseable final line is the contract the
+    smoke test checks."""
     import atexit
     import shutil
 
@@ -1374,6 +1489,12 @@ def smoke_main() -> None:
         )
     except Exception as e:  # the smoke contract is exit 0 + JSON line
         result["storage"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        bench_realtime(
+            result, n_users=200, n_items=50, batches=2, batch_events=100
+        )
+    except Exception as e:
+        result["realtime"] = {"error": f"{type(e).__name__}: {e}"}
     result["value"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(result))
     print(json.dumps(_compact_summary(result)))
@@ -1619,6 +1740,13 @@ def main() -> None:
         except Exception as e:
             extras["scaling"] = {"error": f"{type(e).__name__}: {e}"}
         _mark("scaling")
+
+    if RUN_REALTIME:
+        try:
+            bench_realtime(extras)
+        except Exception as e:
+            extras["realtime"] = {"error": f"{type(e).__name__}: {e}"}
+        _mark("realtime")
 
     # second chance a few minutes in: serving+ingest are host-heavy, so
     # a tunnel that came up during them still buys TPU core rows

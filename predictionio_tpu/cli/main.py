@@ -439,9 +439,26 @@ def cmd_deploy(args) -> int:
         batch_window_ms=args.batch_window_ms,
         reuse_port=args.reuse_port,
     )
+    layer = None
+    if getattr(args, "realtime", 0.0) and args.realtime > 0:
+        from pathlib import Path
+
+        from predictionio_tpu.realtime import SpeedLayer
+
+        cursor = args.realtime_cursor or str(
+            Path("~/.pio_tpu").expanduser()
+            / "realtime"
+            / f"cursor_{instance.engine_id}_{args.port}.json"
+        )
+        layer = SpeedLayer(server, interval=args.realtime, cursor_path=cursor)
+        layer.start()
     # foreground, like the reference: backgrounding is the caller's job
     # (shell &, supervisor); a daemon thread would die with this process
-    server.start(background=False)
+    try:
+        server.start(background=False)
+    finally:
+        if layer is not None:
+            layer.stop()
     return 0
 
 
@@ -804,6 +821,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--reuse-port", action="store_true",
         help="bind with SO_REUSEPORT (set automatically for workers; "
         "useful when an external supervisor runs the processes)",
+    )
+    d.add_argument(
+        "--realtime", type=float, default=0.0, metavar="SECONDS",
+        help="enable the speed layer: tail the app's event stream every "
+        "SECONDS and fold new rating events into the live model between "
+        "retrains (0 = batch-only serving); see docs/realtime.md",
+    )
+    d.add_argument(
+        "--realtime-cursor",
+        help="durable tailer cursor file (default: "
+        "~/.pio_tpu/realtime/cursor_<engine>_<port>.json)",
     )
     d.set_defaults(fn=cmd_deploy)
 

@@ -351,6 +351,13 @@ class JSONLEvents(base.Events):
         fold_jsonl_file(self._file(app_id, channel_id), table)
         return table
 
+    def tail_files(
+        self, app_id: int, channel_id: int | None = None
+    ) -> list[Path]:
+        """Log files a byte-offset tailer should follow, in replay order.
+        One append-only log here; the file may not exist yet."""
+        return [self._file(app_id, channel_id)]
+
     def change_token(
         self, app_id: int, channel_id: int | None = None
     ) -> object | None:

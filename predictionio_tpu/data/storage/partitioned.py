@@ -797,6 +797,28 @@ class PartitionedEvents(base.Events):
             for pp, lines in per_part.items():
                 write_part(pp, lines)
 
+    def tail_files(
+        self, app_id: int, channel_id: int | None = None
+    ) -> list[Path]:
+        """Log files a byte-offset tailer should follow: per partition the
+        sealed segments (immutable once named ``seg_*``) then the active
+        log. A seal moves bytes from active to a new segment path — the
+        tailer sees the active file shrink (lineage break, re-read) and
+        the new segment appear; its watermark dedupe skips the re-read of
+        already-delivered records."""
+        ns = self._ns_dir(app_id, channel_id)
+        if not (ns / "_meta.json").exists():
+            return []
+        n = self._n_partitions(ns)
+        out: list[Path] = []
+        for pp in range(n):
+            pdir = ns / f"p{pp:02x}"
+            if not pdir.is_dir():
+                continue
+            out.extend(self._segments(pdir))
+            out.append(pdir / "active.jsonl")
+        return out
+
     def change_token(
         self, app_id: int, channel_id: int | None = None
     ) -> object | None:

@@ -50,6 +50,7 @@ from predictionio_tpu.data.storage.sqlite import (
     SQLiteEvaluationInstances,
     SQLiteEvents,
     SQLiteModels,
+    _is_missing_table,
 )
 
 # column lists for the INSERT OR REPLACE -> ON CONFLICT translation
@@ -411,6 +412,66 @@ class PostgresEvents(SQLiteEvents):
             # idle-in-transaction (pinning vacuum) until the next write
             with self._c.lock:
                 self._c.conn.commit()
+
+    _TAIL_START = (0.0, "")
+
+    def tail_end(
+        self, app_id: int, channel_id: int | None = None
+    ) -> object | None:
+        t = self._table(app_id, channel_id)
+        try:
+            with self._c.lock:
+                row = self._c.query_one(
+                    f"SELECT creationtime, id FROM {t} "
+                    f"ORDER BY creationtime DESC, id DESC LIMIT 1"
+                )
+                self._c.conn.commit()
+        except sqlite3.OperationalError as err:
+            if _is_missing_table(err):
+                return self._TAIL_START
+            raise
+        if row is None:
+            return self._TAIL_START
+        return (float(row[0]), str(row[1]))
+
+    def tail_events(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        after: object | None = None,
+        limit: int | None = None,
+    ) -> tuple[list[Event], object]:
+        """Postgres has no sqlite rowid, so the cursor is the keyset
+        ``(creationtime, id)`` — ingest timestamp (server-assigned at
+        insert) tie-broken by the primary key. The strictly-greater
+        keyset predicate makes the tail exactly-once even when a burst
+        shares one timestamp: a limit-truncated read resumes mid-tie at
+        the id boundary instead of skipping or re-delivering."""
+        t = self._table(app_id, channel_id)
+        ct, last_id = self._TAIL_START if after is None else (
+            float(after[0]),
+            str(after[1]),
+        )
+        cursor: object = (ct, last_id)
+        sql = (
+            f"SELECT * FROM {t} WHERE creationtime > ? "
+            f"OR (creationtime = ? AND id > ?) ORDER BY creationtime, id"
+        )
+        params: list = [ct, ct, last_id]
+        if limit is not None and limit > 0:
+            sql += " LIMIT ?"
+            params.append(int(limit))
+        try:
+            with self._c.lock:
+                rows = self._c.query(sql, params)
+                self._c.conn.commit()
+        except sqlite3.OperationalError as err:
+            if _is_missing_table(err):
+                return [], cursor
+            raise
+        if rows:
+            cursor = (float(rows[-1][11]), str(rows[-1][0]))
+        return [self._parse(r) for r in rows], cursor
 
     def change_token(
         self, app_id: int, channel_id: int | None = None

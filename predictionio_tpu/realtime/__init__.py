@@ -1,0 +1,24 @@
+"""Realtime speed layer: event tailing + incremental ALS fold-in.
+
+The reference PredictionIO is a Lambda architecture — batch retrain plus
+a speed layer where serving reflects events that arrived after the last
+train. This package is that speed layer: :class:`EventTailer` follows an
+event store incrementally with a durable cursor, :class:`ALSFoldIn`
+solves touched user rows in closed form against the fixed item factors
+(the ALX per-row least-squares primitive, arxiv 2112.02194), and
+:class:`SpeedLayer` drives the loop against a deployed engine server,
+hot-patching its model tables under an epoch fence so a full retrain +
+``/reload`` always wins. See docs/realtime.md.
+"""
+
+from predictionio_tpu.realtime.foldin import ALSFoldIn, FoldInConfig, FoldInStats
+from predictionio_tpu.realtime.speed_layer import SpeedLayer
+from predictionio_tpu.realtime.tailer import EventTailer
+
+__all__ = [
+    "ALSFoldIn",
+    "EventTailer",
+    "FoldInConfig",
+    "FoldInStats",
+    "SpeedLayer",
+]

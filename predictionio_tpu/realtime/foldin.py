@@ -1,0 +1,319 @@
+"""Incremental ALS fold-in: solve touched user rows against fixed items.
+
+The ALX observation (arxiv 2112.02194): one ALS half-step already solves
+every user row in closed form against the current item factors, and that
+per-row least-squares is exactly the "fold a new/changed user in without
+retraining" primitive. This module reuses the same jitted Gramian +
+batched Cholesky path (:func:`ops.als.solve_bucket_explicit`, f32 solve
+regardless of storage dtype) on the batch of users touched by tailed
+rating events:
+
+- each touched user's FULL rating history is re-read from the event
+  store (the new events are already ingested there), so the solve is
+  the exact half-step the next retrain would take for that row;
+- item factors stay fixed — int8 tables are dequantized at gather time
+  on device, exactly like training;
+- solved rows are written back in the model's storage dtype: f32/bf16
+  cast, or int8 requantized with a fresh per-row scale
+  (:func:`ops.als.quantize_rows` semantics);
+- brand-new users are appended to the factor table and the id index;
+- events naming items unseen at train time can't be solved against (no
+  factor row) — they accumulate in ``cold_items`` (count + rating sum)
+  as cold-start stats for the next retrain to pick up.
+
+The patched model SHARES the item arrays with the old model and never
+mutates served state — the server swap is a pointer flip under its lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.models.recommendation import ALSModel
+from predictionio_tpu.ops import als as als_ops
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class FoldInConfig:
+    """Rating-extraction + solve parameters; must match the deployed
+    engine's datasource/algorithm params so the fold-in solves the same
+    problem the batch trainer does (SpeedLayer derives one from the
+    server's EngineParams)."""
+
+    event_names: tuple[str, ...] = ("rate", "buy")
+    rating_key: str | None = "rating"
+    default_ratings: dict | None = None
+    override_ratings: dict | None = None
+    entity_type: str = "user"
+    target_entity_type: str = "item"
+    reg: float = 0.01
+    weighted_reg: bool = True
+
+
+@dataclasses.dataclass
+class FoldInStats:
+    """What one fold() call did."""
+
+    events: int = 0
+    rating_events: int = 0
+    users_touched: int = 0
+    users_added: int = 0
+    users_skipped: int = 0  # touched but no trainable pairs
+    cold_item_events: int = 0
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+class ALSFoldIn:
+    """Folds batches of rating events into an ALSModel's user table."""
+
+    def __init__(
+        self,
+        events,
+        app_id: int,
+        channel_id: int | None = None,
+        config: FoldInConfig | None = None,
+    ):
+        self._events = events
+        self._app_id = app_id
+        self._channel_id = channel_id
+        self.config = config or FoldInConfig()
+        # item id -> [event count, rating sum]; unseen-at-train items
+        self.cold_items: dict[str, list] = {}
+        # device copy of the item table, keyed by the identity of the
+        # host array so a /reload (new model object) invalidates it
+        self._item_dev = None
+        self._item_dev_key = None
+
+    # -- rating extraction (mirrors base.Events.scan_ratings) ---------------
+
+    def _rating_of(self, e: Event) -> float | None:
+        cfg = self.config
+        if e.event not in cfg.event_names:
+            return None
+        if e.entity_type != cfg.entity_type:
+            return None
+        if e.target_entity_type != cfg.target_entity_type:
+            return None
+        if e.target_entity_id is None:
+            return None
+        v = (cfg.override_ratings or {}).get(e.event)
+        if v is None:
+            v = (
+                e.properties.to_dict().get(cfg.rating_key)
+                if cfg.rating_key is not None
+                else None
+            )
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                v = (cfg.default_ratings or {}).get(e.event)
+        if v is None:
+            return None
+        return float(v)
+
+    # -- history reads ------------------------------------------------------
+
+    def _histories(self, touched: list[str]) -> dict[str, list[Event]]:
+        """Full rating-event history per touched user, including the
+        events that triggered this fold (they are already ingested)."""
+        cfg = self.config
+        out: dict[str, list[Event]] = {u: [] for u in touched}
+        if getattr(self._events, "entity_indexed", False):
+            for uid in touched:
+                out[uid] = self._events.find(
+                    self._app_id,
+                    self._channel_id,
+                    entity_type=cfg.entity_type,
+                    entity_id=uid,
+                    event_names=list(cfg.event_names),
+                    target_entity_type=cfg.target_entity_type,
+                )
+            return out
+        # replay backends: one bulk scan amortizes across the batch
+        touched_set = set(touched)
+        for e in self._events.find(
+            self._app_id,
+            self._channel_id,
+            entity_type=cfg.entity_type,
+            event_names=list(cfg.event_names),
+            target_entity_type=cfg.target_entity_type,
+        ):
+            if e.entity_id in touched_set:
+                out[e.entity_id].append(e)
+        return out
+
+    # -- the fold -----------------------------------------------------------
+
+    def fold(
+        self, model: ALSModel, events: list[Event]
+    ) -> tuple[ALSModel | None, FoldInStats]:
+        """Fold a batch of tailed events into ``model``.
+
+        Returns ``(patched_model, stats)`` — patched_model is ``None``
+        when the batch contained nothing foldable (stats says why). The
+        input model is never mutated."""
+        stats = FoldInStats(events=len(events))
+        touched: list[str] = []
+        touched_set: set[str] = set()
+        for e in events:
+            v = self._rating_of(e)
+            if v is None:
+                continue
+            stats.rating_events += 1
+            if e.target_entity_id not in model.item_index:
+                acc = self.cold_items.setdefault(e.target_entity_id, [0, 0.0])
+                acc[0] += 1
+                acc[1] += v
+                stats.cold_item_events += 1
+            if e.entity_id not in touched_set:
+                touched_set.add(e.entity_id)
+                touched.append(e.entity_id)
+        if not touched:
+            return None, stats
+
+        histories = self._histories(touched)
+        users: list[str] = []
+        pairs: list[list[tuple[int, float]]] = []
+        for uid in touched:
+            seen: dict[int, float] = {}
+            for e in histories.get(uid, ()):
+                v = self._rating_of(e)
+                if v is None:
+                    continue
+                ix = model.item_index.get(e.target_entity_id)
+                if ix is None:
+                    continue  # cold item: no factor row to solve against
+                seen[ix] = v  # replay order: last write wins
+            if not seen:
+                stats.users_skipped += 1
+                continue
+            users.append(uid)
+            pairs.append(list(seen.items()))
+        stats.users_touched = len(users)
+        if not users:
+            return None, stats
+
+        solved = self._solve(model, pairs)
+        patched = self._patch(model, users, solved, stats)
+        return patched, stats
+
+    def _solve(self, model: ALSModel, pairs) -> np.ndarray:
+        """Closed-form f32 solve of the touched rows, padded to stable
+        (B, K) program shapes so repeat folds reuse the jit cache."""
+        import jax.numpy as jnp
+
+        B = _pow2(len(pairs))
+        K = _pow2(max(len(p) for p in pairs), floor=8)
+        col_ids = np.zeros((B, K), dtype=np.int32)
+        ratings = np.zeros((B, K), dtype=np.float32)
+        mask = np.zeros((B, K), dtype=np.float32)
+        for i, p in enumerate(pairs):
+            for j, (ix, v) in enumerate(p):
+                col_ids[i, j] = ix
+                ratings[i, j] = v
+                mask[i, j] = 1.0
+        item_host = model.item_table()
+        key = id(
+            item_host[0] if isinstance(item_host, tuple) else item_host
+        )
+        if self._item_dev_key != key:
+            if isinstance(item_host, tuple):
+                self._item_dev = (
+                    jnp.asarray(item_host[0]),
+                    jnp.asarray(item_host[1]),
+                )
+            else:
+                self._item_dev = jnp.asarray(item_host)
+            self._item_dev_key = key
+        x = als_ops.solve_bucket_explicit(
+            self._item_dev,
+            jnp.asarray(col_ids),
+            jnp.asarray(ratings),
+            jnp.asarray(mask),
+            reg=self.config.reg,
+            weighted_reg=self.config.weighted_reg,
+            compute_dtype="float32",
+        )
+        return np.asarray(x)[: len(pairs)]
+
+    def _patch(
+        self,
+        model: ALSModel,
+        users: list[str],
+        solved: np.ndarray,
+        stats: FoldInStats,
+    ) -> ALSModel:
+        """New ALSModel with the solved rows written back (appending
+        brand-new users); item arrays are shared, nothing is mutated."""
+        index = model.user_index.to_dict()
+        n_existing = len(index)
+        new_ids = [u for u in users if u not in index]
+        for uid in new_ids:
+            index[uid] = len(index)
+        stats.users_added = len(new_ids)
+        user_index = (
+            model.user_index if not new_ids else BiMap(index)
+        )
+
+        uf = model.user_factors
+        if model.user_scales is not None:
+            # int8 storage: requantize each solved row with a fresh
+            # per-row scale (quantize_rows semantics, host-side)
+            sc = np.max(np.abs(solved), axis=1) / 127.0
+            sc[sc <= 0] = 1.0
+            q = np.round(solved / sc[:, None]).astype(np.int8)
+            values = np.concatenate(
+                [uf, np.zeros((len(new_ids), uf.shape[1]), dtype=uf.dtype)]
+            )
+            scales = np.concatenate(
+                [
+                    model.user_scales,
+                    np.ones(len(new_ids), dtype=model.user_scales.dtype),
+                ]
+            )
+            for i, uid in enumerate(users):
+                ix = index[uid]
+                values[ix] = q[i]
+                scales[ix] = sc[i]
+            return ALSModel(
+                user_index=user_index,
+                item_index=model.item_index,
+                user_factors=values,
+                item_factors=model.item_factors,
+                user_scales=scales,
+                item_scales=model.item_scales,
+            )
+        values = np.concatenate(
+            [uf, np.zeros((len(new_ids), uf.shape[1]), dtype=uf.dtype)]
+        )
+        rows = solved.astype(uf.dtype)
+        for i, uid in enumerate(users):
+            values[index[uid]] = rows[i]
+        if n_existing == 0 and not new_ids:  # pragma: no cover - guard
+            raise AssertionError("patch with no rows")
+        return ALSModel(
+            user_index=user_index,
+            item_index=model.item_index,
+            user_factors=values,
+            item_factors=model.item_factors,
+            user_scales=None,
+            item_scales=model.item_scales,
+        )
+
+    def cold_start_stats(self) -> dict[str, dict]:
+        """Accumulated unseen-item stats: id -> {events, mean_rating}."""
+        return {
+            iid: {"events": c, "mean_rating": s / c if c else 0.0}
+            for iid, (c, s) in self.cold_items.items()
+        }

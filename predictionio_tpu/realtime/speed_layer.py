@@ -1,0 +1,212 @@
+"""SpeedLayer: the loop that ties tailer + fold-in to a deployed server.
+
+One background thread per deployed engine server: every ``interval``
+seconds it polls the event tailer, folds tailed rating events into the
+served ALS model, and hot-patches the server's model list under the
+epoch fence. The invariants (docs/realtime.md):
+
+- **retrain wins** — a ``/reload`` to a NEW engine instance supersedes
+  all fold-in state: the tailer cursor resets to the new instance's
+  train watermark (its events are in the retrain) and pending patches
+  are dropped. A reload of the SAME instance likewise discards applied
+  patches (the epoch fence rejects them); folded events are served
+  again only after the next retrain covers them.
+- **at-most-once serving staleness** — gauges (`events_behind`,
+  `seconds_behind`, `foldin_epoch`) ride the engine server's
+  ``/stats.json`` so operators can alert on a stuck speed layer
+  (the staleness-as-first-class-metric argument of arxiv 2501.10546).
+- the fold thread NEVER holds the server lock across a solve: it
+  snapshots, folds off-lock, and compare-and-swaps by epoch.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from predictionio_tpu.data import store
+from predictionio_tpu.realtime.foldin import ALSFoldIn, FoldInConfig
+from predictionio_tpu.realtime.tailer import EventTailer
+
+logger = logging.getLogger(__name__)
+
+
+def _is_als_model(m) -> bool:
+    return all(
+        hasattr(m, a)
+        for a in ("user_index", "item_index", "user_factors", "item_factors")
+    )
+
+
+class SpeedLayer:
+    """Tail the deployed app's event stream and fold into the live model.
+
+    Derives its fold-in config from the server's deployed EngineParams
+    (datasource app/event names + the algorithm's regularization), so the
+    incremental solve matches the batch trainer's problem exactly.
+    """
+
+    def __init__(
+        self,
+        server,
+        interval: float = 5.0,
+        cursor_path=None,
+        batch_limit: int = 5000,
+    ):
+        self.server = server
+        self.interval = float(interval)
+        ds_params = server.engine_params.datasource[1]
+        algo_params = server.engine_params.algorithms[0][1]
+        self._config = FoldInConfig(
+            event_names=tuple(ds_params.event_names),
+            rating_key="rating",
+            override_ratings={"buy": ds_params.buy_rating},
+            reg=getattr(algo_params, "lambda_", 0.01),
+            weighted_reg=True,
+        )
+        app_id, channel_id = store.app_name_to_id(
+            ds_params.app_name, None, server.storage
+        )
+        events = server.storage.get_events()
+        self.tailer = EventTailer(
+            events,
+            app_id,
+            channel_id,
+            cursor_path=cursor_path,
+            batch_limit=batch_limit,
+        )
+        self.foldin = ALSFoldIn(events, app_id, channel_id, config=self._config)
+        # the instance this layer's fold-in state belongs to; a snapshot
+        # naming a different instance means a retrain superseded us
+        self._instance_id = server.instance.id
+        self._caught_up_at = time.time()
+        self._last_fold_s = 0.0
+        self.events_folded = 0
+        self.users_touched = 0
+        self.users_added = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        server.speed_layer = self
+
+    # -- one fold cycle -----------------------------------------------------
+
+    def step(self) -> str:
+        """One poll+fold+patch cycle; returns what happened (for tests
+        and logs): "superseded" | "idle" | "patched" | "fenced" |
+        "skipped"."""
+        inst_id, models, epoch = self.server.model_snapshot()
+        if inst_id != self._instance_id:
+            # retrain won: the new instance's training read covered the
+            # log up to its own watermark — restart tailing from now
+            logger.info(
+                "speed layer superseded by instance %s (was %s); "
+                "resetting cursor to the new train watermark",
+                inst_id,
+                self._instance_id,
+            )
+            self._instance_id = inst_id
+            self.tailer.reset()
+            self.foldin.cold_items.clear()
+            self._caught_up_at = time.time()
+            return "superseded"
+
+        events = self.tailer.poll()
+        if not events:
+            if (self.tailer.events_behind() or 0) == 0:
+                self._caught_up_at = time.time()
+            return "idle"
+
+        t0 = time.perf_counter()
+        for _attempt in range(3):
+            patched_any = False
+            new_models = []
+            stats = None
+            for m in models:
+                if _is_als_model(m):
+                    patched, stats = self.foldin.fold(m, events)
+                    if patched is not None:
+                        new_models.append(patched)
+                        patched_any = True
+                        continue
+                new_models.append(m)
+            if not patched_any:
+                self._last_fold_s = time.perf_counter() - t0
+                return "skipped"  # no foldable events for any model
+            if self.server.apply_patch(new_models, epoch):
+                self._last_fold_s = time.perf_counter() - t0
+                if stats is not None:
+                    self.events_folded += stats.rating_events
+                    self.users_touched += stats.users_touched
+                    self.users_added += stats.users_added
+                if (self.tailer.events_behind() or 0) == 0:
+                    self._caught_up_at = time.time()
+                return "patched"
+            # fence lost: someone swapped models since our snapshot
+            inst_id, models, epoch = self.server.model_snapshot()
+            if inst_id != self._instance_id:
+                # a retrain landed mid-fold: ITS training read already
+                # covers these events — drop the batch, reset forward
+                self._instance_id = inst_id
+                self.tailer.reset()
+                self.foldin.cold_items.clear()
+                self._last_fold_s = time.perf_counter() - t0
+                return "superseded"
+            # same instance (another patch or same-instance reload):
+            # re-fold this batch against the fresh models
+        self._last_fold_s = time.perf_counter() - t0
+        logger.warning("speed layer lost the epoch fence 3 times; retrying next poll")
+        return "fenced"
+
+    # -- gauges -------------------------------------------------------------
+
+    def gauges(self) -> dict:
+        behind = self.tailer.events_behind()
+        with self.server._lock:
+            foldin_epoch = self.server._foldin_epoch
+        return {
+            "enabled": True,
+            "interval": self.interval,
+            "mode": self.tailer.mode,
+            "foldin_epoch": foldin_epoch,
+            "events_behind": behind,
+            "seconds_behind": (
+                0.0 if behind == 0 else round(time.time() - self._caught_up_at, 3)
+            ),
+            "events_folded": self.events_folded,
+            "users_touched": self.users_touched,
+            "users_added": self.users_added,
+            "cold_start_items": len(self.foldin.cold_items),
+            "last_fold_s": round(self._last_fold_s, 6),
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="speed-layer", daemon=True
+        )
+        self._thread.start()
+        logger.info(
+            "speed layer started: interval %.1fs, tail mode %s",
+            self.interval,
+            self.tailer.mode,
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        self._thread = None
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception:  # pragma: no cover - loop must survive
+                logger.exception("speed layer fold cycle failed")
+            self._stop.wait(self.interval)

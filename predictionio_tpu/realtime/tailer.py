@@ -1,0 +1,370 @@
+"""Incremental event-log follower with a durable per-source cursor.
+
+One tailer follows one (app, channel) stream of an Events DAO and
+delivers each event at most once across polls, restarts, log rotation,
+and torn trailing writes. The cursor mode is picked from the backend's
+capabilities:
+
+- **files** — the backend exposes ``tail_files()`` (jsonl, partitioned):
+  per-file byte offsets, each keyed by ``(inode, mtime_ns, size)``
+  lineage. A compaction/rotation replaces the inode (or shrinks the
+  file below our offset); that breaks lineage, so the file is re-read
+  from byte 0 with watermark + seen-id dedupe suppressing records that
+  were already delivered or predate the attach point.
+- **seq** — the backend answers ``tail_end()`` (sqlite rowid, postgres
+  creationtime, memory insertion seq): the store hands us events past an
+  opaque monotone cursor; boundary re-delivery is deduped by event id.
+- **generic** — neither: fall back to ``change_token`` + full ``find``
+  filtered by the attach watermark. Correct but O(store) per change;
+  only the capability floor, every bundled backend has a better mode.
+
+The cursor persists as JSON (tmp + atomic replace) so a restarted
+process resumes exactly where it stopped — no double-counting, no
+skipping. A fresh tailer attaches AT THE END of the stream (the batch
+layer owns history; the speed layer only folds what arrives after
+deploy), and ``reset()`` re-attaches at the end after a retrain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from pathlib import Path
+
+from predictionio_tpu.data.event import Event
+
+logger = logging.getLogger(__name__)
+
+_CURSOR_VERSION = 1
+# cap for the events_behind estimate scan, per file
+_BEHIND_SCAN_CAP = 4 * 1024 * 1024
+
+
+@dataclasses.dataclass
+class _FileCursor:
+    """Byte offset into one log file plus the lineage it belongs to.
+
+    ``offset`` is only meaningful for the file identified by ``ino``
+    with a size that never went below ``offset`` — a new inode or a
+    shrink means the log was rewritten and the offset is void."""
+
+    offset: int
+    ino: int
+    mtime_ns: int
+    size: int
+
+
+def _end_offset(path: Path) -> int:
+    """Offset just past the last complete line (trailing newline).
+
+    Scans backwards in blocks so attaching to a log with a torn final
+    line (a writer died mid-append) doesn't leave the cursor pointing
+    into the torn bytes — the torn line re-delivers whole once the
+    writer (or compaction) completes it."""
+    try:
+        with open(path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            pos = size
+            while pos > 0:
+                step = min(65536, pos)
+                f.seek(pos - step)
+                block = f.read(step)
+                nl = block.rfind(b"\n")
+                if nl >= 0:
+                    return pos - step + nl + 1
+                pos -= step
+            return 0
+    except OSError:
+        return 0
+
+
+class EventTailer:
+    """Follow one (app, channel) event stream with a durable cursor.
+
+    ``cursor_path=None`` keeps the cursor in memory only (tests, bench);
+    otherwise every poll that moved the cursor persists it atomically.
+    """
+
+    def __init__(
+        self,
+        events,
+        app_id: int,
+        channel_id: int | None = None,
+        cursor_path: str | Path | None = None,
+        batch_limit: int = 5000,
+    ):
+        self._events = events
+        self._app_id = app_id
+        self._channel_id = channel_id
+        self._cursor_path = Path(cursor_path) if cursor_path else None
+        self._batch_limit = int(batch_limit)
+        if callable(getattr(events, "tail_files", None)):
+            self.mode = "files"
+        elif events.tail_end(app_id, channel_id) is not None:
+            self.mode = "seq"
+        else:
+            self.mode = "generic"
+        self._files: dict[str, _FileCursor] = {}
+        self._seq: object | None = None
+        self._token: object | None = None
+        self._watermark: float = 0.0
+        self._seen: set[str] = set()
+        self._dirty = False
+        if not self._load():
+            self.reset()
+
+    # -- cursor lifecycle ---------------------------------------------------
+
+    def reset(self) -> None:
+        """Re-attach at the current end of the stream.
+
+        Called at first attach and after a retrain supersedes the fold-in
+        state: everything up to now is (or will be) covered by the batch
+        layer, so the speed layer starts clean from here."""
+        self._seen = set()
+        self._watermark = time.time()
+        self._files = {}
+        self._token = None
+        if self.mode == "files":
+            for path in self._events.tail_files(self._app_id, self._channel_id):
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                self._files[str(path)] = _FileCursor(
+                    _end_offset(Path(path)), st.st_ino, st.st_mtime_ns, st.st_size
+                )
+        elif self.mode == "seq":
+            self._seq = self._events.tail_end(self._app_id, self._channel_id)
+        self._dirty = True
+        self._save()
+
+    def _load(self) -> bool:
+        if self._cursor_path is None or not self._cursor_path.exists():
+            return False
+        try:
+            state = json.loads(self._cursor_path.read_text())
+        except (OSError, ValueError):
+            logger.warning("unreadable tailer cursor %s; resetting", self._cursor_path)
+            return False
+        if state.get("version") != _CURSOR_VERSION or state.get("mode") != self.mode:
+            logger.warning(
+                "tailer cursor %s is for mode %r (we are %r); resetting",
+                self._cursor_path,
+                state.get("mode"),
+                self.mode,
+            )
+            return False
+        self._watermark = float(state.get("watermark", 0.0))
+        self._seen = set(state.get("seen", ()))
+        self._seq = state.get("seq")
+        self._token = None  # change tokens don't survive restart; re-scan
+        self._files = {
+            p: _FileCursor(c["offset"], c["ino"], c["mtime_ns"], c["size"])
+            for p, c in state.get("files", {}).items()
+        }
+        return True
+
+    def _save(self) -> None:
+        if not self._dirty:
+            return
+        self._dirty = False
+        if self._cursor_path is None:
+            return
+        state = {
+            "version": _CURSOR_VERSION,
+            "mode": self.mode,
+            "watermark": self._watermark,
+            "seq": self._seq,
+            "files": {
+                p: dataclasses.asdict(c) for p, c in self._files.items()
+            },
+            "seen": sorted(self._seen),
+        }
+        self._cursor_path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self._cursor_path.with_name(self._cursor_path.name + ".tmp")
+        tmp.write_text(json.dumps(state))
+        os.replace(tmp, self._cursor_path)
+
+    # -- polling ------------------------------------------------------------
+
+    def poll(self, limit: int | None = None) -> list[Event]:
+        """Events appended since the last poll, at most ``limit``
+        (default: the tailer's batch_limit). Persists the moved cursor
+        before returning, so a crash after poll never re-delivers."""
+        limit = self._batch_limit if limit is None else int(limit)
+        if self.mode == "files":
+            out = self._poll_files(limit)
+        elif self.mode == "seq":
+            out = self._poll_seq(limit)
+        else:
+            out = self._poll_generic(limit)
+        self._save()
+        return out
+
+    def _mark_seen(self, event: Event) -> bool:
+        """True if the event is new (and now remembered)."""
+        eid = event.event_id
+        if eid is None:
+            return True
+        if eid in self._seen:
+            return False
+        self._seen.add(eid)
+        return True
+
+    def _parse_line(self, raw: bytes) -> Event | None:
+        line = raw.strip()
+        if not line or line.startswith(b'{"$delete"'):
+            return None
+        try:
+            return Event.from_json(line.decode("utf-8"))
+        except (ValueError, KeyError, UnicodeDecodeError) as err:
+            logger.warning("tailer: skipping unparseable log line: %s", err)
+            return None
+
+    def _poll_files(self, limit: int) -> list[Event]:
+        out: list[Event] = []
+        for path in self._events.tail_files(self._app_id, self._channel_id):
+            if len(out) >= limit:
+                break
+            key = str(path)
+            try:
+                f = open(path, "rb")
+            except OSError:
+                continue
+            with f:
+                # fstat AFTER open: a rotation between a stat and the open
+                # could otherwise pair old lineage with new bytes
+                st = os.fstat(f.fileno())
+                cur = self._files.get(key)
+                fresh = (
+                    cur is None
+                    or st.st_ino != cur.ino
+                    or st.st_size < cur.offset
+                )
+                if (
+                    not fresh
+                    and st.st_size == cur.size
+                    and st.st_mtime_ns == cur.mtime_ns
+                ):
+                    continue  # unchanged since last poll
+                start = 0 if fresh else cur.offset
+                f.seek(start)
+                # bound the read to the fstat'ed size: bytes appended
+                # after the fstat belong to the next poll's lineage
+                buf = f.read(max(0, st.st_size - start))
+            consumed = 0
+            truncated = False
+            pos = 0
+            while pos < len(buf):
+                nl = buf.find(b"\n", pos)
+                if nl < 0:
+                    break  # torn trailing line: wait for the newline
+                if len(out) >= limit:
+                    truncated = True
+                    break
+                raw = buf[pos:nl]
+                pos = nl + 1
+                consumed = pos
+                event = self._parse_line(raw)
+                if event is None:
+                    continue
+                if fresh and event.creation_time.timestamp() <= self._watermark:
+                    # rewrite resurfaced pre-attach history; not ours
+                    continue
+                if self._mark_seen(event):
+                    out.append(event)
+            new_offset = start + consumed
+            if truncated:
+                # stop mid-file: record the offset but NOT the stat, so
+                # the next poll re-reads the remainder
+                self._files[key] = _FileCursor(new_offset, st.st_ino, -1, -1)
+            else:
+                self._files[key] = _FileCursor(
+                    new_offset, st.st_ino, st.st_mtime_ns, st.st_size
+                )
+            self._dirty = True
+        return out
+
+    def _poll_seq(self, limit: int) -> list[Event]:
+        got = self._events.tail_events(
+            self._app_id, self._channel_id, after=self._seq, limit=limit
+        )
+        if got is None:  # capability vanished (shouldn't happen)
+            return []
+        events, cursor = got
+        if cursor != self._seq:
+            self._seq = cursor
+            self._dirty = True
+        out = [e for e in events if self._mark_seen(e)]
+        if out:
+            self._dirty = True
+        return out
+
+    def _poll_generic(self, limit: int) -> list[Event]:
+        token = self._events.change_token(self._app_id, self._channel_id)
+        if token is not None and token == self._token:
+            return []
+        out: list[Event] = []
+        truncated = False
+        for event in self._events.find(self._app_id, self._channel_id):
+            if event.creation_time.timestamp() <= self._watermark:
+                continue
+            if not self._mark_seen(event):
+                continue
+            out.append(event)
+            if len(out) >= limit:
+                truncated = True
+                break
+        if not truncated:
+            # only advance the token when the scan was complete —
+            # otherwise the rest of the backlog would be skipped
+            self._token = token
+        if out:
+            self._dirty = True
+        return out
+
+    # -- staleness ----------------------------------------------------------
+
+    def events_behind(self) -> int | None:
+        """Estimated undelivered events (upper bound: deletes and
+        replaced records count too), or None when unknowable cheaply."""
+        if self.mode == "files":
+            behind = 0
+            for path in self._events.tail_files(self._app_id, self._channel_id):
+                cur = self._files.get(str(path))
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                if (
+                    cur is not None
+                    and st.st_ino == cur.ino
+                    and st.st_size == cur.size
+                    and st.st_mtime_ns == cur.mtime_ns
+                ):
+                    continue
+                start = (
+                    cur.offset
+                    if cur is not None
+                    and st.st_ino == cur.ino
+                    and st.st_size >= cur.offset
+                    else 0
+                )
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(start)
+                        behind += f.read(_BEHIND_SCAN_CAP).count(b"\n")
+                except OSError:
+                    continue
+            return behind
+        if self.mode == "seq":
+            end = self._events.tail_end(self._app_id, self._channel_id)
+            if isinstance(end, int) and isinstance(self._seq, int):
+                return max(0, end - self._seq)
+            return None  # float cursors (postgres) aren't countable
+        token = self._events.change_token(self._app_id, self._channel_id)
+        return 0 if token is not None and token == self._token else None

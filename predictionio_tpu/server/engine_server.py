@@ -261,6 +261,13 @@ class EngineServer:
         self.log_url = log_url
         self.log_prefix = log_prefix or ""
         self._lock = threading.RLock()
+        # one epoch counter fences BOTH full reloads and speed-layer
+        # patches: every swap of self.models bumps it, and apply_patch
+        # refuses when its snapshot epoch is stale — a reload racing a
+        # fold-in can never be overwritten by pre-retrain factors
+        self._epoch = 0
+        self._foldin_epoch = 0
+        self.speed_layer = None  # attached by realtime.SpeedLayer
         self._load(instance)
 
         self.request_count = 0
@@ -303,6 +310,10 @@ class EngineServer:
             self.algorithms = algorithms
             self.models = models
             self.serving = serving
+            # retrain wins: a reload supersedes any applied fold-in
+            # patches (the new instance was trained on the full log)
+            self._epoch += 1
+            self._foldin_epoch = 0
         logger.info("engine instance %s loaded for serving", instance.id)
 
     # -- query path --------------------------------------------------------
@@ -484,6 +495,30 @@ class EngineServer:
         self._load(latest)
         return True
 
+    # -- speed-layer hot patching -------------------------------------------
+    def model_snapshot(self):
+        """(instance_id, models, epoch) under the lock — the fenced read
+        a fold-in starts from. Apply the patch back with the SAME epoch;
+        any intervening swap (reload or another patch) invalidates it."""
+        with self._lock:
+            return self.instance.id, self.models, self._epoch
+
+    def apply_patch(self, models, expected_epoch: int) -> bool:
+        """Epoch-fenced swap of the model list (speed-layer hot patch).
+
+        Returns False without touching anything when the epoch moved
+        since the snapshot — the caller re-reads and re-folds. In-flight
+        queries are untouched either way: handle_query snapshots
+        (algorithms, models, serving) under the lock and scores from
+        its snapshot; the swap is a pointer flip."""
+        with self._lock:
+            if expected_epoch != self._epoch:
+                return False
+            self.models = models
+            self._epoch += 1
+            self._foldin_epoch += 1
+            return True
+
     def status(self) -> dict[str, Any]:
         with self._lock:
             avg = (
@@ -549,6 +584,15 @@ class EngineServer:
             if "text/html" in request.headers.get("accept", ""):
                 return Response.html(server._status_html())
             return Response.json(server.status())
+
+        @router.route("GET", "/stats.json")
+        def stats(request: Request) -> Response:
+            body = server.status()
+            layer = server.speed_layer
+            body["realtime"] = (
+                layer.gauges() if layer is not None else {"enabled": False}
+            )
+            return Response.json(body)
 
         @router.route("POST", "/queries.json")
         def queries(request: Request) -> Response:
@@ -649,6 +693,8 @@ class EngineServer:
         return port
 
     def stop(self) -> None:
+        if self.speed_layer is not None:
+            self.speed_layer.stop()
         if self.batcher is not None:
             self.batcher.stop()
         self.app.stop()

@@ -29,12 +29,20 @@ class Stats:
         self._buckets: dict[int, dict[_Key, int]] = defaultdict(
             lambda: defaultdict(int)
         )
+        # per-app accepted-write sequence + last ingest wall time: the
+        # authoritative upstream numbers a realtime tailer's
+        # events_behind / seconds_behind gauges compare against
+        self._seq: dict[int, int] = defaultdict(int)
+        self._last_ingest: dict[int, float] = {}
         self.start_time = time.time()
 
     def update(self, app_id: int, status: int, event: str, entity_type: str) -> None:
         minute = int(time.time() // 60)
         with self._lock:
             self._buckets[minute][_Key(app_id, status, event, entity_type)] += 1
+            if status == 201:  # accepted write
+                self._seq[app_id] += 1
+                self._last_ingest[app_id] = time.time()
 
     def get(self, app_id: int) -> dict:
         """Aggregate counts for one app across all buckets
@@ -46,11 +54,15 @@ class Stats:
                 for key, count in bucket.items():
                     if key.app_id == app_id:
                         agg[(key.status, key.event, key.entity_type)] += count
+            seq = self._seq.get(app_id, 0)
+            last_ingest = self._last_ingest.get(app_id)
         return {
             "startTime": self.start_time,
             "statusCount": _group(agg, 0),
             "eventCount": _group(agg, 1),
             "entityTypeCount": _group(agg, 2),
+            "lastEventSeq": seq,
+            "lastIngestTime": last_ingest,
         }
 
 
