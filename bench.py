@@ -481,10 +481,45 @@ def _concurrent_qps(host: str, port: int, path: str, queries: list[dict],
     }
 
 
+def _http_floor_us(recv_buffer: bool, n: int = 2000) -> float:
+    """Per-request microseconds of the HTTP layer ALONE: keep-alive GETs
+    against a route that returns pre-encoded bytes (zero handler work),
+    one warm client connection. ``recv_buffer`` toggles the per-connection
+    recv_into reader vs the stdlib buffered rfile — the before/after of
+    the floor cut."""
+    import http.client
+
+    from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+    router = Router()
+    payload = b'{"ok":true}'
+    router.add("GET", "/ping", lambda req: Response.json_bytes(payload))
+    app = HTTPApp(router, host="127.0.0.1", port=0, recv_buffer=recv_buffer)
+    port = app.start(background=True)
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        c.connect()
+        for _ in range(100):  # warm the connection + handler thread
+            c.request("GET", "/ping")
+            c.getresponse().read()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.request("GET", "/ping")
+            c.getresponse().read()
+        dt = time.perf_counter() - t0
+        c.close()
+        return dt / n * 1e6
+    finally:
+        app.stop()
+
+
 def bench_serving(extras: dict) -> None:
     """POST /queries.json p50/p99 through a real EngineServer: dense
     top-k, RingCatalog sharded serving, and the e-commerce live-filter
-    path (reference serving bookkeeping: CreateServer.scala:582-590)."""
+    path (reference serving bookkeeping: CreateServer.scala:582-590).
+    Plus the PR-4 serving fast path: query-cache hit vs miss qps, hit
+    rate under a Zipf replay, and the raw HTTP floor before/after the
+    recv_into buffer reuse."""
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.core.workflow import run_train
     from predictionio_tpu.data.event import Event
@@ -579,6 +614,94 @@ def bench_serving(extras: dict) -> None:
         }
     finally:
         server.stop()
+
+    # -- query-result cache: the epoch-fenced serving fast path --------
+    # miss qps: cache disabled, every request runs gather->score->top-k->
+    # encode. hit qps: cache enabled, all clients repeat one hot query so
+    # steady state is pure cache hits (preserialized bytes, no device
+    # dispatch, no json encode). Same instance, same route, same clients.
+    hot = [queries[0]]
+    server = EngineServer(
+        recommendation.engine(), inst, storage=storage, host="127.0.0.1",
+        port=0,
+    )
+    port = server.start(background=True)
+    try:
+        _latency_block(f"http://127.0.0.1:{port}/queries.json", hot * 5,
+                       warmup=2)
+        miss = _concurrent_qps("127.0.0.1", port, "/queries.json", hot)
+    finally:
+        server.stop()
+    server = EngineServer(
+        recommendation.engine(), inst, storage=storage, host="127.0.0.1",
+        port=0, query_cache_mb=8,
+    )
+    port = server.start(background=True)
+    try:
+        # first request populates the cache; everything after is a hit
+        _latency_block(f"http://127.0.0.1:{port}/queries.json", hot * 5,
+                       warmup=2)
+        hit = _concurrent_qps("127.0.0.1", port, "/queries.json", hot,
+                              per_proc=300)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats.json", timeout=30
+        ) as resp:
+            gauges = json.loads(resp.read()).get("cache", {})
+        extras["serving"]["query_cache"] = {
+            "cache_hit_qps": hit["qps"],
+            "cache_miss_qps": miss["qps"],
+            "hit_qps_over_miss_qps": round(hit["qps"] / miss["qps"], 1),
+            "hit_latency": _latency_block(
+                f"http://127.0.0.1:{port}/queries.json", hot * 40, warmup=5
+            ),
+            "gauges": gauges,
+        }
+    finally:
+        server.stop()
+
+    # Zipf replay: production traffic repeats hot queries with a heavy
+    # tail; the measured hit rate under zipf(1.2) user draws is the
+    # honest "what does the cache buy" number (a uniform replay over
+    # 100k-shaped users would barely repeat within the window)
+    server = EngineServer(
+        recommendation.engine(), inst, storage=storage, host="127.0.0.1",
+        port=0, query_cache_mb=8,
+    )
+    port = server.start(background=True)
+    try:
+        url = f"http://127.0.0.1:{port}/queries.json"
+        zipf_users = (rng.zipf(1.2, 400) - 1) % num_u
+        t0 = time.perf_counter()
+        for u in zipf_users:
+            _post_json(url, {"user": f"u{u}", "num": 4})
+        zipf_s = time.perf_counter() - t0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats.json", timeout=30
+        ) as resp:
+            zg = json.loads(resp.read()).get("cache", {})
+        extras["serving"]["query_cache"]["zipf_replay"] = {
+            "queries": len(zipf_users),
+            "distinct_users": int(len(set(zipf_users.tolist()))),
+            "hit_rate_under_zipf": zg.get("cache_hit_rate"),
+            "qps": round(len(zipf_users) / zipf_s, 1),
+            "cache_entries": zg.get("cache_entries"),
+            "cache_bytes": zg.get("cache_bytes"),
+        }
+        extras["serving"]["query_cache"]["hit_rate_under_zipf"] = zg.get(
+            "cache_hit_rate"
+        )
+    finally:
+        server.stop()
+
+    # raw HTTP floor (no engine in the loop): recv_into buffer reuse +
+    # precomputed heads vs the stdlib rfile path
+    floor_buf = _http_floor_us(True)
+    floor_rfile = _http_floor_us(False)
+    extras["serving"]["http_floor_us"] = {
+        "recv_buffer": round(floor_buf, 1),
+        "rfile": round(floor_rfile, 1),
+        "delta_us": round(floor_rfile - floor_buf, 1),
+    }
 
     # RingCatalog (mesh-resident item factors; 1-chip mesh on this box)
     server = train(
@@ -1443,6 +1566,22 @@ def _compact_summary(result: dict) -> dict:
                               "import_speedup")
                     if k in st[bk]
                 }
+    sv = result.get("serving")
+    if isinstance(sv, dict) and "error" not in sv:
+        sc_out: dict = {}
+        qc = sv.get("query_cache")
+        if isinstance(qc, dict):
+            sc_out["cache"] = {
+                k: qc[k]
+                for k in ("cache_hit_qps", "cache_miss_qps",
+                          "hit_qps_over_miss_qps", "hit_rate_under_zipf")
+                if qc.get(k) is not None
+            }
+        hf = sv.get("http_floor_us")
+        if isinstance(hf, dict):
+            sc_out["http_floor_us"] = hf
+        if sc_out:
+            s["serving"] = sc_out
     rt = result.get("realtime")
     if isinstance(rt, dict) and "error" not in rt:
         s["realtime"] = {

@@ -40,6 +40,21 @@ def run_dir() -> Path:
     return d
 
 
+def service_env() -> dict[str, str]:
+    """Environment for spawned services: the operator's env plus a
+    persistent jax compilation cache default (``PIO_COMPILATION_CACHE_DIR``
+    under the run dir) so `pio start-all` restarts skip XLA recompiles —
+    the deploy warmup's compiles land on disk the first time and every
+    later bring-up reuses them. An explicit env var (even empty, to
+    disable) wins."""
+    env = dict(os.environ)
+    if "PIO_COMPILATION_CACHE_DIR" not in env:
+        cache = run_dir() / "jit_cache"
+        cache.mkdir(parents=True, exist_ok=True)
+        env["PIO_COMPILATION_CACHE_DIR"] = str(cache)
+    return env
+
+
 def _pid_file(name: str) -> Path:
     return run_dir() / f"{name}.pid"
 
@@ -98,6 +113,7 @@ def start_service(name: str, argv: list[str], host: str, port: int) -> int:
         stderr=subprocess.STDOUT,
         stdin=subprocess.DEVNULL,
         start_new_session=True,  # survives the CLI process and its tty
+        env=service_env(),
     )
     log.close()
     up = wait_port(host, port, timeout=30.0)

@@ -381,6 +381,30 @@ def _run_workers(args) -> int:
     return rc
 
 
+def _maybe_enable_compilation_cache() -> None:
+    """Wire jax's persistent compilation cache when
+    ``PIO_COMPILATION_CACHE_DIR`` is set (the daemon defaults it for
+    fleet services): deploy warmup compiles land on disk, so a restarted
+    server skips recompiles entirely — the first-query latency spike
+    dies at most once per (program, jax version) per machine."""
+    cache_dir = os.environ.get("PIO_COMPILATION_CACHE_DIR")
+    if not cache_dir:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache every program: serving top-k programs compile fast but
+        # re-compile on every restart without this
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # pragma: no cover - cache is an optimization
+        print(
+            f"persistent compilation cache unavailable ({e}); continuing",
+            file=sys.stderr,
+        )
+
+
 def cmd_deploy(args) -> int:
     from predictionio_tpu.data.storage import get_storage
     from predictionio_tpu.server.engine_server import EngineServer
@@ -388,6 +412,7 @@ def cmd_deploy(args) -> int:
     rc = _maybe_run_workers(args)
     if rc is not None:
         return rc
+    _maybe_enable_compilation_cache()
 
     engine, variant, factory = _engine_from_args(args)
     storage = get_storage()
@@ -438,7 +463,13 @@ def cmd_deploy(args) -> int:
         log_prefix=args.log_prefix,
         batch_window_ms=args.batch_window_ms,
         reuse_port=args.reuse_port,
+        query_cache_mb=args.query_cache_mb,
     )
+    # AOT warmup BEFORE the port binds: the first real query hits a
+    # compiled scoring program (and, with PIO_COMPILATION_CACHE_DIR, the
+    # compile itself persists across restarts)
+    if not getattr(args, "no_warmup", False):
+        server.warmup()
     layer = None
     if getattr(args, "realtime", 0.0) and args.realtime > 0:
         from pathlib import Path
@@ -821,6 +852,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--reuse-port", action="store_true",
         help="bind with SO_REUSEPORT (set automatically for workers; "
         "useful when an external supervisor runs the processes)",
+    )
+    d.add_argument(
+        "--query-cache-mb", type=float, default=0.0, metavar="MB",
+        help="cache preserialized query responses in this many MB, "
+        "invalidated exactly on every /reload and speed-layer patch via "
+        "the epoch fence (0 = disabled); engines opt out per query via "
+        "cacheable_query — see docs/serving.md",
+    )
+    d.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the deploy-time throwaway predict that pre-compiles "
+        "the scoring programs before the port binds",
     )
     d.add_argument(
         "--realtime", type=float, default=0.0, metavar="SECONDS",

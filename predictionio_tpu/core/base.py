@@ -117,6 +117,32 @@ class Algorithm(Component, Generic[PD, M, Q, P], abc.ABC):
         """
         return [(ix, self.predict(model, q)) for ix, q in queries]
 
+    def cacheable_query(self, query: Q) -> bool:
+        """May the engine server cache this query's response until the
+        next model swap? Default True: a pure function of (model, query)
+        is exactly invalidated by the server's epoch fence — every
+        ``/reload`` and speed-layer patch bumps the epoch and retires
+        all cached entries. Return False when the prediction reads
+        MUTABLE state outside the model (live event-store filters,
+        wall-clock time, per-request randomness): the epoch fence cannot
+        see those writes, so a cached result could go stale
+        (server/query_cache.py; docs/serving.md)."""
+        return True
+
+    def warmup_query(self, model: M) -> Q | None:
+        """A throwaway query for deploy-time jit warmup, or None to
+        skip. The engine server scores it once through
+        ``batch_predict`` before binding the port so the first real
+        query doesn't pay XLA compilation. Default: a zero-arg
+        ``query_class()`` when that constructs (engines whose defaults
+        miss the device path override with a model-derived query)."""
+        if self.query_class is None:
+            return None
+        try:
+            return self.query_class()
+        except TypeError:
+            return None
+
     def train_sweep(
         self, ctx: WorkflowContext, prepared_data: PD, params_list: Sequence[Any]
     ) -> "list[M] | None":
@@ -151,6 +177,13 @@ class Serving(Component, Generic[Q, P], abc.ABC):
 
     @abc.abstractmethod
     def serve(self, query: Q, predictions: Sequence[P]) -> P: ...
+
+    def cacheable_query(self, query: Q) -> bool:
+        """Serving-level veto on query-result caching (the Algorithm
+        hook of the same name, for combine-time state: A/B bucketing by
+        time, randomized tie-breaks). Default True — ``serve`` is
+        normally a pure join of its inputs."""
+        return True
 
 
 class FirstServing(Serving[Q, P]):

@@ -495,6 +495,15 @@ class ECommAlgorithm(Algorithm):
             cache[key] = weighted
             return weighted
 
+    def cacheable_query(self, query: Query) -> bool:
+        """Never cacheable: predictions depend on LIVE event-store state
+        the epoch fence can't see — the user's seen events, the latest
+        ``$set`` of the ``unavailableItems`` constraint entity, and
+        cold-start users' recent views all change with ingest, not with
+        model swaps. A cached result would keep recommending an item the
+        store just marked unavailable until the next retrain."""
+        return False
+
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         import jax.numpy as jnp
 

@@ -397,6 +397,14 @@ class ALSAlgorithm(Algorithm):
             )
         return out
 
+    def warmup_query(self, model: ALSModel) -> Query | None:
+        """Deploy-time jit warmup hits the REAL device path: a known
+        user (the zero-arg default would take the unseen-user early
+        return and compile nothing)."""
+        if not len(model.user_index):
+            return None
+        return Query(user=model.user_index.inverse[0], num=4)
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         from predictionio_tpu.ops.topk import top_k_items
 

@@ -85,6 +85,11 @@ class SpeedLayer:
         self.events_folded = 0
         self.users_touched = 0
         self.users_added = 0
+        # each successful patch bumps the server epoch, which retires
+        # every cached query result (server/query_cache.py) — operators
+        # watch this against cache_hit_rate: a fold interval shorter
+        # than the traffic's repeat window makes the cache useless
+        self.cache_invalidations = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         server.speed_layer = self
@@ -134,6 +139,10 @@ class SpeedLayer:
                 self._last_fold_s = time.perf_counter() - t0
                 return "skipped"  # no foldable events for any model
             if self.server.apply_patch(new_models, epoch):
+                # the epoch bump just swept the query cache (the
+                # fold-in hook mirrors /reload exactly)
+                if self.server.query_cache is not None:
+                    self.cache_invalidations += 1
                 self._last_fold_s = time.perf_counter() - t0
                 if stats is not None:
                     self.events_folded += stats.rating_events
@@ -178,6 +187,7 @@ class SpeedLayer:
             "users_added": self.users_added,
             "cold_start_items": len(self.foldin.cold_items),
             "last_fold_s": round(self._last_fold_s, 6),
+            "query_cache_invalidations": self.cache_invalidations,
         }
 
     # -- lifecycle ----------------------------------------------------------

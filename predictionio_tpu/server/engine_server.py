@@ -38,8 +38,13 @@ from typing import Any
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.workflow import prepare_deploy
 from predictionio_tpu.data.storage import EngineInstance, Storage, get_storage
+from predictionio_tpu.server import jsonx
 from predictionio_tpu.server import plugins as plugin_mod
 from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+from predictionio_tpu.server.query_cache import (
+    QueryCache,
+    canonical_query_bytes,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -245,6 +250,7 @@ class EngineServer:
         batch_window_ms: float = 0.0,
         dispatch_cost_s: float | None = None,
         reuse_port: bool = False,
+        query_cache_mb: float = 0.0,
     ):
         self.engine = engine
         self.storage = storage or get_storage()
@@ -268,6 +274,7 @@ class EngineServer:
         self._epoch = 0
         self._foldin_epoch = 0
         self.speed_layer = None  # attached by realtime.SpeedLayer
+        self.query_cache: QueryCache | None = None
         self._load(instance)
 
         self.request_count = 0
@@ -279,6 +286,31 @@ class EngineServer:
         self.plugin_context: dict[str, Any] = {"storage": self.storage}
         for p in self.plugins:
             p.start(self.plugin_context)
+
+        # query-result cache: preserialized response bytes keyed by
+        # (engine_variant, canonical_query_bytes, epoch) — the epoch
+        # fence gives EXACT invalidation (every /reload and every
+        # speed-layer patch bumps it), so a hit can never be stale with
+        # respect to the served model. Two per-request effects make
+        # caching incorrect, so they disable it outright:
+        if query_cache_mb and query_cache_mb > 0:
+            blockers = [
+                p for p in self.plugins
+                if p.plugin_type == plugin_mod.OUTPUT_BLOCKER
+            ]
+            if feedback:
+                logger.warning(
+                    "query cache disabled: --feedback generates a fresh "
+                    "prId and POSTs a predict event per request"
+                )
+            elif blockers:
+                logger.warning(
+                    "query cache disabled: output-blocker plugin(s) %s "
+                    "rewrite responses per request",
+                    [p.plugin_name for p in blockers],
+                )
+            else:
+                self.query_cache = QueryCache(int(query_cache_mb * 2**20))
 
         # micro-batched serving: amortize device dispatch across
         # concurrent requests (0 = per-request, the reference behavior;
@@ -314,9 +346,73 @@ class EngineServer:
             # patches (the new instance was trained on the full log)
             self._epoch += 1
             self._foldin_epoch = 0
+            epoch = self._epoch
+        # entries under older epochs are unreachable by key the moment
+        # the counter moves; the sweep just reclaims their bytes (done
+        # off the server lock — the cache has its own shard locks)
+        if self.query_cache is not None:
+            self.query_cache.sweep(epoch)
         logger.info("engine instance %s loaded for serving", instance.id)
 
     # -- query path --------------------------------------------------------
+    def serve_query_bytes(self, body: dict[str, Any]) -> bytes:
+        """THE /queries.json read path: preserialized response bytes.
+
+        Cache hit: one canonical-bytes build + one sharded dict lookup —
+        no device dispatch, no serving join, no JSON encode, and the
+        request never enters the micro-batch queue. Miss: the normal
+        scoring path, then the encoded bytes are stored iff every
+        Algorithm and the Serving say the query is cacheable.
+
+        Epoch fencing: the epoch is snapshotted BEFORE scoring, so a
+        model swap landing mid-flight strands the computed result under
+        the pre-swap epoch — it can never be served after the swap. (The
+        reverse order would race: old-model results could be filed under
+        the new epoch.)"""
+        cache = self.query_cache
+        key = None
+        if cache is not None:
+            with self._lock:
+                epoch = self._epoch
+                variant = self.instance.engine_variant
+            try:
+                key = (variant, canonical_query_bytes(body), epoch)
+            except (TypeError, ValueError):
+                key = None  # non-canonicalizable body: uncacheable
+            if key is not None:
+                payload = cache.get(key)
+                if payload is not None:
+                    # a hit is still a served request; it adds ~0 to
+                    # serving_seconds by construction
+                    with self._lock:
+                        self.request_count += 1
+                    return payload
+        if (
+            self.batcher is not None
+            and self.batcher.active
+            and self.batcher.engaged
+        ):
+            response_obj = self.batcher.submit(body).result(timeout=60)
+        else:
+            response_obj = self.handle_query(body)
+        payload = jsonx.dumps_bytes(response_obj)
+        if key is not None and self._query_cacheable(body):
+            cache.put(key, payload)
+        return payload
+
+    def _query_cacheable(self, body: dict[str, Any]) -> bool:
+        """Every Algorithm AND the Serving must consent (core/base.py
+        ``cacheable_query``). Runs on the miss path only."""
+        with self._lock:
+            algorithms, serving = self.algorithms, self.serving
+        try:
+            query, supplemented = self._parse_query(body, algorithms, serving)
+        except Exception:
+            return False
+        if not serving.cacheable_query(query):
+            return False
+        return all(a.cacheable_query(supplemented) for a in algorithms)
+
     def handle_query(self, body: dict[str, Any]) -> dict[str, Any]:
         t0 = time.perf_counter()
         with self._lock:
@@ -517,7 +613,13 @@ class EngineServer:
             self.models = models
             self._epoch += 1
             self._foldin_epoch += 1
-            return True
+            epoch = self._epoch
+        # fold-in patches sweep cached results exactly like /reload:
+        # the bumped epoch already makes old entries unreachable, the
+        # sweep reclaims their bytes (off the server lock)
+        if self.query_cache is not None:
+            self.query_cache.sweep(epoch)
+        return True
 
     def status(self) -> dict[str, Any]:
         with self._lock:
@@ -592,6 +694,12 @@ class EngineServer:
             body["realtime"] = (
                 layer.gauges() if layer is not None else {"enabled": False}
             )
+            cache = server.query_cache
+            body["cache"] = (
+                {"enabled": True, **cache.gauges()}
+                if cache is not None
+                else {"enabled": False}
+            )
             return Response.json(body)
 
         @router.route("POST", "/queries.json")
@@ -600,15 +708,7 @@ class EngineServer:
             if not isinstance(body, dict):
                 return Response.error("request body must be a JSON object", 400)
             try:
-                if (
-                    server.batcher is not None
-                    and server.batcher.active
-                    and server.batcher.engaged
-                ):
-                    response_obj = server.batcher.submit(body).result(timeout=60)
-                else:
-                    response_obj = server.handle_query(body)
-                return Response.json(response_obj)
+                return Response.json_bytes(server.serve_query_bytes(body))
             except (TypeError, KeyError, ValueError) as e:
                 # reference: MappingException -> 400 + remote log
                 # (CreateServer.scala:596-604)
@@ -687,6 +787,36 @@ class EngineServer:
         return request.query.get("accessKey") == self.server_key
 
     # -- lifecycle ---------------------------------------------------------
+    def warmup(self) -> int:
+        """Deploy-time AOT warmup: one throwaway ``batch_predict`` per
+        algorithm BEFORE the port binds, so the first real query pays a
+        scoring-program cache hit instead of an XLA compile (seconds on
+        CPU, tens of seconds on TPU attachments). Queries come from each
+        algorithm's ``warmup_query`` hook; failures are logged and
+        swallowed — warmup must never block a deploy. Returns how many
+        algorithms were warmed."""
+        with self._lock:
+            algorithms, models = self.algorithms, self.models
+        warmed = 0
+        for a, m in zip(algorithms, models):
+            try:
+                q = a.warmup_query(m)
+                if q is None:
+                    continue
+                t0 = time.perf_counter()
+                a.batch_predict(m, [(0, q)])
+                logger.info(
+                    "warmup: %s compiled+scored in %.3fs",
+                    type(a).__name__, time.perf_counter() - t0,
+                )
+                warmed += 1
+            except Exception:
+                logger.exception(
+                    "warmup predict failed for %s (serving unaffected)",
+                    type(a).__name__,
+                )
+        return warmed
+
     def start(self, background: bool = True) -> int:
         port = self.app.start(background=background)
         logger.info("Engine Server listening on %s:%d", self.host, port)

@@ -17,6 +17,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.server import jsonx
+
 logger = logging.getLogger(__name__)
 
 
@@ -32,7 +34,9 @@ class Request:
     def json(self) -> Any:
         if not self.body:
             return None
-        return json.loads(self.body.decode("utf-8"))
+        # orjson when available (event-server ingest parses one body per
+        # request on the hot path), stdlib fallback — server/jsonx.py
+        return jsonx.loads(self.body)
 
     def form(self) -> dict[str, str]:
         parsed = parse_qs(self.body.decode("utf-8"), keep_blank_values=True)
@@ -58,7 +62,10 @@ class Request:
 @dataclass
 class Response:
     status: int = 200
-    body: Any = None  # JSON-serializable, or (content_type, bytes)
+    # JSON-serializable object; or (content_type, bytes); or raw bytes
+    # already JSON-encoded (sent verbatim — the query-cache hit path and
+    # any other preserialized producer skip the re-encode)
+    body: Any = None
     headers: dict[str, str] = field(default_factory=dict)
     # invoked after the response bytes are written — lets a /stop route
     # shut the server down without racing its own response flush
@@ -67,6 +74,11 @@ class Response:
     @staticmethod
     def json(obj: Any, status: int = 200) -> "Response":
         return Response(status=status, body=obj)
+
+    @staticmethod
+    def json_bytes(payload: bytes, status: int = 200) -> "Response":
+        """Pre-encoded JSON sent as-is (no dumps on the send path)."""
+        return Response(status=status, body=payload)
 
     @staticmethod
     def error(message: str, status: int) -> "Response":
@@ -78,6 +90,43 @@ class Response:
 
 
 Handler = Callable[[Request], Response]
+
+
+_JSON_CT = "application/json; charset=utf-8"
+
+# (status, phrase) -> full response bytes for header-only error replies,
+# and (status, content_type) -> static head prefix up to "Content-Length: ".
+# Built lazily ONCE per distinct shape instead of f-string-assembled per
+# request — the measured per-request floor is dominated by exactly this
+# kind of per-call byte construction.
+_SIMPLE_CACHE: dict[tuple[int, str], bytes] = {}
+_HEAD_CACHE: dict[tuple[int, str], bytes] = {}
+
+
+def _simple_bytes(status: int, phrase: str) -> bytes:
+    key = (status, phrase)
+    payload = _SIMPLE_CACHE.get(key)
+    if payload is None:
+        payload = (
+            f"HTTP/1.1 {status} {phrase}\r\n"
+            "Content-Length: 0\r\nConnection: close\r\n\r\n"
+        ).encode("latin-1")
+        _SIMPLE_CACHE[key] = payload
+    return payload
+
+
+def _static_head(status: int, content_type: str) -> bytes:
+    """Everything before the Content-Length VALUE, precomputed."""
+    key = (status, content_type)
+    head = _HEAD_CACHE.get(key)
+    if head is None:
+        phrase = _RESPONSES.get(status, "")
+        head = (
+            f"HTTP/1.1 {status} {phrase}\r\n"
+            f"Content-Type: {content_type}\r\nContent-Length: "
+        ).encode("latin-1")
+        _HEAD_CACHE[key] = head
+    return head
 
 
 _CORS_ALLOW_HEADERS = (
@@ -150,6 +199,90 @@ class Router:
         return Response.error("not found", 404)
 
 
+class _ConnReader:
+    """Per-connection request reader over ONE reusable ``recv_into``
+    buffer.
+
+    The stdlib path (``socket.makefile`` -> BufferedReader) allocates a
+    fresh 64 KiB buffer per connection and crosses the C/Python boundary
+    once per ``readline`` — ~8 crossings per request (request line + 5-7
+    headers). A keep-alive request usually lands in ONE TCP segment, so
+    one ``recv_into`` into a reused bytearray followed by C-speed
+    ``find(b"\\n")`` scans serves the whole request with a single
+    syscall and zero per-request buffer allocations (only the returned
+    line/body bytes are materialized). Interface matches what
+    ``handle_one_request`` used from ``rfile``: ``readline(limit)``
+    (up to ``limit`` bytes, newline-terminated unless truncated/EOF) and
+    ``read(n)`` (short only at EOF). Works unchanged over TLS —
+    ``SSLSocket.recv_into`` drives the lazy server-side handshake the
+    accept path deferred."""
+
+    __slots__ = ("_sock", "_buf", "_start", "_end")
+
+    def __init__(self, sock, bufsize: int = 65536):
+        self._sock = sock
+        self._buf = bytearray(bufsize)
+        self._start = 0
+        self._end = 0
+
+    def _fill(self) -> bool:
+        """recv more bytes; False on EOF. Compacts before recv when the
+        tail of the buffer is exhausted."""
+        buf = self._buf
+        if self._start == self._end:
+            self._start = self._end = 0
+        elif self._end == len(buf):
+            n = self._end - self._start
+            buf[:n] = buf[self._start:self._end]
+            self._start, self._end = 0, n
+        with memoryview(buf) as mv:
+            got = self._sock.recv_into(mv[self._end:])
+        if got == 0:
+            return False
+        self._end += got
+        return True
+
+    def readline(self, limit: int) -> bytes:
+        """Up to ``limit`` bytes ending at the first ``\\n``; exactly
+        ``limit`` bytes when no newline fits (caller rejects oversized
+        lines); whatever remains at EOF (b"" when nothing)."""
+        while True:
+            i = self._buf.find(b"\n", self._start, self._end)
+            if i >= 0 and i - self._start < limit:
+                line = bytes(self._buf[self._start:i + 1])
+                self._start = i + 1
+                return line
+            if self._end - self._start >= limit:
+                line = bytes(self._buf[self._start:self._start + limit])
+                self._start += limit
+                return line
+            if not self._fill():
+                line = bytes(self._buf[self._start:self._end])
+                self._start = self._end
+                return line
+
+    def read(self, n: int) -> bytes:
+        """Exactly ``n`` body bytes (fewer only at EOF). Whatever the
+        header recv over-read is consumed from the buffer; any remainder
+        recv_into's DIRECTLY into the result — no double buffering."""
+        have = min(n, self._end - self._start)
+        if have == n:
+            body = bytes(self._buf[self._start:self._start + n])
+            self._start += n
+            return body
+        out = bytearray(n)
+        out[:have] = self._buf[self._start:self._start + have]
+        self._start += have
+        filled = have
+        with memoryview(out) as mv:
+            while filled < n:
+                got = self._sock.recv_into(mv[filled:])
+                if got == 0:
+                    return bytes(out[:filled])
+                filled += got
+        return bytes(out)
+
+
 class HTTPApp:
     """A router bound to a ThreadingHTTPServer with start/stop lifecycle."""
 
@@ -161,6 +294,7 @@ class HTTPApp:
         ssl_context=None,
         reuse_port: bool = False,
         read_timeout: float = 120.0,
+        recv_buffer: bool = True,
     ):
         self.router = router
         self.host = host
@@ -176,6 +310,10 @@ class HTTPApp:
         # kernel load-balances accepts — the multi-process scale-out
         # path (`--workers`) past the single-interpreter GIL
         self.reuse_port = reuse_port
+        # False falls back to the stdlib rfile (BufferedReader) request
+        # parse — kept for the bench's before/after http_floor_us
+        # comparison and as an escape hatch
+        self.recv_buffer = recv_buffer
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -192,6 +330,10 @@ class HTTPApp:
             # socket — plain TCP gets the same slow-client bound the TLS
             # accept path sets below
             timeout = self.read_timeout
+
+            # per-connection request reader, created on first request
+            # (one reusable recv_into buffer for the connection's life)
+            _reader = None
 
             def log_message(self, fmt, *args):  # route to logging, not stderr
                 if logger.isEnabledFor(logging.DEBUG):
@@ -212,8 +354,17 @@ class HTTPApp:
                 request bodies (the reference's spray server also
                 buffers full entities)."""
                 self.close_connection = True
+                reader = self._reader
+                if reader is None:
+                    # the stdlib rfile exposes the same readline/read
+                    # shape — it IS the fallback reader
+                    reader = self._reader = (
+                        _ConnReader(self.connection)
+                        if app.recv_buffer
+                        else self.rfile
+                    )
                 try:
-                    line = self.rfile.readline(65537)
+                    line = reader.readline(65537)
                 except OSError:
                     return
                 if not line:
@@ -247,7 +398,7 @@ class HTTPApp:
                 n_lines = 0
                 while True:
                     try:
-                        h = self.rfile.readline(65537)
+                        h = reader.readline(65537)
                     except OSError:  # read timeout / client reset
                         return
                     if h in (b"\r\n", b"\n", b""):
@@ -290,7 +441,7 @@ class HTTPApp:
                     self._send_simple(400, "Bad Request")
                     return
                 try:
-                    body = self.rfile.read(length) if length > 0 else b""
+                    body = reader.read(length) if length > 0 else b""
                 except OSError:  # read timeout mid-body
                     return
                 if length > 0 and len(body) < length:
@@ -322,12 +473,9 @@ class HTTPApp:
                 self._send(response)
 
             def _send_simple(self, status: int, phrase: str) -> None:
-                self.wfile.write(
-                    (
-                        f"HTTP/1.1 {status} {phrase}\r\n"
-                        "Content-Length: 0\r\nConnection: close\r\n\r\n"
-                    ).encode("latin-1")
-                )
+                # cached constant bytes — parse-reject paths pay one
+                # dict lookup, not per-request string assembly
+                self.wfile.write(_simple_bytes(status, phrase))
                 self.close_connection = True
 
             def _head(self, response: Response, content_type: str,
@@ -365,20 +513,30 @@ class HTTPApp:
                             target=response.after_send, daemon=True
                         ).start()
                     return
-                if isinstance(response.body, tuple):
+                if isinstance(response.body, (bytes, bytearray)):
+                    # pre-encoded JSON (query-cache hits and any other
+                    # preserialized producer): sent verbatim, no dumps
+                    content_type, payload = _JSON_CT, response.body
+                elif isinstance(response.body, tuple):
                     content_type, payload = response.body
                 else:
-                    content_type = "application/json; charset=utf-8"
-                    payload = json.dumps(
+                    content_type = _JSON_CT
+                    payload = jsonx.dumps_bytes(
                         response.body if response.body is not None else {}
-                    ).encode("utf-8")
-                self.wfile.write(
-                    self._head(
+                    )
+                if response.headers:
+                    head = self._head(
                         response, content_type,
                         f"Content-Length: {len(payload)}\r\n",
                     )
-                    + payload
-                )
+                else:
+                    # common case: no custom headers — static prefix +
+                    # the length digits, zero per-request f-strings
+                    head = (
+                        _static_head(response.status, content_type)
+                        + b"%d\r\n\r\n" % len(payload)
+                    )
+                self.wfile.write(head + payload)
                 self.wfile.flush()
                 if response.after_send is not None:
                     threading.Thread(

@@ -724,3 +724,157 @@ def test_event_server_stats_expose_seq_and_ingest_time(storage):
         assert body["lastEventSeq"] == 3
     finally:
         es.stop()
+
+
+# ---------------------------------------------------------------------------
+# PR 4: the query cache under the epoch fence — swap races must never
+# serve a pre-swap cached result
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def cached_deployed(deployed):
+    """A second server over the trained instance with the query cache
+    enabled (the `deployed` server stays untouched for other tests)."""
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    server = EngineServer(
+        deployed["engine"], deployed["server"].instance,
+        storage=deployed["storage"], host="127.0.0.1", port=0,
+        server_key="secret", query_cache_mb=4,
+    )
+    port = server.start()
+    yield {**deployed, "base": f"http://127.0.0.1:{port}", "server": server}
+    server.stop()
+
+
+class TestQueryCacheEpochFence:
+    def _block_predict(self, server):
+        """Gate the algorithm's predict on an event so a query can
+        be held in flight while the model swaps under it."""
+        import threading
+
+        algo = server.algorithms[0]
+        orig = algo.predict
+        started, release = threading.Event(), threading.Event()
+
+        def blocking(*a, **k):
+            started.set()
+            assert release.wait(timeout=30), "test never released the gate"
+            return orig(*a, **k)
+
+        algo.predict = blocking
+        return started, release, orig
+
+    def test_foldin_racing_inflight_query_never_caches_stale(
+        self, cached_deployed
+    ):
+        """THE race the epoch fence exists for: a query snapshots the
+        model, a fold-in patch swaps it mid-compute, the query finishes
+        with pre-swap factors. Its result lands under the PRE-swap epoch
+        key — unreachable — so the next identical query recomputes
+        against the patched model and serves different bytes."""
+        import dataclasses
+        import threading
+
+        from predictionio_tpu.server import jsonx
+        from tests.test_servers import _raw_post
+
+        server = cached_deployed["server"]
+        url = cached_deployed["base"] + "/queries.json"
+        q = {"user": "u1", "num": 3}
+        started, release, orig = self._block_predict(server)
+
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.update(b=_raw_post(url, q))
+        )
+        t.start()
+        assert started.wait(timeout=30)
+        # the fold-in lands while the query is mid-compute: negated user
+        # factors flip every score, so pre- and post-swap bytes differ
+        _, models, epoch = server.model_snapshot()
+        flipped = [
+            dataclasses.replace(m, user_factors=-m.user_factors)
+            for m in models
+        ]
+        assert server.apply_patch(flipped, epoch) is True
+        release.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        stale = result["b"]
+
+        server.algorithms[0].predict = orig
+        fresh = _raw_post(url, q)
+        assert fresh != stale  # post-swap model answers, not the cache
+        assert fresh == jsonx.dumps_bytes(server.handle_query(q))
+        # and the fresh bytes ARE now cached under the post-swap epoch
+        hits_before = server.query_cache.gauges()["cache_hits"]
+        assert _raw_post(url, q) == fresh
+        assert server.query_cache.gauges()["cache_hits"] == hits_before + 1
+
+    def test_reload_racing_inflight_query_never_caches_stale(
+        self, cached_deployed
+    ):
+        """Same race via /reload: the in-flight result is stranded under
+        the pre-reload epoch, the follow-up query recomputes on the
+        reloaded instance's algorithm (a retrain on identical data is
+        bit-identical, so the proof is the recompute, not the bytes)."""
+        import threading
+
+        from predictionio_tpu.server.query_cache import canonical_query_bytes
+        from tests.test_servers import _raw_post
+
+        server = cached_deployed["server"]
+        url = cached_deployed["base"] + "/queries.json"
+        q = {"user": "u1", "num": 3}
+        started, release, _ = self._block_predict(server)
+
+        t = threading.Thread(target=lambda: _raw_post(url, q))
+        t.start()
+        assert started.wait(timeout=30)
+        run_train(
+            cached_deployed["engine"], cached_deployed["ep"], engine_id="rt",
+            storage=cached_deployed["storage"],
+        )
+        status, _ = http(
+            "POST", cached_deployed["base"] + "/reload?accessKey=secret"
+        )
+        assert status == 200
+        release.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+        # the stale result is NOT reachable under the served epoch
+        with server._lock:
+            epoch = server._epoch
+            variant = server.instance.engine_variant
+        key = (variant, canonical_query_bytes(q), epoch)
+        assert server.query_cache.get(key) is None
+        # the follow-up query recomputes on the post-reload algorithm
+        calls = []
+        algo = server.algorithms[0]
+        orig2 = algo.predict
+        algo.predict = lambda *a, **k: (
+            calls.append(1),  # noqa: B023 - count then delegate
+            orig2(*a, **k),
+        )[1]
+        _raw_post(url, q)
+        assert len(calls) == 1
+
+    def test_speed_layer_counts_cache_invalidations(self, cached_deployed):
+        """A patched step() on a cache-enabled server bumps the
+        query_cache_invalidations gauge on /stats.json."""
+        from predictionio_tpu.realtime.speed_layer import SpeedLayer
+
+        server = cached_deployed["server"]
+        layer = SpeedLayer(server, interval=60.0)
+        # ingest a foldable rating into the deployed app, then step
+        storage = cached_deployed["storage"]
+        events = storage.get_events()
+        events.insert(_rate("u1", "i2", 5.0), cached_deployed["app_id"])
+        assert layer.step() == "patched"
+        assert layer.gauges()["query_cache_invalidations"] == 1
+        status, body = http("GET", cached_deployed["base"] + "/stats.json")
+        assert status == 200
+        assert body["realtime"]["query_cache_invalidations"] == 1

@@ -1135,3 +1135,334 @@ class TestWorkerProcesses:
         events = storage.get_events().find(info["id"], limit=None)
         assert len(events) == 60
         assert len({e.event_id for e in events}) == 60
+
+
+# ---------------------------------------------------------------------------
+# PR 4: serving fast path — jsonx parity, query cache, HTTP floor pieces
+# ---------------------------------------------------------------------------
+
+
+def _raw_post(url: str, payload: dict) -> bytes:
+    """POST and return the raw response BYTES (the cache stores and
+    serves preserialized bytes; byte equality is the contract)."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.read()
+
+
+class TestJsonxByteParity:
+    """jsonx must be wire-compatible across backends: the stdlib
+    fallback is pinned to orjson's format (compact separators, raw
+    utf-8), so cached bytes and parsed payloads are byte-identical no
+    matter which backend the box has."""
+
+    CASES = [
+        {"itemScores": [{"item": "i1", "score": 1.5},
+                        {"item": "ü", "score": -0.25}]},
+        {"a": [1, 2.5, None, True, False, "snow☃"],
+         "b": {"nested": {"k": []}}},
+        [],
+        {},
+        {"unicode": "héllo wörld 中文"},
+        {"big": 2**53 - 1, "neg": -0.0001},
+    ]
+
+    def test_dumps_matches_compact_stdlib(self):
+        from predictionio_tpu.server import jsonx
+
+        for obj in self.CASES:
+            expected = json.dumps(
+                obj, separators=(",", ":"), ensure_ascii=False
+            ).encode("utf-8")
+            assert jsonx.dumps_bytes(obj) == expected, obj
+
+    def test_loads_round_trip(self):
+        from predictionio_tpu.server import jsonx
+
+        for obj in self.CASES:
+            assert jsonx.loads(jsonx.dumps_bytes(obj)) == obj
+
+    def test_loads_raises_stdlib_decode_error(self):
+        """Dispatch's `except json.JSONDecodeError` must keep catching
+        parse failures whichever backend is active."""
+        from predictionio_tpu.server import jsonx
+
+        with pytest.raises(json.JSONDecodeError):
+            jsonx.loads(b"{not json")
+
+
+class TestQueryCacheUnit:
+    def _cache(self, capacity=64 * 1024, shards=1):
+        from predictionio_tpu.server.query_cache import QueryCache
+
+        return QueryCache(capacity, shards=shards)
+
+    def _key(self, i, epoch=0):
+        from predictionio_tpu.server.query_cache import canonical_query_bytes
+
+        return ("default", canonical_query_bytes({"user": f"u{i}"}), epoch)
+
+    def test_canonical_bytes_key_order_insensitive(self):
+        from predictionio_tpu.server.query_cache import canonical_query_bytes
+
+        a = canonical_query_bytes({"user": "u1", "num": 3})
+        b = canonical_query_bytes({"num": 3, "user": "u1"})
+        assert a == b
+
+    def test_put_get_counters(self):
+        cache = self._cache()
+        k = self._key(1)
+        assert cache.get(k) is None
+        cache.put(k, b'{"ok":1}')
+        assert cache.get(k) == b'{"ok":1}'
+        g = cache.gauges()
+        assert g["cache_hits"] == 1 and g["cache_misses"] == 1
+        assert g["cache_entries"] == 1
+        assert g["cache_hit_rate"] == 0.5
+        assert g["cache_bytes"] > len(b'{"ok":1}')  # payload + key + overhead
+
+    def test_eviction_under_pressure(self):
+        """Byte cap enforced per shard: filling far past capacity evicts
+        LRU entries, keeps bytes under the cap, and counts evictions."""
+        cache = self._cache(capacity=8 * 1024, shards=1)
+        payload = b"x" * 512
+        for i in range(50):
+            cache.put(self._key(i), payload)
+        g = cache.gauges()
+        assert g["cache_bytes"] <= 8 * 1024
+        assert 0 < g["cache_entries"] < 50
+        assert g["cache_evictions"] == 50 - g["cache_entries"]
+        assert cache.get(self._key(0)) is None  # oldest evicted
+        assert cache.get(self._key(49)) == payload  # newest retained
+
+    def test_get_refreshes_lru_order(self):
+        cache = self._cache(capacity=8 * 1024, shards=1)
+        payload = b"x" * 512
+        cache.put(self._key(0), payload)
+        for i in range(1, 11):
+            cache.put(self._key(i), payload)
+            cache.get(self._key(0))  # keep key 0 hot
+        assert cache.get(self._key(0)) == payload
+
+    def test_oversized_payload_skipped(self):
+        cache = self._cache(capacity=4 * 1024, shards=1)
+        cache.put(self._key(1), b"y" * 8 * 1024)  # larger than the shard
+        assert cache.gauges()["cache_entries"] == 0
+
+    def test_sweep_drops_stale_epochs(self):
+        cache = self._cache()
+        for i, epoch in enumerate((0, 0, 1, 2)):
+            cache.put(self._key(i, epoch=epoch), b"z")
+        dropped = cache.sweep(2)
+        assert dropped == 3
+        g = cache.gauges()
+        assert g["cache_entries"] == 1
+        assert cache.get(self._key(3, epoch=2)) == b"z"
+
+
+@pytest.fixture()
+def cached_engine(deployed_engine):
+    """A second EngineServer over the already-trained instance with the
+    query-result cache enabled (no retrain; construction is cheap)."""
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    d = deployed_engine
+    server = EngineServer(
+        d["engine"], d["server"].instance, storage=d["storage"],
+        host="127.0.0.1", port=0, server_key="secret", query_cache_mb=4,
+    )
+    port = server.start()
+    yield {
+        "base": f"http://127.0.0.1:{port}",
+        "server": server,
+        "storage": d["storage"],
+        "engine": d["engine"],
+        "ep": d["ep"],
+    }
+    server.stop()
+
+
+class TestQueryCacheServing:
+    def _count_predict(self, server):
+        """Wrap the deployed algorithm's predict with a call
+        counter (the device-dispatch skip is the point of a hit)."""
+        algo = server.algorithms[0]
+        calls = []
+        orig = algo.predict
+
+        def counting(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        algo.predict = counting
+        return calls
+
+    def test_hit_serves_identical_bytes_without_recompute(self, cached_engine):
+        server = cached_engine["server"]
+        url = cached_engine["base"] + "/queries.json"
+        calls = self._count_predict(server)
+        b1 = _raw_post(url, {"user": "u1", "num": 3})
+        b2 = _raw_post(url, {"user": "u1", "num": 3})
+        assert b1 == b2
+        assert len(calls) == 1  # second request never touched the model
+        g = server.query_cache.gauges()
+        assert g["cache_hits"] == 1 and g["cache_entries"] == 1
+        # the canonical key ignores body key order: still a hit
+        b3 = _raw_post(url, {"num": 3, "user": "u1"})
+        assert b3 == b1 and len(calls) == 1
+
+    def test_hits_count_in_request_count(self, cached_engine):
+        url = cached_engine["base"] + "/queries.json"
+        _raw_post(url, {"user": "u1", "num": 3})
+        _raw_post(url, {"user": "u1", "num": 3})
+        status, page = http("GET", cached_engine["base"] + "/")
+        assert status == 200 and page["requestCount"] == 2
+
+    def test_stats_route_exposes_cache_gauges(self, cached_engine):
+        url = cached_engine["base"] + "/queries.json"
+        _raw_post(url, {"user": "u1", "num": 3})
+        _raw_post(url, {"user": "u1", "num": 3})
+        status, body = http("GET", cached_engine["base"] + "/stats.json")
+        assert status == 200
+        cache = body["cache"]
+        assert cache["enabled"] is True
+        assert cache["cache_hits"] == 1 and cache["cache_misses"] == 1
+        assert cache["cache_hit_rate"] == 0.5
+        assert cache["cache_entries"] == 1 and cache["cache_bytes"] > 0
+
+    def test_stats_route_reports_disabled_without_cache(self, deployed_engine):
+        status, body = http("GET", deployed_engine["base"] + "/stats.json")
+        assert status == 200
+        assert body["cache"] == {"enabled": False}
+
+    def test_reload_invalidates(self, cached_engine):
+        from predictionio_tpu.core.workflow import run_train
+
+        server = cached_engine["server"]
+        url = cached_engine["base"] + "/queries.json"
+        calls = self._count_predict(server)
+        _raw_post(url, {"user": "u1", "num": 3})
+        assert len(calls) == 1
+        run_train(
+            cached_engine["engine"], cached_engine["ep"], engine_id="serve",
+            storage=cached_engine["storage"],
+        )
+        status, _ = http(
+            "POST", cached_engine["base"] + "/reload?accessKey=secret"
+        )
+        assert status == 200
+        # the reload re-wraps algorithms; recount on the fresh object
+        calls2 = self._count_predict(server)
+        _raw_post(url, {"user": "u1", "num": 3})
+        assert len(calls2) == 1  # recomputed: pre-reload entry swept
+        assert server.query_cache.gauges()["cache_entries"] == 1
+
+    def test_cacheable_false_bypasses_cache(self, cached_engine):
+        server = cached_engine["server"]
+        url = cached_engine["base"] + "/queries.json"
+        server.algorithms[0].cacheable_query = lambda q: False
+        calls = self._count_predict(server)
+        b1 = _raw_post(url, {"user": "u1", "num": 3})
+        b2 = _raw_post(url, {"user": "u1", "num": 3})
+        assert b1 == b2
+        assert len(calls) == 2  # both recomputed
+        assert server.query_cache.gauges()["cache_entries"] == 0
+
+    def test_ecommerce_algorithm_opts_out(self):
+        """The live-filter engine (per-query event-store reads the epoch
+        fence can't see) must refuse caching by contract."""
+        from predictionio_tpu.models import ecommerce
+
+        algo = ecommerce.ECommAlgorithm(
+            ecommerce.ECommAlgorithmParams(app_name="x")
+        )
+        assert algo.cacheable_query(ecommerce.Query(user="u1")) is False
+
+    def test_recommendation_algorithm_default_cacheable(self):
+        from predictionio_tpu.models import recommendation as rec
+
+        algo = rec.ALSAlgorithm(rec.ALSAlgorithmParams())
+        assert algo.cacheable_query(rec.Query(user="u1")) is True
+
+    def test_warmup_compiles_per_algorithm(self, deployed_engine):
+        assert deployed_engine["server"].warmup() == 1
+
+
+class TestHTTPFastPathPieces:
+    def test_preencoded_bytes_sent_verbatim(self):
+        """Response.json_bytes: the body bytes go out untouched — the
+        no-re-encode contract the cache hit path relies on."""
+        from predictionio_tpu.server import jsonx
+        from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+        payload = jsonx.dumps_bytes({"x": [1, 2, 3], "s": "é"})
+        router = Router()
+        router.add("GET", "/pre", lambda req: Response.json_bytes(payload))
+        app = HTTPApp(router, host="127.0.0.1", port=0)
+        port = app.start(background=True)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/pre", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "application/json"
+                )
+                assert resp.read() == payload
+        finally:
+            app.stop()
+
+    def test_rfile_fallback_serves_keep_alive(self):
+        """recv_buffer=False pins the stdlib rfile reader (the bench's
+        http-floor 'before'); framing and keep-alive must be identical."""
+        import http.client
+
+        from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+        router = Router()
+        router.add(
+            "POST", "/echo",
+            lambda req: Response.json({"n": len(req.body)}),
+        )
+        app = HTTPApp(router, host="127.0.0.1", port=0, recv_buffer=False)
+        port = app.start(background=True)
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            for i in range(3):  # same connection: keep-alive holds
+                c.request(
+                    "POST", "/echo", body=b"x" * (i + 1),
+                    headers={"Content-Type": "application/json"},
+                )
+                r = c.getresponse()
+                assert r.status == 200
+                assert json.loads(r.read()) == {"n": i + 1}
+            c.close()
+        finally:
+            app.stop()
+
+    def test_conn_reader_matches_rfile_semantics(self):
+        """_ConnReader.readline(limit)/read(n) must mirror the buffered
+        rfile exactly — it IS the drop-in for the request parser."""
+        import socket
+
+        from predictionio_tpu.server.http import _ConnReader
+
+        a, b = socket.socketpair()
+        try:
+            reader = _ConnReader(a)
+            b.sendall(b"hello\nworld")
+            assert reader.readline(100) == b"hello\n"
+            assert reader.read(5) == b"world"
+            # a line longer than limit comes back as exactly limit bytes
+            b.sendall(b"abcdefgh")
+            b.close()
+            assert reader.readline(4) == b"abcd"
+            assert reader.readline(100) == b"efgh"  # EOF: remainder
+            assert reader.readline(100) == b""
+            assert reader.read(3) == b""
+        finally:
+            a.close()
