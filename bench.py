@@ -65,6 +65,7 @@ RUN_SERVING = os.environ.get("BENCH_SERVING", "1") == "1"
 RUN_INGEST = os.environ.get("BENCH_INGEST", "1") == "1"
 RUN_SCALING = os.environ.get("BENCH_SCALING", "1") == "1"
 RUN_REALTIME = os.environ.get("BENCH_REALTIME", "1") == "1"
+RUN_EVAL = os.environ.get("BENCH_EVAL", "1") == "1"
 E2E_EVENTS = int(os.environ.get("BENCH_E2E_EVENTS", "20000000"))
 # high-rank MFU sweep at the 20m scale (comma list; empty disables)
 RANK_SWEEP = [
@@ -1500,6 +1501,147 @@ def bench_realtime(
     }
 
 
+def bench_eval(
+    extras: dict,
+    n_users: int = 3000,
+    n_items: int = 800,
+    n_events: int = 60_000,
+    n_candidates: int = 8,
+    eval_queries: int = 5000,
+    k: int = 10,
+) -> None:
+    """Evaluation-sweep throughput: device-resident fast path vs the
+    per-query Python path over the same prewarmed sweep.
+
+    Both comparators share the FastEvalEngineWorkflow prefix caches and
+    a vmapped `train_sweep` prewarm, so training cost is excluded from
+    both sides — the measured interval is exactly the predict+metric
+    stage the fast path replaces (one batched top-k + the vectorized
+    ranking kernel vs Q Python predictions + per-query set membership).
+    Parity between the two paths is asserted at atol 1e-6.
+    """
+    from predictionio_tpu.core import (
+        DataSource,
+        Engine,
+        FirstServing,
+        WorkflowContext,
+    )
+    from predictionio_tpu.core.fast_eval import FastEvalEngineWorkflow
+    from predictionio_tpu.core.ranking import MAPAtK, NDCGAtK, PrecisionAtK
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithm,
+        Query,
+        RecommendationPreparator,
+        TrainingData,
+    )
+
+    rng = np.random.default_rng(SEED)
+    rows = rng.integers(0, n_users, n_events).astype(np.int32)
+    cols = rng.integers(0, n_items, n_events).astype(np.int32)
+    vals = rng.uniform(1.0, 5.0, n_events).astype(np.float32)
+    td = TrainingData(
+        user_ids=[f"u{i}" for i in range(n_users)],
+        item_ids=[f"i{i}" for i in range(n_items)],
+        rows=rows,
+        cols=cols,
+        ratings=vals,
+    )
+    qa = []
+    for qi in range(eval_queries):
+        # a sprinkle of unknown users and empty actual sets keeps both
+        # paths honest about the edge semantics they must share
+        user = f"u{int(rng.integers(0, n_users + n_users // 50))}"
+        n_act = int(rng.integers(0, 4)) if qi % 37 else 0
+        acts = {
+            f"i{int(j)}"
+            for j in rng.choice(n_items, size=n_act, replace=False)
+        }
+        qa.append((Query(user=user, num=k), acts))
+
+    class _EvalBenchDataSource(DataSource):
+        def read_training(self, ctx):
+            return td
+
+        def read_eval(self, ctx):
+            return [(td, {"fold": 0}, qa)]
+
+    engine = Engine(
+        datasource_classes=_EvalBenchDataSource,
+        preparator_classes=RecommendationPreparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_classes=FirstServing,
+    )
+    # a lambda sweep at fixed rank: exactly the shape train_sweep vmaps
+    candidates = [
+        engine.params_from_variant({
+            "id": "bench-eval",
+            "engineFactory": "bench",
+            "algorithms": [{
+                "name": "als",
+                "params": {
+                    "rank": 16,
+                    "lambda": 0.01 * (ci + 1),
+                    "num_iterations": 3,
+                },
+            }],
+        })
+        for ci in range(n_candidates)
+    ]
+    ctx = WorkflowContext(mode="Evaluation", batch="bench-eval")
+    metrics = [PrecisionAtK(k), MAPAtK(k), NDCGAtK(k)]
+
+    # warm every jitted program at the exact eval shapes (top-k at both
+    # paths' k buckets, the ranking-metrics kernel) so the timed
+    # intervals compare steady-state throughput, not one-time XLA
+    # compiles — both paths' programs persist in the process jit cache
+    warm = FastEvalEngineWorkflow(engine, ctx)
+    assert warm.eval_device(candidates[0], metrics) is not None
+    for m in metrics:
+        m.calculate(warm.eval(candidates[0]))
+
+    def run(mode: str):
+        workflow = FastEvalEngineWorkflow(engine, ctx)
+        t0 = time.perf_counter()
+        workflow.prewarm_sweeps(candidates)
+        train_s = time.perf_counter() - t0
+        out = []
+        t0 = time.perf_counter()
+        for ep in candidates:
+            if mode == "batched":
+                vals_ = workflow.eval_device(ep, metrics)
+                assert vals_ is not None, "fast path unexpectedly fell back"
+            else:
+                data = workflow.eval(ep)
+                vals_ = [m.calculate(data) for m in metrics]
+            out.append(vals_)
+        return out, time.perf_counter() - t0, train_s
+
+    serial_scores, serial_s, _serial_train_s = run("serial")
+    batched_scores, batched_s, batched_train_s = run("batched")
+    parity = max(
+        abs(a - b)
+        for sa, sb in zip(serial_scores, batched_scores)
+        for a, b in zip(sa, sb)
+    )
+    assert parity <= 1e-6, f"fast/serial metric divergence: {parity}"
+
+    extras["eval"] = {
+        "eval_queries": eval_queries,
+        "candidates": n_candidates,
+        "k": k,
+        "model_shape": f"{n_users}x{n_items} rank 16, {n_events} events",
+        "train_sweep_s": round(batched_train_s, 3),
+        "serial_s": round(serial_s, 3),
+        "batched_s": round(batched_s, 3),
+        "batched_vs_serial_speedup": round(serial_s / batched_s, 2),
+        "eval_queries_per_s": round(
+            n_candidates * eval_queries / batched_s
+        ),
+        "candidates_per_min": round(60.0 * n_candidates / batched_s, 1),
+        "parity_max_abs_diff": float(parity),
+    }
+
+
 def _compact_summary(result: dict) -> dict:
     """One SMALL machine-readable line — always the LAST stdout line, so
     a bounded tail capture (the driver keeps ~2,000 chars) still parses
@@ -1589,6 +1731,14 @@ def _compact_summary(result: dict) -> dict:
             for k in ("foldin_latency_s", "events_per_s", "max_events_behind")
             if k in rt
         }
+    ev = result.get("eval")
+    if isinstance(ev, dict) and "error" not in ev:
+        s["eval"] = {
+            k: ev[k]
+            for k in ("eval_queries_per_s", "candidates_per_min",
+                      "batched_vs_serial_speedup")
+            if k in ev
+        }
     errors = sorted(
         k for k, v in result.items()
         if isinstance(v, dict) and "error" in v
@@ -1634,6 +1784,13 @@ def smoke_main() -> None:
         )
     except Exception as e:
         result["realtime"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        bench_eval(
+            result, n_users=300, n_items=80, n_events=4000,
+            n_candidates=4, eval_queries=600, k=5,
+        )
+    except Exception as e:
+        result["eval"] = {"error": f"{type(e).__name__}: {e}"}
     result["value"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(result))
     print(json.dumps(_compact_summary(result)))
@@ -1886,6 +2043,13 @@ def main() -> None:
         except Exception as e:
             extras["realtime"] = {"error": f"{type(e).__name__}: {e}"}
         _mark("realtime")
+
+    if RUN_EVAL:
+        try:
+            bench_eval(extras)
+        except Exception as e:
+            extras["eval"] = {"error": f"{type(e).__name__}: {e}"}
+        _mark("eval")
 
     # second chance a few minutes in: serving+ingest are host-heavy, so
     # a tunnel that came up during them still buys TPU core rows

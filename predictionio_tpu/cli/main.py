@@ -284,6 +284,28 @@ def cmd_eval(args) -> int:
     )
     print(result.to_one_liner())
     print(f"Evaluation completed. Evaluation instance ID: {instance_id}")
+    # compact machine-readable summary as the FINAL stdout line (same
+    # contract as bench.py: drivers that keep only a bounded tail of
+    # stdout can json.loads the last line on its own)
+    best = result.best_score
+    summary = {
+        "metric": result.metric_header,
+        "best_index": result.best_idx,
+        "best_params": result.best_engine_params.to_jsonable(),
+        "best_scores": {
+            result.metric_header: best.score,
+            **dict(zip(result.other_metric_headers, best.other_scores)),
+        },
+        "scores": [ms.score for _, ms in result.engine_params_scores],
+        "candidates": len(result.engine_params_scores),
+        "fast_path_candidates": result.fast_path_candidates,
+        "phase_seconds": {
+            k: round(v, 3) for k, v in result.phase_seconds.items()
+        },
+        "cache": result.cache_stats,
+        "instance_id": instance_id,
+    }
+    print(json.dumps(summary, sort_keys=True))
     return 0
 
 

@@ -23,6 +23,7 @@ from predictionio_tpu.core.params import Params, EmptyParams, EngineParams
 from predictionio_tpu.core.base import (
     Algorithm,
     DataSource,
+    EvalTopK,
     Preparator,
     IdentityPreparator,
     Serving,
@@ -41,6 +42,7 @@ __all__ = [
     "EngineParams",
     "Algorithm",
     "DataSource",
+    "EvalTopK",
     "Preparator",
     "IdentityPreparator",
     "Serving",

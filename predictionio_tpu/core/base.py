@@ -20,6 +20,7 @@ from __future__ import annotations
 import abc
 import inspect
 import logging
+from dataclasses import dataclass
 from typing import Any, Generic, Sequence, TypeVar
 
 from predictionio_tpu.core.context import WorkflowContext
@@ -92,6 +93,26 @@ class IdentityPreparator(Preparator[TD, TD]):
         return training_data
 
 
+@dataclass
+class EvalTopK:
+    """Device-shaped evaluation predictions: one candidate's answers to a
+    whole eval split as a padded [Q, P] id/score matrix (the evaluation
+    fast path's interchange type — core/fast_eval.py eval_device).
+
+    ``ids``: int32 [Q, P] ranked predicted item indices in the model's
+    dense id space; -1 marks an empty slot (rows already capped to each
+    query's requested result count, so slicing ``ids[:, :k]`` is exactly
+    the per-query path's ``top[:k]``).
+    ``scores``: float32 [Q, P] matching scores (padding slots are 0).
+    ``index``: the id -> dense-int mapping (``.get``-capable: a BiMap or
+    dict) that encodes actual/relevant ids into the same space.
+    """
+
+    ids: Any
+    scores: Any
+    index: Any
+
+
 class Algorithm(Component, Generic[PD, M, Q, P], abc.ABC):
     """Train a model from prepared data; score queries against it.
 
@@ -142,6 +163,22 @@ class Algorithm(Component, Generic[PD, M, Q, P], abc.ABC):
             return self.query_class()
         except TypeError:
             return None
+
+    def eval_topk(
+        self, model: M, queries: Sequence[Q], k: int
+    ) -> "EvalTopK | None":
+        """Batched device-resident eval scoring, or None when unsupported.
+
+        The evaluation fast path calls this once per eval split with all
+        queries: an implementation returns the whole split's ranked
+        predictions as one padded EvalTopK matrix (ONE batched top-k
+        device call instead of Q Python predictions). Rows must match
+        what ``predict``/``batch_predict`` would serve — same ranking,
+        capped to each query's requested result count — so metric parity
+        with the per-query path holds exactly. Returning None (the
+        default) keeps the candidate on the per-query path.
+        """
+        return None
 
     def train_sweep(
         self, ctx: WorkflowContext, prepared_data: PD, params_list: Sequence[Any]

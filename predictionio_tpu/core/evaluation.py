@@ -14,6 +14,7 @@ from __future__ import annotations
 import html as html_mod
 import json
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
@@ -39,6 +40,13 @@ class MetricEvaluatorResult:
     metric_header: str
     other_metric_headers: list[str]
     engine_params_scores: list[tuple[EngineParams, MetricScores]]
+    # eval report extras: per-phase wall time (train / predict / metric,
+    # plus "serial" for candidates that ran the classic engine.eval
+    # path), sweep cache hit/miss counters, and how many candidates the
+    # device fast path scored (core/fast_eval.py eval_device)
+    phase_seconds: dict[str, float] = field(default_factory=dict)
+    cache_stats: dict[str, dict[str, int]] = field(default_factory=dict)
+    fast_path_candidates: int = 0
 
     def to_one_liner(self) -> str:
         return f"[{self.best_score.score:.4f}] {self.metric_header}"
@@ -59,6 +67,9 @@ class MetricEvaluatorResult:
                     }
                     for ep, ms in self.engine_params_scores
                 ],
+                "phaseSeconds": self.phase_seconds,
+                "cacheStats": self.cache_stats,
+                "fastPathCandidates": self.fast_path_candidates,
             },
             sort_keys=True,
         )
@@ -90,10 +101,47 @@ class MetricEvaluator:
         metric: Metric,
         other_metrics: Sequence[Metric] = (),
         output_path: str | None = None,
+        use_device_path: bool = True,
     ):
         self.metric = metric
         self.other_metrics = list(other_metrics)
         self.output_path = output_path
+        # the device-resident fast path (core/fast_eval.py eval_device);
+        # off forces every candidate through the classic per-query
+        # engine.eval path — the bench's serial comparator
+        self.use_device_path = use_device_path
+
+    def _make_workflow(
+        self,
+        ctx: WorkflowContext,
+        engine: Engine,
+        engine_params_list: Sequence[EngineParams],
+        metrics: Sequence[Metric],
+    ):
+        """A prewarmed FastEvalEngineWorkflow when the sweep can take the
+        device fast path, else None (per-candidate engine.eval keeps the
+        exact classic semantics — sanity checks, serving.supplement)."""
+        if not self.use_device_path or not isinstance(engine, Engine):
+            return None
+        if any(m.device_spec() is None for m in metrics):
+            return None
+        try:
+            from predictionio_tpu.core.base import Algorithm, FirstServing
+
+            for ep in engine_params_list:
+                if type(engine.make_serving(ep)) is not FirstServing:
+                    return None
+            algos = engine.make_algorithms(engine_params_list[0])
+            if not algos or type(algos[0]).eval_topk is Algorithm.eval_topk:
+                return None
+        except Exception:
+            logger.debug("device eval gating failed; using serial path", exc_info=True)
+            return None
+        from predictionio_tpu.core.fast_eval import FastEvalEngineWorkflow
+
+        workflow = FastEvalEngineWorkflow(engine, ctx)
+        workflow.prewarm_sweeps(engine_params_list)
+        return workflow
 
     def evaluate(
         self,
@@ -104,19 +152,41 @@ class MetricEvaluator:
     ) -> MetricEvaluatorResult:
         if not engine_params_list:
             raise ValueError("engine_params_list must not be empty")
+        metrics = [self.metric, *self.other_metrics]
+        workflow = self._make_workflow(ctx, engine, engine_params_list, metrics)
+        phase: dict[str, float] = (
+            workflow.phase_seconds
+            if workflow is not None
+            else {"train": 0.0, "predict": 0.0, "metric": 0.0}
+        )
         scores: list[tuple[EngineParams, MetricScores]] = []
         for i, ep in enumerate(engine_params_list):
-            eval_data = engine.eval(ctx, ep, workflow_params)
-            ms = MetricScores(
-                score=self.metric.calculate(eval_data),
-                other_scores=[m.calculate(eval_data) for m in self.other_metrics],
-            )
+            vals = workflow.eval_device(ep, metrics) if workflow is not None else None
+            if vals is not None:
+                ms = MetricScores(score=vals[0], other_scores=vals[1:])
+            else:
+                t0 = time.perf_counter()
+                eval_data = engine.eval(ctx, ep, workflow_params)
+                phase["serial"] = (
+                    phase.get("serial", 0.0) + time.perf_counter() - t0
+                )
+                t0 = time.perf_counter()
+                ms = MetricScores(
+                    score=self.metric.calculate(eval_data),
+                    other_scores=[
+                        m.calculate(eval_data) for m in self.other_metrics
+                    ],
+                )
+                phase["metric"] = (
+                    phase.get("metric", 0.0) + time.perf_counter() - t0
+                )
             logger.info(
-                "candidate %d/%d: %s = %s",
+                "candidate %d/%d: %s = %s%s",
                 i + 1,
                 len(engine_params_list),
                 self.metric.header,
                 ms.score,
+                " (device fast path)" if vals is not None else "",
             )
             scores.append((ep, ms))
 
@@ -132,6 +202,21 @@ class MetricEvaluator:
             metric_header=self.metric.header,
             other_metric_headers=[m.header for m in self.other_metrics],
             engine_params_scores=scores,
+            phase_seconds=dict(phase),
+            cache_stats=(
+                {"hits": dict(workflow.hits), "misses": dict(workflow.misses)}
+                if workflow is not None
+                else {}
+            ),
+            fast_path_candidates=(
+                workflow.fast_path_candidates if workflow is not None else 0
+            ),
+        )
+        logger.info(
+            "eval phases (s): %s; fast-path candidates %d/%d",
+            {k: round(v, 3) for k, v in result.phase_seconds.items()},
+            result.fast_path_candidates,
+            len(scores),
         )
         if self.output_path:
             self.save_engine_json(result, self.output_path)

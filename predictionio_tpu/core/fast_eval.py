@@ -15,11 +15,18 @@ from __future__ import annotations
 
 import json
 import logging
+import time
+from contextlib import contextmanager
 from typing import Any, Sequence
 
+import numpy as np
+
+from predictionio_tpu.core.base import Algorithm, FirstServing
 from predictionio_tpu.core.context import WorkflowContext
 from predictionio_tpu.core.engine import Engine, WorkflowParams
+from predictionio_tpu.core.metrics import Metric
 from predictionio_tpu.core.params import EngineParams, Params
+from predictionio_tpu.core.ranking import encode_actuals
 
 logger = logging.getLogger(__name__)
 
@@ -41,16 +48,45 @@ class FastEvalEngineWorkflow:
         self.preparator_cache: dict[str, Any] = {}
         self.models_cache: dict[str, Any] = {}
         self.algorithms_cache: dict[str, Any] = {}
-        self.hits = {"datasource": 0, "preparator": 0, "algorithms": 0}
-        self.misses = {"datasource": 0, "preparator": 0, "algorithms": 0}
+        # device fast path caches: per-candidate padded [Q, K] top-k
+        # matrices, and per eval split the encoded actual-id rows (shared
+        # across every candidate whose model exposes the same id space)
+        self.topk_cache: dict[str, list] = {}
+        self.actuals_cache: dict[tuple[str, int], tuple[Any, np.ndarray, np.ndarray]] = {}
+        self.hits = {"datasource": 0, "preparator": 0, "algorithms": 0, "topk": 0}
+        self.misses = {"datasource": 0, "preparator": 0, "algorithms": 0, "topk": 0}
         self.swept_candidates = 0  # candidates trained via vmapped sweeps
+        self.fast_path_candidates = 0  # candidates scored via eval_device
+        self.phase_seconds = {"train": 0.0, "predict": 0.0, "metric": 0.0}
+        self._active_phases: set[str] = set()
+
+    @contextmanager
+    def _phase(self, name: str):
+        """Accumulate wall time into the per-phase eval report counters.
+
+        Reentrant per name (an outer section swallows inner sections of
+        the same phase), so helpers can time their own work without the
+        caller knowing; callers must not nest DIFFERENT phase names."""
+        if name in self._active_phases:
+            yield
+            return
+        self._active_phases.add(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._active_phases.discard(name)
+            self.phase_seconds[name] = (
+                self.phase_seconds.get(name, 0.0) + time.perf_counter() - t0
+            )
 
     def _eval_sets(self, ep: EngineParams):
         key = _key(ep.datasource)
         if key not in self.datasource_cache:
             self.misses["datasource"] += 1
             datasource = self.engine.make_datasource(ep)
-            self.datasource_cache[key] = datasource.read_eval(self.ctx)
+            with self._phase("train"):
+                self.datasource_cache[key] = datasource.read_eval(self.ctx)
         else:
             self.hits["datasource"] += 1
         return key, self.datasource_cache[key]
@@ -61,10 +97,11 @@ class FastEvalEngineWorkflow:
         if key not in self.preparator_cache:
             self.misses["preparator"] += 1
             preparator = self.engine.make_preparator(ep)
-            self.preparator_cache[key] = [
-                (preparator.prepare(self.ctx, td), info, qa)
-                for td, info, qa in eval_sets
-            ]
+            with self._phase("train"):
+                self.preparator_cache[key] = [
+                    (preparator.prepare(self.ctx, td), info, qa)
+                    for td, info, qa in eval_sets
+                ]
         else:
             self.hits["preparator"] += 1
         return key, self.preparator_cache[key]
@@ -75,13 +112,14 @@ class FastEvalEngineWorkflow:
         vmapped batch trainings before candidates are walked serially."""
         key = prep_key + "|" + _key(*ep.algorithms)
         if key not in self.models_cache:
-            self.models_cache[key] = [
-                [
-                    a.train(self.ctx, pd)
-                    for a in self.engine.make_algorithms(ep)
+            with self._phase("train"):
+                self.models_cache[key] = [
+                    [
+                        a.train(self.ctx, pd)
+                        for a in self.engine.make_algorithms(ep)
+                    ]
+                    for pd, _info, _qa in prepared_sets
                 ]
-                for pd, _info, _qa in prepared_sets
-            ]
         return self.models_cache[key]
 
     def prewarm_sweeps(self, engine_params_list: Sequence[EngineParams]) -> None:
@@ -115,7 +153,8 @@ class FastEvalEngineWorkflow:
             params_list = [ep.algorithms[0][1] for ep in distinct]
             per_set_models = []
             for pd, _info, _qa in prepared_sets:
-                models = algo.train_sweep(self.ctx, pd, params_list)
+                with self._phase("train"):
+                    models = algo.train_sweep(self.ctx, pd, params_list)
                 if models is None:
                     per_set_models = None
                     break
@@ -138,13 +177,14 @@ class FastEvalEngineWorkflow:
             algorithms = self.engine.make_algorithms(ep)
             per_set_models = self._models(ep, prep_key, prepared_sets)
             per_set = []
-            for (pd, info, qa), models in zip(prepared_sets, per_set_models):
-                indexed = list(enumerate(q for q, _ in qa))
-                per_algo = [
-                    dict(a.batch_predict(m, indexed))
-                    for a, m in zip(algorithms, models)
-                ]
-                per_set.append((per_algo, info, qa))
+            with self._phase("predict"):
+                for (pd, info, qa), models in zip(prepared_sets, per_set_models):
+                    indexed = list(enumerate(q for q, _ in qa))
+                    per_algo = [
+                        dict(a.batch_predict(m, indexed))
+                        for a, m in zip(algorithms, models)
+                    ]
+                    per_set.append((per_algo, info, qa))
             self.algorithms_cache[key] = per_set
             # the factor models were consumed into (small) predictions;
             # dropping them bounds sweep memory at O(1) models instead of
@@ -157,13 +197,115 @@ class FastEvalEngineWorkflow:
     def eval(self, ep: EngineParams):
         serving = self.engine.make_serving(ep)
         results = []
-        for per_algo, info, qa in self._predictions(ep):
-            served = [
-                (q, serving.serve(q, [pa[ix] for pa in per_algo]), a)
-                for ix, (q, a) in enumerate(qa)
-            ]
-            results.append((info, served))
+        predictions = self._predictions(ep)
+        with self._phase("predict"):
+            for per_algo, info, qa in predictions:
+                served = [
+                    (q, serving.serve(q, [pa[ix] for pa in per_algo]), a)
+                    for ix, (q, a) in enumerate(qa)
+                ]
+                results.append((info, served))
         return results
+
+    # -- device-resident fast path -----------------------------------------
+
+    def _encoded_actuals(self, prep_key: str, set_i: int, qa, index):
+        """Padded sorted actual-id rows for one eval split, encoded once
+        and reused across every candidate sharing the id space."""
+        cache_key = (prep_key, set_i)
+        cached = self.actuals_cache.get(cache_key)
+        if cached is not None:
+            tok, enc, counts = cached
+            if tok is index or tok == index:
+                return enc, counts
+        enc, counts = encode_actuals([a for _, a in qa], index)
+        self.actuals_cache[cache_key] = (index, enc, counts)
+        return enc, counts
+
+    def eval_device(self, ep: EngineParams, metrics: Sequence[Metric]):
+        """Score one candidate fully on device, or None to signal the
+        caller to fall back to the per-query ``eval`` path.
+
+        Fallback gates (any miss -> None): every metric advertises a
+        DeviceRankingSpec (custom Metric subclasses don't); serving is
+        exactly FirstServing (a custom Serving may transform or combine
+        predictions the fast path never materializes); the first
+        algorithm implements ``eval_topk``. When all gates pass, the
+        candidate's predictions stay on device as ONE padded [Q, K]
+        top-k matrix per eval split and PrecisionAtK / MAPAtK / NDCGAtK
+        reduce via the vectorized kernel — no per-query Python at all.
+
+        Returns one score per metric, in order.
+        """
+        from predictionio_tpu.ops import topk as topk_ops
+
+        specs = [m.device_spec() for m in metrics]
+        if not specs or any(s is None for s in specs):
+            return None
+        serving = self.engine.make_serving(ep)
+        if type(serving) is not FirstServing:
+            return None
+        algorithms = self.engine.make_algorithms(ep)
+        if not algorithms:
+            return None
+        algo = algorithms[0]
+        if type(algo).eval_topk is Algorithm.eval_topk:
+            return None
+
+        k_max = max(s.k for s in specs)
+        prep_key, prepared_sets = self._prepared(ep)
+        algo_key = prep_key + "|" + _key(*ep.algorithms)
+        key = algo_key + f"|k={k_max}"
+        per_set = self.topk_cache.get(key)
+        if per_set is None:
+            self.misses["topk"] += 1
+            per_set_models = self._models(ep, prep_key, prepared_sets)
+            per_set = []
+            with self._phase("predict"):
+                for (_pd, _info, qa), models in zip(prepared_sets, per_set_models):
+                    topk = algo.eval_topk(models[0], [q for q, _ in qa], k_max)
+                    if topk is None:
+                        return None
+                    per_set.append(topk)
+            self.topk_cache[key] = per_set
+            # factor models were consumed into (small) top-k matrices;
+            # dropping them bounds sweep memory like _predictions does
+            self.models_cache.pop(algo_key, None)
+        else:
+            self.hits["topk"] += 1
+
+        with self._phase("metric"):
+            sums = np.zeros(len(specs), dtype=np.float64)
+            counts = np.zeros(len(specs), dtype=np.int64)
+            for set_i, ((_pd, _info, qa), topk) in enumerate(
+                zip(prepared_sets, per_set)
+            ):
+                enc, n_actual = self._encoded_actuals(
+                    prep_key, set_i, qa, topk.index
+                )
+                pred_ids = np.asarray(topk.ids)
+                by_k: dict[int, list[np.ndarray]] = {}
+                for mi, spec in enumerate(specs):
+                    res = by_k.get(spec.k)
+                    if res is None:
+                        res = [
+                            np.asarray(r)
+                            for r in topk_ops.ranking_metrics_batch(
+                                pred_ids[:, : spec.k], enc, n_actual, k=spec.k
+                            )
+                        ]
+                        by_k[spec.k] = res
+                    precision, ap, ndcg, valid = res
+                    arr = {"precision": precision, "ap": ap, "ndcg": ndcg}[
+                        spec.kernel
+                    ]
+                    sums[mi] += float(arr[valid].sum(dtype=np.float64))
+                    counts[mi] += int(valid.sum())
+        self.fast_path_candidates += 1
+        return [
+            float(sums[i] / counts[i]) if counts[i] else float("nan")
+            for i in range(len(specs))
+        ]
 
 
 class FastEvalEngine(Engine):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import abc
 import math
+from dataclasses import dataclass
 from typing import Any, Generic, Sequence, TypeVar
 
 import numpy as np
@@ -24,6 +25,22 @@ A = TypeVar("A")
 
 # eval data: [(eval_info, [(q, p, a), ...]), ...]
 EvalDataSet = Sequence[tuple[Any, Sequence[tuple[Q, P, A]]]]
+
+
+@dataclass(frozen=True)
+class DeviceRankingSpec:
+    """A metric's claim to the device-resident eval fast path.
+
+    ``kernel`` names an output of ops.topk.ranking_metrics_batch
+    ("precision" | "ap" | "ndcg"); ``k`` is the cutoff. Metrics that
+    advertise a spec are computed fully vectorized over a candidate's
+    padded top-k matrix instead of per (query, prediction, actual) point
+    (core/fast_eval.py eval_device); mean reduction skips invalid
+    (empty-actual) rows, matching OptionAverageMetric semantics.
+    """
+
+    kernel: str
+    k: int
 
 
 class Metric(abc.ABC, Generic[Q, P, A]):
@@ -48,6 +65,15 @@ class Metric(abc.ABC, Generic[Q, P, A]):
     @property
     def header(self) -> str:
         return type(self).__name__
+
+    def device_spec(self) -> DeviceRankingSpec | None:
+        """A DeviceRankingSpec when this metric can ride the device
+        fast path, else None (the default — per-point Python scoring).
+        Implementations MUST return None for subclasses whose
+        ``calculate_point`` may have been overridden: the fast path
+        never calls it, so a spec from a customized metric would
+        silently compute the wrong number."""
+        return None
 
 
 class QPAMetric(Metric[Q, P, A]):

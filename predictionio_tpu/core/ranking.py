@@ -20,7 +20,49 @@ from typing import Any, Iterable, Sequence
 
 import numpy as np
 
-from predictionio_tpu.core.metrics import OptionAverageMetric
+from predictionio_tpu.core.metrics import DeviceRankingSpec, OptionAverageMetric
+
+# padding sentinel for encoded actual-id rows: sorts past every real id
+# and past every out-of-vocabulary code, and ``pos < count`` in the
+# kernel's sorted lookup keeps it from ever matching
+ACTUAL_PAD = np.iinfo(np.int32).max
+
+
+def encode_actuals(actuals: Sequence, index: Any) -> tuple[np.ndarray, np.ndarray]:
+    """Encode per-query actual/relevant id collections as padded sorted
+    int rows — the one-time host-side prep for the device metric kernel
+    (ops.topk.ranking_metrics_batch).
+
+    ``index`` maps raw ids to the prediction id space (``.get``-capable:
+    BiMap or dict). Actual ids MISSING from it get distinct codes <= -2:
+    they still count toward |actual| (AP normalization, IDCG) but can
+    never match a predicted id (predictions are >= 0, empty slots -1).
+
+    Returns ``(rows [Q, A] int32 sorted ascending + ACTUAL_PAD padding,
+    counts [Q] int32)``.
+    """
+    encoded: list[list[int]] = []
+    counts = np.zeros(len(actuals), dtype=np.int32)
+    width = 1
+    for qi, a in enumerate(actuals):
+        ids = _id_set(a)
+        counts[qi] = len(ids)
+        row = []
+        miss = -2
+        for x in ids:
+            j = index.get(x)
+            if j is None:
+                row.append(miss)
+                miss -= 1
+            else:
+                row.append(int(j))
+        row.sort()
+        encoded.append(row)
+        width = max(width, len(row))
+    out = np.full((len(actuals), width), ACTUAL_PAD, dtype=np.int32)
+    for qi, row in enumerate(encoded):
+        out[qi, : len(row)] = row
+    return out, counts
 
 
 def _ranked_ids(p: Any) -> list:
@@ -110,6 +152,11 @@ class PrecisionAtK(OptionAverageMetric):
     def calculate_point(self, q, p, a) -> float | None:
         return precision_at_k(p, a, self.k)
 
+    def device_spec(self) -> DeviceRankingSpec | None:
+        # exact-type gate: a subclass may override calculate_point, and
+        # the device kernel would silently ignore it (core/metrics.py)
+        return DeviceRankingSpec("precision", self.k) if type(self) is PrecisionAtK else None
+
     @property
     def header(self) -> str:
         return f"PrecisionAtK (k={self.k})"
@@ -124,6 +171,9 @@ class MAPAtK(OptionAverageMetric):
     def calculate_point(self, q, p, a) -> float | None:
         return average_precision_at_k(p, a, self.k)
 
+    def device_spec(self) -> DeviceRankingSpec | None:
+        return DeviceRankingSpec("ap", self.k) if type(self) is MAPAtK else None
+
     @property
     def header(self) -> str:
         return f"MAPAtK (k={self.k})"
@@ -137,6 +187,9 @@ class NDCGAtK(OptionAverageMetric):
 
     def calculate_point(self, q, p, a) -> float | None:
         return ndcg_at_k(p, a, self.k)
+
+    def device_spec(self) -> DeviceRankingSpec | None:
+        return DeviceRankingSpec("ndcg", self.k) if type(self) is NDCGAtK else None
 
     @property
     def header(self) -> str:

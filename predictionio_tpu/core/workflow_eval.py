@@ -97,7 +97,16 @@ def run_evaluation(
             instance.evaluator_results_html = result.to_html()
             instance.evaluator_results_json = result.to_json()
         instances.update(instance)
-        logger.info("evaluation instance %s EVALCOMPLETED", instance_id)
+        logger.info(
+            "evaluation instance %s EVALCOMPLETED "
+            "(fast-path candidates=%d, phase seconds=%s)",
+            instance_id,
+            getattr(result, "fast_path_candidates", 0),
+            {
+                k: round(v, 3)
+                for k, v in getattr(result, "phase_seconds", {}).items()
+            },
+        )
         return instance_id, result
     except Exception:
         instance.status = EvaluationInstanceStatus.FAILED

@@ -13,6 +13,12 @@ with `EngineParamsList`). Run it with:
 The target app defaults to ``MyApp``; set ``PIO_EVAL_APP_NAME`` (shared
 with the recommendation eval target) to point elsewhere. Entry points
 are zero-arg factories — importing this module never touches storage.
+
+``Accuracy`` is a custom Metric subclass, so this sweep takes the
+per-query fallback path by design, not the device-resident ranking fast
+path (docs/evaluation.md "Fallback rules") — the fast path only covers
+the stock P@K/MAP@K/NDCG@K metrics whose math lives in the device
+kernel.
 """
 
 from __future__ import annotations

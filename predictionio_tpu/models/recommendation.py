@@ -31,6 +31,7 @@ from predictionio_tpu.core import (
     Algorithm,
     DataSource,
     Engine,
+    EvalTopK,
     FirstServing,
     Params,
     Preparator,
@@ -72,6 +73,12 @@ class DataSourceParams(Params):
     app_name: str = ""
     event_names: tuple[str, ...] = ("rate", "buy")
     buy_rating: float = 4.0
+    # evaluation split knobs (read_eval): fold count and the PRNG seed
+    # for the shuffled fold assignment. The seed makes repeated
+    # `pio eval` runs bit-reproducible — same folds, same metric values
+    # (docs/evaluation.md "Reproducibility")
+    eval_folds: int = 3
+    eval_seed: int = 42
 
 
 @dataclass
@@ -131,15 +138,22 @@ class RecommendationDataSource(DataSource):
         )
 
     def read_eval(self, ctx: WorkflowContext):
-        """k-fold split for evaluation (reference evaluation DataSource
-        pattern; folds by rating index modulo k)."""
+        """Seeded k-fold split for evaluation (reference evaluation
+        DataSource pattern). Fold assignment is a seeded shuffled
+        balanced partition — deterministic in (event data, eval_folds,
+        eval_seed), so repeated `pio eval` runs see identical
+        train/test splits and produce identical metric values; raising
+        index-correlated ingest order (e.g. time-sorted imports) no
+        longer biases folds the way the old index-modulo split did."""
         td = self.read_training(ctx)
-        k = 3
+        k = max(1, int(self.params.eval_folds))
         folds = []
         n = len(td.ratings)
-        idx = np.arange(n)
+        rng = np.random.default_rng(int(self.params.eval_seed))
+        fold_of = np.empty(n, dtype=np.int64)
+        fold_of[rng.permutation(n)] = np.arange(n) % k
         for fold in range(k):
-            mask = idx % k == fold
+            mask = fold_of == fold
             # compact the train fold's id space to entities that actually
             # appear in it: a user whose only ratings fell in the test
             # fold must be ABSENT from the model (unseen-user -> empty
@@ -479,6 +493,50 @@ class ALSAlgorithm(Algorithm):
                     )
                 )
         return out
+
+    def eval_topk(
+        self, model: ALSModel, queries: Sequence[Query], k: int
+    ) -> EvalTopK | None:
+        """Device-resident eval scoring (core/fast_eval.py eval_device):
+        ONE batched top-k over every known user in the eval split; the
+        padded [Q, K] id matrix never becomes Python result objects.
+
+        Parity with the per-query path is structural: the same scorer
+        ranks the same user rows (lax.top_k's prefix is k-invariant, so
+        a smaller k here equals the sliced pow2-k `batch_predict` rows),
+        unknown users keep all -1 (empty-prediction) rows, and each row
+        is capped to its query's ``num`` exactly like ``predict``
+        truncates its result list.
+        """
+        from predictionio_tpu.ops.topk import top_k_items_batch
+
+        num_items = len(model.item_index)
+        if num_items == 0:
+            return None
+        kr = max(1, min(int(k), num_items))
+        qn = len(queries)
+        ids = np.full((qn, kr), -1, dtype=np.int32)
+        scores = np.zeros((qn, kr), dtype=np.float32)
+        known = [qi for qi, q in enumerate(queries) if q.user in model.user_index]
+        if known:
+            uixs = np.asarray(
+                [model.user_index[queries[qi].user] for qi in known],
+                dtype=np.int32,
+            )
+            if self.params.sharded_serving:
+                s, i = model.ring_catalog().top_k(model.user_rows(uixs), kr)
+            else:
+                _, V = model.device_factors()
+                s, i = top_k_items_batch(model.user_rows(uixs), V, k=kr)
+            ids[known] = np.asarray(i, dtype=np.int32)
+            scores[known] = np.asarray(s, dtype=np.float32)
+        # cap each row to the query's requested result count, mirroring
+        # the per-query path's slice to q.num before metrics see it
+        nums = np.fromiter((int(q.num) for q in queries), dtype=np.int64, count=qn)
+        over = np.arange(kr)[None, :] >= nums[:, None]
+        ids[over] = -1
+        scores[over] = 0.0
+        return EvalTopK(ids=ids, scores=scores, index=model.item_index)
 
 
 def engine() -> Engine:

@@ -16,6 +16,15 @@ the sweep at another app (the reference's template hardcodes the app name
 in Evaluation.scala for the user to edit — an env var keeps the shipped
 module usable unedited).
 
+This sweep rides the device-resident evaluation fast path end to end
+(docs/evaluation.md): Precision@K plus the MAP@K / NDCG@K side metrics
+are stock ranking metrics, the engine serves with FirstServing, and
+ALSAlgorithm implements ``eval_topk`` — so every candidate's predictions
+stay on device as one padded [Q, K] top-k matrix and the metrics reduce
+in the vectorized kernel (ops/topk.py ranking_metrics_batch). The eval
+split is seeded (DataSourceParams.eval_seed), so repeated runs reproduce
+identical folds and scores.
+
 Both entry points are zero-arg factories (resolved lazily by
 ``run_evaluation``), so importing this module never touches storage.
 """
@@ -24,9 +33,9 @@ from __future__ import annotations
 
 import os
 
-from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.core.evaluation import Evaluation, MetricEvaluator
 from predictionio_tpu.core.params import EngineParamsGenerator
-from predictionio_tpu.core.ranking import PrecisionAtK
+from predictionio_tpu.core.ranking import MAPAtK, NDCGAtK, PrecisionAtK
 from predictionio_tpu.models import recommendation
 
 SWEEP = [
@@ -73,9 +82,13 @@ def param_grid() -> EngineParamsGenerator:
 
 
 def evaluation() -> Evaluation:
-    """Precision@K over the engine's k-fold eval splits."""
+    """Precision@K (primary) + MAP@K / NDCG@K side metrics over the
+    engine's seeded k-fold eval splits."""
     return Evaluation(
         engine=recommendation.engine(),
-        metric=PrecisionAtK(k=K),
+        evaluator=MetricEvaluator(
+            metric=PrecisionAtK(k=K),
+            other_metrics=[MAPAtK(k=K), NDCGAtK(k=K)],
+        ),
         engine_params_generator=param_grid(),
     )

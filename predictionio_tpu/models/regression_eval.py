@@ -15,6 +15,10 @@ app ``PIO_EVAL_APP_NAME`` (default ``MyApp``, ``datapoint`` events).
 
 Both entry points are zero-arg factories (resolved lazily by
 ``run_evaluation``), so importing this module never touches storage.
+
+``MeanSquareError`` is not a ranking metric, so this sweep evaluates on
+the per-query fallback path (docs/evaluation.md "Fallback rules"); the
+device-resident fast path applies only to top-k ranking evaluations.
 """
 
 from __future__ import annotations

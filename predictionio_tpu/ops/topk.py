@@ -84,6 +84,59 @@ def top_k_items_batch(user_vectors, item_factors, k: int, exclude_mask=None):
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
+def ranking_metrics_batch(pred_ids, actual_sorted, actual_counts, k: int):
+    """Vectorized P@K / AP@K / NDCG@K over a padded top-k id matrix.
+
+    The evaluation fast path's metric kernel (core/fast_eval.py
+    eval_device): one call scores EVERY eval query of a candidate,
+    replacing the per-query Python set-membership loops in
+    core/ranking.py. Membership is a sorted lookup per rank position
+    (searchsorted), hit prefix sums give the precision-at-hit terms.
+
+    ``pred_ids``: [Q, P] int32 ranked predicted ids, P <= k; -1 marks an
+    empty slot (shorter result rows, unseen users).
+    ``actual_sorted``: [Q, A] int32 relevant ids per query, sorted
+    ascending and padded with int32-max; relevant ids that are OUTSIDE
+    the prediction id space are encoded as distinct codes <= -2 so they
+    count toward |actual| (AP normalization, IDCG) but can never match.
+    ``actual_counts``: [Q] int32 true |actual| per query.
+    ``k``: static metric cutoff — denominators use it even when P < k.
+
+    Returns ``(precision, ap, ndcg, valid)`` with shape [Q]; ``valid`` is
+    False where the actual set is empty (the Option-skip rows — metric
+    semantics in core/ranking.py say those queries score None).
+    """
+    pred = jnp.asarray(pred_ids, dtype=jnp.int32)
+    actual = jnp.asarray(actual_sorted, dtype=jnp.int32)
+    counts = jnp.asarray(actual_counts, dtype=jnp.int32)
+    pn = pred.shape[1]
+
+    def row_hits(p_row, a_row, count):
+        pos = jnp.searchsorted(a_row, p_row)
+        clipped = jnp.clip(pos, 0, a_row.shape[0] - 1)
+        return (pos < count) & (a_row[clipped] == p_row) & (p_row >= 0)
+
+    hits = jax.vmap(row_hits)(pred, actual, counts).astype(jnp.float32)
+
+    precision = hits.sum(axis=1) / float(k)
+
+    ranks = jnp.arange(1, pn + 1, dtype=jnp.float32)
+    ap_terms = jnp.where(hits > 0, jnp.cumsum(hits, axis=1) / ranks, 0.0)
+    ap_norm = jnp.maximum(jnp.minimum(float(k), counts.astype(jnp.float32)), 1.0)
+    ap = ap_terms.sum(axis=1) / ap_norm
+
+    discounts = 1.0 / jnp.log2(jnp.arange(2, pn + 2, dtype=jnp.float32))
+    dcg = (hits * discounts).sum(axis=1)
+    # IDCG over min(k, |actual|) ideal hits; |actual| may exceed P, so
+    # the prefix table spans the full k, not just the prediction width
+    idcg_prefix = jnp.cumsum(1.0 / jnp.log2(jnp.arange(2, k + 2, dtype=jnp.float32)))
+    ideal_n = jnp.clip(jnp.minimum(counts, k), 1, k)
+    ndcg = dcg / idcg_prefix[ideal_n - 1]
+
+    return precision, ap, ndcg, counts > 0
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
 def top_k_similar(item_vector, item_factors, k: int, exclude_mask=None):
     """Cosine item-item similarity top-k (similarproduct template's scoring,
     examples/scala-parallel-similarproduct/multi/src/main/scala/

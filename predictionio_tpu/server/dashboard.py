@@ -9,12 +9,44 @@ evaluations (most recent first) with links to per-instance
 from __future__ import annotations
 
 import html
+import json
 import logging
 
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
 
 logger = logging.getLogger(__name__)
+
+
+def _result_summary(instance) -> tuple[str, str]:
+    """(metric scores, best params) cells for an evaluation row, parsed
+    from the persisted ``evaluator_results_json`` (MetricEvaluatorResult
+    .to_json) — empty cells when the instance predates the format or the
+    JSON is malformed."""
+    try:
+        doc = json.loads(instance.evaluator_results_json or "")
+    except (ValueError, TypeError):
+        return "", ""
+    if not isinstance(doc, dict) or "bestScore" not in doc:
+        return "", ""
+    scores = [f"{doc.get('metricHeader', 'metric')}: {doc['bestScore']:.4f}"]
+    best_idx = doc.get("bestIndex", 0)
+    candidates = doc.get("scores", [])
+    if isinstance(candidates, list) and 0 <= best_idx < len(candidates):
+        other = candidates[best_idx].get("otherScores", [])
+        for header, val in zip(doc.get("otherMetricHeaders", []), other):
+            try:
+                scores.append(f"{header}: {float(val):.4f}")
+            except (TypeError, ValueError):
+                continue
+    best = doc.get("bestEngineParams", {})
+    # the algorithm params are the part a tuning sweep varies; the full
+    # EngineParams JSON is a click away on the JSON results link
+    params = best.get("algorithms", best) if isinstance(best, dict) else best
+    params_str = json.dumps(params, sort_keys=True)
+    if len(params_str) > 300:
+        params_str = params_str[:300] + "…"
+    return "<br>".join(html.escape(s) for s in scores), html.escape(params_str)
 
 
 class Dashboard:
@@ -56,23 +88,29 @@ class Dashboard:
             if not server._authorized(request):
                 return Response.error("Not authenticated", 401)
             instances = server.storage.get_metadata_evaluation_instances().get_completed()
-            rows = "".join(
-                f"<tr><td>{html.escape(i.id)}</td>"
-                f"<td>{html.escape(i.evaluation_class)}</td>"
-                f"<td>{i.start_time:%Y-%m-%d %H:%M:%S}</td>"
-                f"<td>{i.end_time:%Y-%m-%d %H:%M:%S}</td>"
-                f"<td>{html.escape(i.evaluator_results)}</td>"
-                f"<td><a href='/engine_instances/{i.id}/evaluator_results.txt'>txt</a> "
-                f"<a href='/engine_instances/{i.id}/evaluator_results.html'>HTML</a> "
-                f"<a href='/engine_instances/{i.id}/evaluator_results.json'>JSON</a>"
-                f"</td></tr>"
-                for i in instances
-            )
+            cells = []
+            for i in instances:
+                scores_cell, params_cell = _result_summary(i)
+                cells.append(
+                    f"<tr><td>{html.escape(i.id)}</td>"
+                    f"<td>{html.escape(i.evaluation_class)}</td>"
+                    f"<td>{i.start_time:%Y-%m-%d %H:%M:%S}</td>"
+                    f"<td>{i.end_time:%Y-%m-%d %H:%M:%S}</td>"
+                    f"<td>{html.escape(i.evaluator_results)}</td>"
+                    f"<td>{scores_cell}</td>"
+                    f"<td><pre>{params_cell}</pre></td>"
+                    f"<td><a href='/engine_instances/{i.id}/evaluator_results.txt'>txt</a> "
+                    f"<a href='/engine_instances/{i.id}/evaluator_results.html'>HTML</a> "
+                    f"<a href='/engine_instances/{i.id}/evaluator_results.json'>JSON</a>"
+                    f"</td></tr>"
+                )
+            rows = "".join(cells)
             page = (
                 "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
                 "<body><h1>Completed evaluations</h1>"
                 "<table border='1'><tr><th>ID</th><th>Evaluation</th>"
                 "<th>Started</th><th>Finished</th><th>One-liner</th>"
+                "<th>Metric scores</th><th>Best params</th>"
                 f"<th>Results</th></tr>{rows}</table></body></html>"
             )
             return Response.html(page)

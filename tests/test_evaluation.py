@@ -189,6 +189,10 @@ class TestRunEvaluation:
                 f"http://127.0.0.1:{port}/", timeout=10
             ).read().decode()
             assert "Completed evaluations" in page and "VaryingMetric" in page
+            # the metric-scores / best-params columns (parsed from the
+            # persisted result JSON)
+            assert "Metric scores" in page and "Best params" in page
+            assert "VaryingMetric: 7.0000" in page
             iid = storage.get_metadata_evaluation_instances().get_completed()[0].id
             status, body = http(
                 "GET",
@@ -197,6 +201,54 @@ class TestRunEvaluation:
             assert status == 200 and body["bestScore"] == 7.0
         finally:
             dash.stop()
+
+
+class TestDashboardResultSummary:
+    """The index-table cells parsed from evaluator_results_json."""
+
+    def _instance(self, doc):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(
+            evaluator_results_json=doc if isinstance(doc, str) else json.dumps(doc)
+        )
+
+    def test_scores_and_params_cells(self):
+        from predictionio_tpu.server.dashboard import _result_summary
+
+        doc = {
+            "bestScore": 0.25,
+            "bestIndex": 1,
+            "metricHeader": "PrecisionAtK (k=5)",
+            "otherMetricHeaders": ["MAPAtK (k=5)"],
+            "scores": [
+                {"score": 0.1, "otherScores": [0.05]},
+                {"score": 0.25, "otherScores": [0.125]},
+            ],
+            "bestEngineParams": {
+                "algorithms": [{"name": "als", "params": {"lambda_": 0.02}}]
+            },
+        }
+        scores_cell, params_cell = _result_summary(self._instance(doc))
+        assert "PrecisionAtK (k=5): 0.2500" in scores_cell
+        assert "MAPAtK (k=5): 0.1250" in scores_cell  # best candidate's
+        assert "lambda_" in params_cell and "0.02" in params_cell
+
+    def test_malformed_json_yields_empty_cells(self):
+        from predictionio_tpu.server.dashboard import _result_summary
+
+        assert _result_summary(self._instance("not json")) == ("", "")
+        assert _result_summary(self._instance({"noBestScore": 1})) == ("", "")
+
+    def test_long_params_truncated(self):
+        from predictionio_tpu.server.dashboard import _result_summary
+
+        doc = {
+            "bestScore": 1.0,
+            "bestEngineParams": {"algorithms": [{"blob": "x" * 1000}]},
+        }
+        _scores, params_cell = _result_summary(self._instance(doc))
+        assert params_cell.endswith("…") and len(params_cell) < 400
 
 
 class CountingEngineWorkflowTest:
@@ -469,10 +521,7 @@ class TestVectorizedSweep:
 
 
 class TestShippedRecommendationEval:
-    def test_shipped_eval_runs_end_to_end(self, tmp_path, monkeypatch):
-        """The out-of-the-box `pio eval` target: Precision@1 sweep over
-        the ALS lambda/rank grid against a real event store."""
-        from predictionio_tpu.core.workflow_eval import run_evaluation
+    def _storage_with_events(self, tmp_path, monkeypatch):
         from predictionio_tpu.data.event import Event
         from predictionio_tpu.data.storage import App, Storage
 
@@ -499,15 +548,49 @@ class TestShippedRecommendationEval:
         from predictionio_tpu.data import store as store_mod
         monkeypatch.setattr(we, "get_storage", lambda: storage)
         monkeypatch.setattr(store_mod, "get_storage", lambda: storage)
+        return storage
 
+    def test_shipped_eval_runs_end_to_end(self, tmp_path, monkeypatch):
+        """The out-of-the-box `pio eval` target: Precision@1 sweep over
+        the ALS lambda/rank grid against a real event store."""
+        from predictionio_tpu.core.workflow_eval import run_evaluation
+
+        storage = self._storage_with_events(tmp_path, monkeypatch)
         instance_id, result = run_evaluation(
             "predictionio_tpu.models.recommendation_eval.evaluation",
             storage=storage,
         )
         assert 0.0 <= result.best_score.score <= 1.0
         assert len(result.engine_params_scores) == 4  # the shipped SWEEP
+        # the shipped target rides the device fast path end to end
+        # (stock ranking metrics + FirstServing + ALS eval_topk)
+        assert result.fast_path_candidates == 4
+        assert result.other_metric_headers  # MAP@K / NDCG@K side metrics
         inst = storage.get_metadata_evaluation_instances().get(instance_id)
         assert inst.status == "EVALCOMPLETED"
+        storage.close()
+
+    def test_repeated_runs_reproduce_identical_results(
+        self, tmp_path, monkeypatch
+    ):
+        """The eval split is seeded (DataSourceParams.eval_seed) and ALS
+        training is seeded, so two back-to-back runs over unchanged
+        events must serialize IDENTICAL results — same splits, same
+        metric values, same best params (docs/evaluation.md
+        "Reproducibility"). Only wall-clock phase timings may differ."""
+        from predictionio_tpu.core.workflow_eval import run_evaluation
+
+        storage = self._storage_with_events(tmp_path, monkeypatch)
+        docs = []
+        for _ in range(2):
+            _iid, result = run_evaluation(
+                "predictionio_tpu.models.recommendation_eval.evaluation",
+                storage=storage,
+            )
+            doc = json.loads(result.to_json())
+            doc.pop("phaseSeconds")
+            docs.append(doc)
+        assert docs[0] == docs[1]
         storage.close()
 
 
