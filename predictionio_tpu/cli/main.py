@@ -811,6 +811,13 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--stop-after-prepare", action="store_true")
     t.add_argument("--profile-dir", help="write a JAX profiler trace here")
     t.add_argument(
+        "--profile",
+        dest="profile_dir",
+        metavar="DIR",
+        help="alias for --profile-dir: wrap the training loop in "
+        "jax.profiler.trace and write the trace to DIR",
+    )
+    t.add_argument(
         "--mesh",
         help="device-mesh axes for the training run, e.g. 'data=8' or "
         "'data=4,model=2' (-1 once absorbs remaining devices)",

@@ -211,6 +211,11 @@ class ALSAlgorithmParams(Params):
     # all_gather over ICI each half-iteration) — the production multi-chip
     # train path replacing MLlib ALS's Spark-cluster execution
     sharded_train: bool = False
+    # half-step variant for the sharded trainer: "auto" picks gather
+    # while the gathered opposite side fits the per-chip budget and the
+    # scan-fused ppermute ring past it; "gather"/"ring" force one
+    # (parallel/als_sharded.py "Two half-step variants")
+    sharded_mode: str = "auto"
     # degree-bucket widths for the padded ALS layout (ops/als.py); rows
     # hotter than the largest width segment exactly across table rows
     bucket_widths: tuple[int, ...] = als_ops.DEFAULT_BUCKETS
@@ -325,7 +330,11 @@ class ALSAlgorithm(Algorithm):
         from predictionio_tpu.parallel.als_sharded import train_for_context
 
         U, V = train_for_context(
-            data, params, ctx, sharded=self.params.sharded_train
+            data,
+            params,
+            ctx,
+            sharded=self.params.sharded_train,
+            mode=self.params.sharded_mode,
         )
         logger.info(
             "ALS trained: %d users x %d items, rank %d, train RMSE %.4f",

@@ -209,6 +209,83 @@ def build_ratings_data(
 
 
 # ---------------------------------------------------------------------------
+# Host-side layout: entry packing (shared with the sharded trainer)
+# ---------------------------------------------------------------------------
+
+
+PACK_WIDTH_CANDIDATES = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+# Extra slot-equivalents one packed ROW costs beyond its slots: each row
+# materializes a [D, D] partial Gramian and scatter-adds it into the
+# per-solved-row accumulators, work that scales like a handful of slots'
+# worth of outer products. Measured on the bench workload (rank 16,
+# 250k entries): pure slot-minimization picks K=4 / 64k rows and runs
+# ~2x slower than K=32 / 9k rows; overhead 8 lands each mode at its
+# empirical optimum (gather ~32-64, ring ~8-16).
+PACK_ROW_OVERHEAD_SLOTS = 8
+
+
+def choose_pack_width(
+    counts,
+    candidates=PACK_WIDTH_CANDIDATES,
+    row_overhead=PACK_ROW_OVERHEAD_SLOTS,
+) -> int:
+    """Pick one packed-row width for a set of entry groups.
+
+    ``counts`` are per-group entry counts (e.g. per-row degrees). The
+    width minimizing ``sum(ceil(c/K)) * (K + row_overhead)`` — total
+    padded slots plus the per-row accumulate/scatter overhead — wins;
+    ties go to the LARGER width (fewer, wider rows batch better on the
+    MXU). This replaces the per-bucket width ladder for the sharded
+    trainer: one uniform width means one table, one program — the ALX
+    trade of a little padding for zero per-bucket dispatch.
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    if counts.size == 0:
+        return int(candidates[0])
+    best_k, best_cost = None, None
+    for k in candidates:
+        rows = (-(-counts // k)).sum()
+        cost = int(rows * (k + row_overhead))
+        if best_cost is None or cost <= best_cost:
+            best_k, best_cost = int(k), cost
+    return best_k
+
+
+def pack_entries(keys: np.ndarray, width: int):
+    """Pack entries into ``width``-wide rows, one group per run of rows.
+
+    ``keys`` is one int64 group key per entry (arbitrary values; entries
+    sharing a key form one group). Each group fills ``ceil(count/width)``
+    consecutive packed rows, groups ordered by ascending key, entries
+    within a group keeping their input order (stable sort — this is what
+    preserves the single-chip reduction order for parity). Returns
+    ``(entry_row, entry_slot, row_key, n_rows)``: the packed (row, slot)
+    of every entry, the group key each packed row serves, and the total
+    packed row count.
+    """
+    keys = np.asarray(keys, dtype=np.int64)
+    n = len(keys)
+    if n == 0:
+        z = np.zeros(0, np.int64)
+        return z, z, z, 0
+    order = np.argsort(keys, kind="stable")
+    ks = keys[order]
+    starts = np.concatenate([[0], np.nonzero(np.diff(ks))[0] + 1])
+    counts = np.diff(np.concatenate([starts, [n]]))
+    rank = np.arange(n) - np.repeat(starts, counts)
+    nseg = -(-counts // width)
+    seg_base = np.concatenate([[0], np.cumsum(nseg)[:-1]])
+    row_sorted = np.repeat(seg_base, counts) + rank // width
+    entry_row = np.empty(n, np.int64)
+    entry_row[order] = row_sorted
+    entry_slot = np.empty(n, np.int64)
+    entry_slot[order] = rank % width
+    row_key = np.repeat(ks[starts], nseg)
+    return entry_row, entry_slot, row_key, int(nseg.sum())
+
+
+# ---------------------------------------------------------------------------
 # Device-side solves
 # ---------------------------------------------------------------------------
 

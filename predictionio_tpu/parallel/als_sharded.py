@@ -2,76 +2,73 @@
 
 MLlib ALS distributes by blocking users x items across executors and
 shuffling factor blocks each half-iteration (external Spark dep; SURVEY
-§2.7). The TPU-native design (ALX pattern, PAPERS.md):
+§2.7). The TPU-native design here is the ALX execution model
+(PAPERS.md, arxiv 2112.02194):
 
-- both factor matrices live **sharded row-wise** over the mesh's ``data``
-  axis (P("data") on dim 0),
-- each half-iteration ``all_gather``s the *opposite* factor matrix over
-  ICI inside a ``shard_map`` (it is the smaller working set), solves the
-  local shard's normal equations with the same batched bucket math as
-  single-chip, and scatters the solutions back into the sharded factors,
+- both factor matrices live **uniformly row-sharded** over the mesh's
+  ``data`` axis as ``NamedSharding(mesh, P("data"))``, moved through
+  ``jax.jit`` with explicit ``in_shardings``/``out_shardings`` and
+  donated buffers — no hand-rolled shard bookkeeping,
+- ALL of a half-step's work — every degree bucket, every ring hop — is
+  ONE ``shard_map`` region inside one compiled program; the entire
+  training run is a single ``lax.fori_loop`` with a dynamic trip count,
 - the implicit-feedback Gramian Y^T Y is computed shard-locally and
   ``psum``-reduced — a [D, D] allreduce instead of MLlib's shuffle.
 
-Two properties the round-1 design lacked, now guaranteed:
+**Packed bucket superstructure.** Instead of one sub-table per degree
+bucket (whose count multiplies dispatch and shard_map regions), the
+trainer packs the ENTIRE rating set into one padded table per side at a
+single width ``K`` chosen to minimize padding (ops/als.py
+``choose_pack_width`` / ``pack_entries``). Rows hotter than ``K`` span
+several packed rows; ``seg`` maps each packed row to its shard-local
+solved row and the per-segment normal equations are scatter-added
+before the solve — the exact-hot-row guarantee of the bucketed layout
+(all segments of a solved row on ONE shard; serpentine
+descending-degree assignment balances load) at uniform shape.
 
-**Exact hot rows.** Degree-bucketed layouts segment rows hotter than the
-widest bucket across several table rows (ops/als.py PaddedBucket). The
-shard layout here places **all segments of one solved row on the same
-shard** (greedy longest-processing-time assignment balances segment
-counts across shards), so the per-segment Gramians are scatter-added
-shard-locally before the solve — multi-chip training is bit-for-bit the
-same math as single-chip, with no truncation of blockbuster rows.
+**Two half-step variants, auto-selected** (``choose_sharded_mode``):
 
-**One device program.** The whole training run is a single jitted
-``lax.fori_loop`` (dynamic trip count) with donated factor buffers; each
-half-iteration is one ``shard_map`` region per bucket set. No per-bucket
-Python dispatch, no host round-trips of the factors.
-
-**Memory model — two half-step variants, auto-selected.** Per chip, each
-half-iteration holds: (a) its shard of both factor matrices —
-``(rows + cols) / n_shards * D * itemsize`` bytes, shrinking with mesh
-size; (b) its shard of the bucket tables (col_ids/ratings/mask ~= 12
-bytes per rating / n_shards), shrinking with mesh size; and (c) the
-working set of the opposite factor matrix, which depends on the variant:
-
-- ``gather`` (``all_gather`` of the FULL opposite side): (c) =
-  ``opposite_rows * D * itemsize`` bytes per chip, NOT shrinking with
-  mesh size. One fused ICI collective — the latency-optimal choice while
-  it fits (ALX makes the same trade, PAPERS.md). On 16-GiB v5e the
-  gathered side caps at roughly 10^8 rows at rank 20 or 1.6*10^7 at
-  rank 128 (at half of HBM). MovieLens-20M (2.7*10^4 items, rank 20 ->
-  2 MiB gathered) is far below it.
-- ``ring`` (blocked ``ppermute`` rotation, the ring-top-k pattern of
-  parallel/ring_topk.py applied to training): each chip keeps only ONE
-  opposite-factor slab (``opposite_rows / n_shards * D``) resident;
-  slabs rotate around the mesh once per half-step, and each bucket's
-  normal equations ``(A, b)`` accumulate in place against the passing
-  slabs. (c) becomes slab + accumulators —
-  ``opposite_rows/S * D + target_table_rows/S * D^2`` floats — which
-  SHRINKS with mesh size, like MLlib's block ALS (whose executors hold
-  per-user triangular systems the same way; reference
+- ``gather``: tables are ``[S, B, K]`` (shard-major, global column
+  ids). One fused ``all_gather`` of the opposite factors per half-step;
+  each shard solves its packed rows against the full gathered matrix.
+  Latency-optimal while the gathered side fits
+  ``ALSParams.sharded_gather_budget_bytes`` per chip (ALX makes the
+  same trade).
+- ``ring``: the opposite factor TABLE never materializes whole on any
+  chip. The value tables are the same gather-shaped ``[S, B, K]``; a
+  routing table ``[S, T, E]`` with ``T = S`` rotation steps lists, per
+  step, the slab-local ids of the entries whose opposite factor row is
+  owned by shard ``(s - t) mod S`` — a device-side owner layout
+  replacing the old host-side ``ring_partition_bucket`` repartition.
+  The half-step is a single ``lax.scan`` over ``ppermute`` slab
+  rotations: each step reads the slab rows its entries need (slab-local
+  ids baked in at pack time), the stacked reads are permuted into
+  ``[B, K, D]`` working-set order by one gather off a host-precomputed
+  inverse map, and the last slot is peeled so a half-step costs S-1
+  collective hops — after which the working set is bit-identical to
+  gather's and the IDENTICAL packed solve runs.
+  Per-chip memory — slab + the shard's ``~nnz/S``-slot working set —
+  SHRINKS with mesh size, like MLlib's block ALS (reference
   examples/scala-parallel-recommendation/custom-prepartor/src/main/
-  scala/ALSAlgorithm.scala:72 delegates to that substrate). Bucket
-  tables are repartitioned host-side by slab owner
-  (``ring_partition_bucket``) so each rotation computes only against
-  the entries the passing slab can serve — total gather/Gramian work
-  stays at parity with gather mode (up to sub-table padding slop), and
-  the real price is S collective hops of the slabs per half-step
-  instead of one fused all_gather.
+  scala/ALSAlgorithm.scala:72 delegates to that substrate).
 
-``sharded_als_train`` picks the variant per run: ``gather`` while the
-gathered side fits ``ALSParams.sharded_gather_budget_bytes``, ``ring``
-past it (``mode=`` overrides). Per-bucket ``[B, K, D]`` factor-gather
-temps are bounded by ``ALSParams.gather_chunk_bytes`` in BOTH variants
-(the ring gathers from its resident slab through the same chunked
-helper). Both variants are exact on segmented hot rows and share the
-single-chip bucket math (ops/als.py `_bucket_weights` /
-`_finish_bucket_solve`).
+Both variants share the single-chip bucket math (ops/als.py
+``_bucket_weights`` / ``_gramian_rhs_gathered`` /
+``_finish_bucket_solve``), are exact on segmented hot rows, and thread
+int8 ``(values, scales)`` / bf16 storage pairs through every collective
+(quantized bytes on the wire). Per-row gather temps stay bounded by
+``ALSParams.gather_chunk_bytes`` in both.
+
+The legacy host-side layout (``shard_bucket`` / ``ring_partition_bucket``
+/ ``resegment_skewed_rows``) is kept below as the REFERENCE
+implementation: the property tests check the packed device layout
+preserves every (row, col, rating) triple against it, and the skew
+analysis in its docstrings documents why the packed layout replaced it.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import heapq
 import logging
@@ -84,14 +81,754 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops import als as als_ops
-from predictionio_tpu.parallel.compat import pcast_varying, shard_map
+from predictionio_tpu.parallel.compat import shard_map
+from predictionio_tpu.parallel.mesh import factor_sharding, replicated_sharding
 
 logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
-# Host-side: shard-aware bucket layout
+# Host-side: packed per-shard layout (the device-side owner layout)
 # ---------------------------------------------------------------------------
+
+
+@dataclass
+class SideLayout:
+    """Degree-balanced placement of ONE side's factor rows on the mesh.
+
+    Factor tables are stored PERMUTED: row ``u`` lives at table position
+    ``assign[u] * rows_per_shard + loc[u]``, with shards assigned
+    serpentine over descending degree. This is the uniform-shard layout
+    that makes ring mode work under popularity skew: slab ownership
+    becomes ``assign[col]`` (balanced entry load per owner — a pareto
+    catalog no longer concentrates every hot column on slab 0 the way
+    contiguous ``col // slab_rows`` ownership does), and the solved
+    side's table position doubles as its accumulator/scatter slot.
+    ``rows_per_shard`` includes one guaranteed-free trailing slot per
+    shard — the scatter target for padding rows. The final factors are
+    un-permuted once per training run (``positions`` gather).
+    """
+
+    assign: np.ndarray  # [N] shard of each factor row
+    loc: np.ndarray  # [N] shard-local table slot
+    rows_per_shard: int  # R: max rows on any shard, +1 dummy slot
+    shards: int
+
+    @property
+    def positions(self) -> np.ndarray:
+        """[N] global (permuted) table position of every factor row."""
+        return self.assign * self.rows_per_shard + self.loc
+
+    @property
+    def table_len(self) -> int:
+        return self.shards * self.rows_per_shard
+
+    def dummy_position(self, shard: int) -> int:
+        """The guaranteed-free last slot of ``shard``."""
+        return shard * self.rows_per_shard + self.rows_per_shard - 1
+
+
+def build_side_layout(ids: np.ndarray, num_rows: int, shards: int) -> SideLayout:
+    """Lay one side's ``num_rows`` factor rows out over ``shards``.
+
+    ``ids`` are that side's COO ids (degree = occurrence count; rows
+    absent from the data get degree 0 and fill the tail slots). The
+    serpentine over descending degree balances per-shard entry load to
+    within one row's degree; within a shard, slots follow ascending row
+    id — a stable layout independent of degree ties.
+    """
+    deg = np.bincount(np.asarray(ids, dtype=np.int64), minlength=num_rows)
+    order = np.argsort(-deg, kind="stable")
+    pos = np.arange(num_rows)
+    blk, off = divmod(pos, shards)
+    assign = np.empty(num_rows, np.int64)
+    assign[order] = np.where(blk % 2 == 0, off, shards - 1 - off)
+    loc = np.empty(num_rows, np.int64)
+    max_count = 0
+    for s in range(shards):
+        js = np.nonzero(assign == s)[0]  # ascending row id
+        loc[js] = np.arange(len(js))
+        max_count = max(max_count, len(js))
+    return SideLayout(
+        assign=assign, loc=loc, rows_per_shard=max_count + 1, shards=shards
+    )
+
+
+@dataclass
+class PackedSide:
+    """One side's ratings packed for the fused half-step.
+
+    ``ratings``/``mask`` are ``[S, B, K]`` (shard ``s`` owns ``[s]``)
+    in BOTH modes — the packed solve is mode-independent. ``col_ids``
+    differs: ``mode="gather"`` stores PERMUTED-GLOBAL table positions
+    of the opposite layout ``[S, B, K]`` (one lookup against the
+    all_gathered table); ``mode="ring"`` stores a routing table
+    ``[S, T, E]`` with ``T = S`` rotation steps — step ``[s, t]`` lists
+    the slab-local col ids of exactly the entries whose opposite factor
+    row is owned by shard ``(s - t) mod S`` under the opposite
+    :class:`SideLayout`, and ``seg[:, :, 1:]`` carries the inverse
+    gather map (working-set slot ``(b, k)`` -> flat ``[T * E]`` scan
+    output position; padding -> the appended zero row), so the scan can
+    assemble the same ``[B, K, D]`` working set gather's lookup
+    produces, one passing slab at a time, with a single final gather.
+
+    ``seg`` (``[S, B]`` gather; ``[S, B, 1 + K]`` ring, slot ``0``)
+    maps each packed row to its shard-local solved slot in
+    ``[0, rows_per_shard)`` — the solved side's own table ``loc`` — and
+    all packed rows of one solved row live on its ``assign`` shard
+    (exact hot rows). ``row_ids`` is ``[S * rows_per_shard]`` permuted
+    table positions for the global scatter of solutions (each shard's
+    dummy slot absorbs the zero solutions of never-solved slots).
+    ``packed_rows`` counts REAL packed rows before the per-shard /
+    per-slot max padding — the sizing guard compares ``col_ids.size``
+    against it to detect residual owner-skew blowup.
+    """
+
+    row_ids: np.ndarray
+    col_ids: np.ndarray
+    ratings: np.ndarray
+    mask: np.ndarray
+    seg: np.ndarray
+    mode: str
+    shards: int
+    rows_per_shard: int
+    pack_width: int
+    packed_rows: int
+
+
+def _group_positions(g: np.ndarray) -> np.ndarray:
+    """Per-element rank within its group (stable order)."""
+    order = np.argsort(g, kind="stable")
+    gs = g[order]
+    if len(gs) == 0:
+        return np.zeros(0, np.int64)
+    starts = np.concatenate([[0], np.nonzero(np.diff(gs))[0] + 1])
+    cnts = np.diff(np.concatenate([starts, [len(gs)]]))
+    r = np.arange(len(gs)) - np.repeat(starts, cnts)
+    pos = np.empty(len(g), np.int64)
+    pos[order] = r
+    return pos
+
+
+def pack_sharded_side(
+    t_ids: np.ndarray,
+    o_ids: np.ndarray,
+    vals: np.ndarray,
+    t_layout: SideLayout,
+    o_layout: SideLayout,
+    shards: int,
+    mode: str,
+) -> PackedSide:
+    """Build one side's :class:`PackedSide` from raw COO entries.
+
+    ``t_ids`` are this side's (solved) row ids under ``t_layout``,
+    ``o_ids`` the opposite side's column ids under ``o_layout``. Rows
+    absent from ``t_ids`` are never solved and keep their init factors —
+    same as single-chip. Entries keep their input order within each
+    packed group (stable packing), which keeps the accumulation order —
+    and thus the float32 trajectory — aligned with single-chip
+    ``als_train``.
+    """
+    t_ids = np.asarray(t_ids, dtype=np.int64)
+    o_ids = np.asarray(o_ids, dtype=np.int64)
+    vals = np.asarray(vals, dtype=np.float32)
+    uniq, inv, counts = np.unique(t_ids, return_inverse=True, return_counts=True)
+    n_uniq = max(1, len(uniq))
+    R = t_layout.rows_per_shard
+
+    # scatter map: solved slots -> their table position; everything else
+    # (never-solved slots, the trailing dummy) -> the shard's dummy slot
+    row_ids = np.empty((shards, R), np.int32)
+    for s in range(shards):
+        row_ids[s, :] = t_layout.dummy_position(s)
+    if len(uniq):
+        row_ids[t_layout.assign[uniq], t_layout.loc[uniq]] = t_layout.positions[
+            uniq
+        ].astype(np.int32)
+
+    assign_e = t_layout.assign[t_ids]
+    loc_u = t_layout.loc[uniq] if len(uniq) else np.zeros(0, np.int64)
+    base_key = assign_e * n_uniq + inv
+
+    if mode == "gather":
+        K = als_ops.choose_pack_width(counts)
+        e_row, e_slot, row_key, n_rows = als_ops.pack_entries(base_key, K)
+        row_shard = row_key // n_uniq
+        row_loc = loc_u[row_key % n_uniq] if len(uniq) else row_key
+        B = (
+            max(1, int(np.bincount(row_shard, minlength=shards).max()))
+            if n_rows
+            else 1
+        )
+        row_pos = _group_positions(row_shard)
+        col_ids = np.zeros((shards, B, K), np.int32)
+        ratings = np.zeros((shards, B, K), np.float32)
+        mask = np.zeros((shards, B, K), np.float32)
+        seg = np.zeros((shards, B), np.int32)
+        if n_rows:
+            seg[row_shard, row_pos] = row_loc
+            rs, rp = row_shard[e_row], row_pos[e_row]
+            col_ids[rs, rp, e_slot] = o_layout.positions[o_ids]
+            ratings[rs, rp, e_slot] = vals
+            mask[rs, rp, e_slot] = 1.0
+    elif mode == "ring":
+        # SAME value tables as gather ([S, B, K] ratings/mask, one seg
+        # per packed row) plus a per-rotation ROUTING table: the scan
+        # assembles the gather variant's [B, K, D] working set
+        # incrementally, each step writing the slab rows its entries
+        # need into their exact (packed row, slot) positions. Both
+        # variants then run the identical one-dot packed solve — ring's
+        # extra cost is only the S-1 small per-step gathers, not a
+        # second (owner-fragmented, padding-heavy) packing.
+        K = als_ops.choose_pack_width(counts)
+        e_row, e_slot, row_key, n_rows = als_ops.pack_entries(base_key, K)
+        row_shard = row_key // n_uniq
+        row_loc = loc_u[row_key % n_uniq] if len(uniq) else row_key
+        B = (
+            max(1, int(np.bincount(row_shard, minlength=shards).max()))
+            if n_rows
+            else 1
+        )
+        row_pos = _group_positions(row_shard)
+        ratings = np.zeros((shards, B, K), np.float32)
+        mask = np.zeros((shards, B, K), np.float32)
+        owner_e = o_layout.assign[o_ids]
+        rs_e = row_shard[e_row]
+        step_e = (rs_e - owner_e) % shards
+        cell = rs_e * shards + step_e
+        E = (
+            max(1, int(np.bincount(cell, minlength=shards * shards).max()))
+            if n_rows
+            else 1
+        )
+        e_pos = _group_positions(cell)
+        # routing: [S, T, E] slab-local col ids read per rotation step
+        # (padding rereads slab row 0 — discarded by the gather map).
+        # seg grows an INVERSE gather map: seg[:, :, 1:] holds, per
+        # working-set slot (b, k), the flat [T * E] position its row
+        # lands at in the scan's stacked outputs (padding slots -> the
+        # appended zero row T * E). Assembly is then a pure gather —
+        # XLA:CPU lowers row scatters serially, ~10x slower than the
+        # equivalent gather, and real-slot order is already known here.
+        col_ids = np.zeros((shards, shards, E), np.int32)
+        seg = np.full((shards, B, 1 + K), shards * E, np.int32)
+        seg[:, :, 0] = 0
+        if n_rows:
+            seg[row_shard, row_pos, 0] = row_loc
+            ratings[rs_e, row_pos[e_row], e_slot] = vals
+            mask[rs_e, row_pos[e_row], e_slot] = 1.0
+            col_ids[rs_e, step_e, e_pos] = o_layout.loc[o_ids]
+            seg[rs_e, row_pos[e_row], 1 + e_slot] = step_e * E + e_pos
+    else:
+        raise ValueError(f"mode must be gather|ring, got {mode!r}")
+
+    return PackedSide(
+        row_ids=row_ids.reshape(-1),
+        col_ids=col_ids,
+        ratings=ratings,
+        mask=mask,
+        seg=seg,
+        mode=mode,
+        shards=shards,
+        rows_per_shard=R,
+        pack_width=K,
+        packed_rows=n_rows,
+    )
+
+
+def upload_packed_side(ps: PackedSide, mesh: Mesh, axis: str) -> tuple:
+    """Place one packed side on the mesh: tables sharded ``P(axis)`` on
+    the shard-major dim, scatter row-ids replicated."""
+    table = factor_sharding(mesh, axis)
+    repl = replicated_sharding(mesh)
+    return (
+        jax.device_put(ps.row_ids, repl),
+        jax.device_put(ps.col_ids, table),
+        jax.device_put(ps.ratings, table),
+        jax.device_put(ps.mask, table),
+        jax.device_put(ps.seg, table),
+    )
+
+
+def packed_table_bytes_per_chip(sides: Sequence[PackedSide], shards: int) -> int:
+    """Per-chip bytes of the packed tables (4-byte col-id/routing,
+    rating, mask, and seg/gather-map slots, including padding)."""
+    return (
+        sum(
+            4 * (ps.col_ids.size + ps.ratings.size + ps.mask.size + ps.seg.size)
+            for ps in sides
+        )
+        // max(1, shards)
+    )
+
+
+def _check_ring_layout(
+    row_ps: PackedSide, col_ps: PackedSide, params: als_ops.ALSParams, shards: int
+) -> None:
+    """Sizing guard for the ring layout's one blowup mode: owner skew.
+
+    The routing table pads every ``[s, t]`` rotation slot to the max
+    per-step entry count ``E``. The degree-balanced :class:`SideLayout`
+    keeps owner loads near-uniform, so residual skew is rare (it takes
+    correlated row/owner structure the serpentine cannot split —
+    e.g. every entry of every row pointing at ONE opposite row); when
+    it does happen most steps sit empty and the routing costs up to
+    ``S`` times the ideally-balanced packing. Past 2x AND past the
+    gather budget the run refuses with the knob named.
+    """
+    packed = packed_table_bytes_per_chip([row_ps, col_ps], shards)
+    # balanced cost: value+mask slots (8B) plus one 4B routing id and
+    # one 4B gather-map entry per slot, with zero rotation-step padding
+    ideal = (
+        sum(ps.packed_rows * ps.pack_width * 16 for ps in (row_ps, col_ps))
+        // max(1, shards)
+    )
+    budget = params.sharded_gather_budget_bytes
+    if packed > 2 * max(1, ideal):
+        if packed > budget:
+            raise ValueError(
+                f"ring-mode packed tables need {packed} bytes/chip under "
+                f"owner skew (balanced packing: {ideal}), over "
+                f"sharded_gather_budget_bytes={budget}; raise the budget, "
+                "add chips, or use mode='gather'"
+            )
+        if packed > (1 << 20):
+            # tiny problems are always padding-dominated; only flag skew
+            # once the tables are big enough for the blowup to matter
+            logger.warning(
+                "ring-mode packed tables blow up under owner skew: %d "
+                "bytes/chip vs %d ideally balanced (within budget %d; "
+                "proceeding)",
+                packed,
+                ideal,
+                budget,
+            )
+
+
+# ---------------------------------------------------------------------------
+# Device-side: fused training program
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ShardedALSState:
+    """Factors resident on the mesh in :class:`SideLayout` (permuted)
+    order, one guaranteed-free dummy slot per shard."""
+
+    mesh: Mesh
+    axis: str
+    U: jax.Array  # [S * row rows_per_shard, D] sharded P(axis)
+    V: jax.Array  # [S * col rows_per_shard, D] sharded P(axis)
+    num_rows: int
+    num_cols: int
+
+
+def _padded_len(n: int, shards: int) -> int:
+    return n + 1 + ((-(n + 1)) % shards)  # +1 dummy row, then round up
+
+
+def init_sharded_factors(
+    data: als_ops.RatingsData,
+    params: als_ops.ALSParams,
+    mesh: Mesh,
+    axis: str = "data",
+    row_layout: SideLayout | None = None,
+    col_layout: SideLayout | None = None,
+) -> ShardedALSState:
+    shards = mesh.shape[axis]
+    if row_layout is None:
+        row_layout = build_side_layout(data.rows, data.num_rows, shards)
+    if col_layout is None:
+        col_layout = build_side_layout(data.cols, data.num_cols, shards)
+    key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
+    # draw the TRUE-size init (identical to single-chip als_train for the
+    # same seed — the parity tests rely on trajectory equality), then
+    # place each row at its layout position; unfilled slots (per-shard
+    # dummies) stay zero and contribute nothing to the psum'd Gramian
+    U = np.zeros((row_layout.table_len, params.rank), np.float32)
+    V = np.zeros((col_layout.table_len, params.rank), np.float32)
+    U[row_layout.positions] = np.asarray(
+        als_ops.init_factors(data.num_rows, params.rank, key_u)
+    )
+    V[col_layout.positions] = np.asarray(
+        als_ops.init_factors(data.num_cols, params.rank, key_v)
+    )
+    sharding = factor_sharding(mesh, axis)
+    # factors persist (and all_gather/ppermute) in storage_dtype: bf16
+    # halves the per-half-iteration ICI traffic and the gathered working
+    # set while solves still accumulate float32 (ops/als.py
+    # ALSParams.storage_dtype)
+    U_dev = jax.device_put(U, sharding)
+    V_dev = jax.device_put(V, sharding)
+    if params.storage_dtype == "int8":
+        # per-row quantization reduces over the (unsharded) rank dim
+        # only, so the row sharding of both values and scales is
+        # preserved; the all_gather/ppermute'd working set becomes the
+        # (int8 values, f32 scales) pair — ~4x fewer ICI bytes than f32
+        U_dev = als_ops.quantize_rows(U_dev)
+        V_dev = als_ops.quantize_rows(V_dev)
+    elif params.storage_dtype != "float32":
+        sd = jnp.dtype(params.storage_dtype)
+        U_dev = U_dev.astype(sd)  # elementwise: sharding preserved
+        V_dev = V_dev.astype(sd)
+    return ShardedALSState(
+        mesh=mesh,
+        axis=axis,
+        U=U_dev,
+        V=V_dev,
+        num_rows=data.num_rows,
+        num_cols=data.num_cols,
+    )
+
+
+def _gather_table_rows(table, positions: np.ndarray, sharding):
+    """Un-permute a trained factor table: gather ``positions`` (original
+    row order) out of the layout-ordered table, keeping the storage
+    representation (int8 ``(values, scales)`` gathers both leaves).
+    The gather runs at full table length (dummy-padded tail) so the
+    result can be re-placed under the row ``sharding``, then is trimmed
+    to the true row count."""
+    n = len(positions)
+    table_len = als_ops.table_rows(table)
+    pos_full = np.full(table_len, table_len - 1, np.int32)
+    pos_full[:n] = positions
+    pos = jnp.asarray(pos_full)
+    gathered = jax.tree_util.tree_map(
+        lambda t: jax.device_put(t[pos], sharding), table
+    )
+    return als_ops.slice_rows(gathered, n)
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_trainer(mesh: Mesh, axis: str, mode: str, params: als_ops.ALSParams):
+    """Build (and cache) the jitted trainer for one (mesh, mode, params).
+
+    The returned function runs the WHOLE training run as one XLA
+    program: ``jax.jit`` with explicit ``in_shardings``/``out_shardings``
+    (uniform ``NamedSharding(mesh, P(axis))`` on every factor/table
+    leaf; a pytree-prefix covers the int8 ``(values, scales)`` pairs)
+    and donated factor buffers, a ``lax.fori_loop`` over a DYNAMIC
+    iteration count (one compile serves any count — the lru_cache key
+    is the iteration-normalized params), and ONE ``shard_map`` region
+    per half-step:
+
+    - ``mode="gather"``: all_gather the opposite factors (tiled — one
+      fused ICI collective), then a single packed-table solve via the
+      single-chip bucket math.
+    - ``mode="ring"``: a single ``lax.scan`` over ``ppermute`` slab
+      rotations. Step ``t`` consumes rotation slot ``t`` of the routing
+      table — whose slab-local column ids index the passing slab
+      directly — and stacks the reads as scan outputs; one final gather
+      off the host-precomputed inverse map lands them in gather's exact
+      ``[B, K, D]`` working-set order, and the identical packed solve
+      runs. The final slot is peeled out of the scan so a half-step
+      costs S-1 hops, and only the slab rides the carry (donated, no
+      per-hop re-materialization).
+    """
+    shards = mesh.shape[axis]
+    factor = factor_sharding(mesh, axis)
+    repl = replicated_sharding(mesh)
+    dt = jnp.dtype(params.compute_dtype)
+    perm = [(i, (i + 1) % shards) for i in range(shards)]
+
+    def opposite_gram(other_shard):
+        if not params.implicit:
+            return None
+        return jax.lax.psum(
+            als_ops.compute_gram(other_shard, params.compute_dtype), axis
+        )
+
+    def gather_fn(R, other_shard, col_ids, ratings, mask, seg):
+        # int8 storage: other_shard is the (values, scales) pair; gather
+        # both leaves so the ICI collective moves quantized bytes
+        other_full = jax.tree_util.tree_map(
+            lambda t: jax.lax.all_gather(t, axis, tiled=True), other_shard
+        )
+        return als_ops._solve_bucket_inline(
+            other_full,
+            opposite_gram(other_shard),
+            (col_ids[0], ratings[0], mask[0]),
+            params,
+            seg_row=seg[0],
+            num_solved_rows=R,
+        )
+
+    def ring_fn(R, other_shard, col_ids, ratings, mask, seg):
+        # col_ids is the ROUTING table [T, E] of slab-local col ids to
+        # read per rotation step; ratings/mask are the exact
+        # gather-shaped [B, K] tables and seg is [B, 1 + K] (solved
+        # slot, then the inverse gather map). The scan ASSEMBLES the
+        # gather variant's [B, K, D] working set: step t reads the rows
+        # this shard's entries need from the slab passing by (their
+        # owner's rotation); the stacked reads are then permuted into
+        # (row, slot) order by ONE gather off the inverse map — padding
+        # slots pull the appended zero row. After S-1 hops the working
+        # set is bit-identical to what gather's all_gather + table
+        # lookup produces, and the SAME packed solve runs — one dot,
+        # one segment scatter, one batched Cholesky.
+        lcol, rt, mt = col_ids[0], ratings[0], mask[0]
+        sg, ginv = seg[0][:, 0], seg[0][:, 1:]
+        D = als_ops.table_dim(other_shard)
+        T, E = lcol.shape
+        gram = opposite_gram(other_shard)
+
+        def assemble(slab, lc):
+            rows = als_ops._read_rows(slab, lc, dt)
+            # int8 slabs rotate as (values, scales) — quantized ICI hops
+            slab = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, axis, perm), slab
+            )
+            return slab, rows
+
+        # S-1 rotate-and-read steps in ONE scan, final slot peeled (the
+        # last rotation's hop would be unused): S-1 hops per half-step,
+        # all inside this program. Only the slab rides the carry; the
+        # per-step reads stack as scan outputs.
+        slab, rows_t = jax.lax.scan(assemble, other_shard, lcol[:-1])
+        rows_all = jnp.concatenate(
+            [rows_t, als_ops._read_rows(slab, lcol[-1], dt)[None]], axis=0
+        )
+        flat = jnp.concatenate(
+            [rows_all.reshape(T * E, D), jnp.zeros((1, D), dt)], axis=0
+        )
+        vg = flat[ginv]
+        w, rr = als_ops._bucket_weights(rt, mt, params, params.alpha)
+        A, b = als_ops._gramian_rhs(vg, w, rr)
+        return als_ops._finish_bucket_solve(
+            A, b, mt.sum(axis=1), gram, params, sg, R, params.reg
+        )
+
+    shard_fn = {"gather": gather_fn, "ring": ring_fn}[mode]
+
+    def half(target, other, pack):
+        row_ids, col_ids, ratings, mask, seg = pack
+        R = row_ids.shape[0] // shards
+        # int8 factor tables are (values, scales) pairs: spell out the
+        # matching spec structure (both leaves row-sharded over axis)
+        other_spec = (P(axis), P(axis)) if isinstance(other, tuple) else P(axis)
+        x = shard_map(
+            functools.partial(shard_fn, R),
+            mesh=mesh,
+            in_specs=(other_spec, P(axis), P(axis), P(axis), P(axis)),
+            out_specs=P(axis),
+        )(other, col_ids, ratings, mask, seg)
+        # solves come back float32 [S*R, D]; factors persist in
+        # storage_dtype (int8 requantizes here, fresh per-row scales)
+        target = als_ops._scatter_rows(target, row_ids, x)
+        return jax.tree_util.tree_map(
+            lambda t: jax.lax.with_sharding_constraint(t, factor), target
+        )
+
+    def train(U, V, row_pack, col_pack, iterations):
+        def step(_, carry):
+            U, V = carry
+            U = half(U, V, row_pack)
+            V = half(V, U, col_pack)
+            return (U, V)
+
+        return jax.lax.fori_loop(0, iterations, step, (U, V))
+
+    pack_s = (repl, factor, factor, factor, factor)
+    return jax.jit(
+        train,
+        donate_argnums=(0, 1),
+        in_shardings=(factor, factor, pack_s, pack_s, repl),
+        out_shardings=(factor, factor),
+    )
+
+
+def choose_sharded_mode(
+    data: als_ops.RatingsData, params: als_ops.ALSParams, shards: int
+) -> str:
+    """Pick the half-step variant for a run: ``gather`` while the larger
+    gathered side fits ``params.sharded_gather_budget_bytes`` per chip,
+    ``ring`` past it (module docstring, "Two half-step variants")."""
+    rows = max(
+        _padded_len(data.num_rows, shards), _padded_len(data.num_cols, shards)
+    )
+    gathered = rows * _factor_row_bytes(params)
+    return "ring" if gathered > params.sharded_gather_budget_bytes else "gather"
+
+
+def _factor_row_bytes(params: als_ops.ALSParams) -> int:
+    """Bytes one gathered factor row costs in storage form (int8 rows
+    carry their f32 per-row scale alongside the quantized values)."""
+    if params.storage_dtype == "int8":
+        return params.rank + 4
+    return params.rank * jnp.dtype(params.storage_dtype).itemsize
+
+
+def halfstep_collective_bytes(
+    num_rows: int, num_cols: int, shards: int, params: als_ops.ALSParams, mode: str
+) -> dict:
+    """Per-chip ICI traffic of ONE half-step (the larger, opposite-side
+    gather; both halves of an iteration together move both sides).
+
+    ``gather``: one fused all_gather — each chip receives the other
+    S-1 slabs of the opposite table in a single collective. ``ring``:
+    S-1 ``ppermute`` hops, each moving one opposite-factor slab. Total
+    bytes match; the ring trades the fused collective for S-1 smaller
+    hops (and never materializes the full table).
+    """
+    opp = max(_padded_len(num_rows, shards), _padded_len(num_cols, shards))
+    row_bytes = _factor_row_bytes(params)
+    slab_bytes = (opp // shards) * row_bytes
+    hops = 1 if mode == "gather" else max(1, shards - 1)
+    per_hop = slab_bytes * (shards - 1) if mode == "gather" else slab_bytes
+    return {
+        "mode": mode,
+        "hops_per_halfstep": hops,
+        "bytes_per_hop": int(per_hop),
+        "total_bytes_per_halfstep": int(per_hop * hops),
+    }
+
+
+def sharded_memory_estimate(
+    num_rows: int,
+    num_cols: int,
+    nnz: int,
+    shards: int,
+    params: als_ops.ALSParams,
+    mode: str,
+) -> dict:
+    """Analytic peak-HBM estimate per chip for one training run (bytes).
+
+    Counts the resident terms of the memory model: both factor shards,
+    the packed tables (12 bytes/entry/side gather, 16 ring — routing
+    ids and the inverse gather map ride along; padding ignored), and
+    the mode's working set.
+    ``gather`` holds the full gathered opposite table — it does NOT
+    shrink with mesh size. ``ring`` holds one rotating slab plus the
+    shard's assembled f32 ``[B, K, D]`` working set (~``nnz/S`` slots),
+    both of which DO shrink with mesh size — that 1/S scaling, not the
+    absolute size, is what lets ring outlive the gather budget.
+    """
+    row_bytes = _factor_row_bytes(params)
+    u_len = _padded_len(num_rows, shards)
+    v_len = _padded_len(num_cols, shards)
+    D = params.rank
+    factors = (u_len + v_len) * row_bytes // shards
+    tables = 2 * nnz * (12 if mode == "gather" else 16) // shards
+    opp = max(u_len, v_len)
+    if mode == "gather":
+        working = opp * row_bytes
+    else:
+        working = (opp // shards) * row_bytes + (nnz // shards) * D * 4
+    return {
+        "mode": mode,
+        "factors_bytes": int(factors),
+        "tables_bytes": int(tables),
+        "working_set_bytes": int(working),
+        "peak_bytes": int(factors + tables + working),
+    }
+
+
+def sharded_als_train(
+    data: als_ops.RatingsData,
+    params: als_ops.ALSParams,
+    mesh: Mesh,
+    axis: str = "data",
+    mode: str = "auto",
+) -> tuple[jax.Array, jax.Array]:
+    """Full multi-chip ALS with mesh-resident factors.
+
+    Exact on arbitrarily hot rows: packed segments of one solved row are
+    colocated per shard and scatter-added before the solve, so results
+    match single-chip ``als_train`` for the same seed. ``mode`` is
+    ``"gather"``, ``"ring"``, or ``"auto"`` (default: pick by the
+    per-chip budget — ``choose_sharded_mode``). Returns (U, V) trimmed
+    to the true row counts (still sharded device arrays)."""
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"mesh has axes {tuple(mesh.axis_names)} but the sharded ALS "
+            f"trainer shards over {axis!r}; name one mesh axis {axis!r} "
+            f"(e.g. --mesh {axis}=N) or pass axis="
+        )
+    shards = mesh.shape[axis]
+    if mode == "auto":
+        mode = choose_sharded_mode(data, params, shards)
+    elif mode not in ("gather", "ring"):
+        raise ValueError(f"mode must be auto|gather|ring, got {mode!r}")
+    row_layout = build_side_layout(data.rows, data.num_rows, shards)
+    col_layout = build_side_layout(data.cols, data.num_cols, shards)
+    state = init_sharded_factors(data, params, mesh, axis, row_layout, col_layout)
+    row_ps = pack_sharded_side(
+        data.rows, data.cols, data.vals, row_layout, col_layout, shards, mode
+    )
+    col_ps = pack_sharded_side(
+        data.cols, data.rows, data.vals, col_layout, row_layout, shards, mode
+    )
+    if mode == "ring":
+        _check_ring_layout(row_ps, col_ps, params, shards)
+    row_pack = upload_packed_side(row_ps, mesh, axis)
+    col_pack = upload_packed_side(col_ps, mesh, axis)
+    # iterations rides as a dynamic loop bound (shared compile across
+    # iteration counts, like the single-chip _train_fused)
+    static_params = dataclasses.replace(params, iterations=0)
+    trainer = _fused_trainer(mesh, axis, mode, static_params)
+    U, V = trainer(state.U, state.V, row_pack, col_pack, params.iterations)
+    # tables are in SideLayout (degree-balanced) order: un-permute ONCE
+    # per training run back to original row order
+    factor = factor_sharding(mesh, axis)
+    return (
+        _gather_table_rows(U, row_layout.positions, factor),
+        _gather_table_rows(V, col_layout.positions, factor),
+    )
+
+
+def train_for_context(
+    data: als_ops.RatingsData,
+    params: als_ops.ALSParams,
+    ctx=None,
+    sharded: bool = False,
+    mode: str = "auto",
+) -> tuple[jax.Array, jax.Array]:
+    """Framework dispatch point: the engine-param ``shardedTrain`` knob.
+
+    Templates call this from ``Algorithm.train``; with ``sharded`` the
+    run executes on the WorkflowContext's device mesh (the production
+    multi-chip path — the TPU replacement for MLlib ALS's Spark-cluster
+    execution, reference examples/scala-parallel-recommendation/
+    custom-prepartor/src/main/scala/ALSAlgorithm.scala:72), otherwise on
+    the single default device. ``mode`` forwards to
+    :func:`sharded_als_train` (the engine-param ``shardedMode`` knob:
+    auto|gather|ring).
+    """
+    if not sharded or ctx is None:
+        return als_ops.als_train(data, params)
+    mesh = ctx.mesh
+    # shard over "data" when present; a 1-D mesh shards over its only axis
+    if "data" in mesh.shape:
+        axis = "data"
+    elif len(mesh.axis_names) == 1:
+        axis = mesh.axis_names[0]
+    else:
+        raise ValueError(
+            f"shardedTrain needs a 'data' axis on the mesh; got axes "
+            f"{tuple(mesh.axis_names)}"
+        )
+    U, V = sharded_als_train(data, params, mesh, axis, mode=mode)
+    if jax.process_count() > 1:
+        # multi-host: shards live on other hosts' devices; templates
+        # np.asarray the factors for persistence, so gather them to
+        # host-replicated arrays (every host gets the full model)
+        from jax.experimental import multihost_utils
+
+        U = multihost_utils.process_allgather(U, tiled=True)
+        V = multihost_utils.process_allgather(V, tiled=True)
+    return U, V
+
+
+# ---------------------------------------------------------------------------
+# Legacy host-side layout (reference implementation)
+# ---------------------------------------------------------------------------
+#
+# The pre-fusion layout: one sub-table per degree bucket, repartitioned
+# host-side by slab owner for ring mode. Kept as the REFERENCE the
+# property tests check the packed device layout against (every
+# (row, col, rating) triple must survive both layouts identically), and
+# because its docstrings document the owner-skew failure mode the packed
+# layout absorbs. Not used by the training path.
 
 
 @dataclass
@@ -185,25 +922,6 @@ def shard_bucket(
     )
 
 
-def upload_sharded_buckets(
-    sharded: Sequence[ShardedBucket], mesh: Mesh, axis: str
-) -> tuple:
-    """Place the layout on the mesh once per training run: tables sharded
-    ``P(axis)``, scatter row-ids replicated."""
-    table = NamedSharding(mesh, P(axis))
-    repl = NamedSharding(mesh, P())
-    return tuple(
-        (
-            jax.device_put(sb.row_ids, repl),
-            jax.device_put(sb.col_ids, table),
-            jax.device_put(sb.ratings, table),
-            jax.device_put(sb.mask, table),
-            jax.device_put(sb.seg_row, table),
-        )
-        for sb in sharded
-    )
-
-
 def resegment_skewed_rows(
     sb: ShardedBucket, opp_rows_loc: int, shards: int
 ) -> ShardedBucket:
@@ -218,7 +936,9 @@ def resegment_skewed_rows(
     owner — more segments of the same solved row, scatter-added by
     ``seg_row`` exactly like hot-row segments — caps ``K_sub`` at the
     spread-case value, so only the skewed rows grow (by their segment
-    count) instead of every row paying the padding.
+    count) instead of every row paying the padding. (The packed layout
+    makes this moot: ``pack_entries`` pads per (row, owner) GROUP, so a
+    skewed row only grows its own cell.)
     """
     S, B, K = sb.shards, sb.table_rows_per_shard, sb.col_ids.shape[1]
     T = max(1, -(-K // shards))
@@ -290,17 +1010,12 @@ def ring_partition_bucket(
     row ``b`` whose opposite factor row lives on shard ``s`` (owner =
     ``col_id // opp_rows_loc``; factors are row-contiguous over shards).
 
-    This is what keeps ring-mode COMPUTE at parity with gather mode: each
-    rotation consumes only its ``[B, K_sub]`` sub-table (``K_sub`` = max
-    entries any (row, owner) pair holds) instead of re-gathering the full
-    ``[B, K]`` table with (S-1)/S of the weights zeroed. Total ring work
-    is ``S * B * K_sub * D^2`` vs gather's ``B * K * D^2`` — parity up to
-    padding slop when entries spread across owners (random id layouts;
-    the common case), degrading only for adversarial skew where one
-    (row, owner) pair holds most of a row's entries. Table memory is
-    ``S * K_sub / K`` times the flat layout — near parity in the common
-    spread case (``K_sub ~= K/S``), but up to S times under the same
-    adversarial skew (``K_sub -> K``); size ring-mode runs accordingly.
+    Reference semantics for the packed ring layout (the property tests
+    compare the two): the per-(row, owner) triples must be identical.
+    Its weakness — and why the packed layout replaced it — is the shared
+    ``K_sub``: one row with ~K entries on one owner drives ``K_sub -> K``
+    and the WHOLE bucket to S x the flat bytes, whereas ``pack_entries``
+    pads per group.
     """
     SB, K = sb.col_ids.shape
     m_flat = sb.mask.reshape(-1) > 0
@@ -336,437 +1051,28 @@ def ring_partition_bucket(
     )
 
 
-# ---------------------------------------------------------------------------
-# Device-side: fused training program
-# ---------------------------------------------------------------------------
-
-
-@dataclass
-class ShardedALSState:
-    """Factors resident on the mesh, each with one trailing dummy row."""
-
-    mesh: Mesh
-    axis: str
-    U: jax.Array  # [num_rows+pad, D] sharded P(axis)
-    V: jax.Array  # [num_cols+pad, D] sharded P(axis)
-    num_rows: int
-    num_cols: int
-
-
-def _padded_len(n: int, shards: int) -> int:
-    return n + 1 + ((-(n + 1)) % shards)  # +1 dummy row, then round up
-
-
-def init_sharded_factors(
-    data: als_ops.RatingsData,
-    params: als_ops.ALSParams,
-    mesh: Mesh,
-    axis: str = "data",
-) -> ShardedALSState:
-    shards = mesh.shape[axis]
-    key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
-    u_len = _padded_len(data.num_rows, shards)
-    v_len = _padded_len(data.num_cols, shards)
-    # draw the TRUE-size init (identical to single-chip als_train for the
-    # same seed — the parity tests rely on trajectory equality), then pad
-    # with zeros; pad rows contribute nothing to the psum'd Gramian
-    U = np.zeros((u_len, params.rank), np.float32)
-    V = np.zeros((v_len, params.rank), np.float32)
-    U[: data.num_rows] = np.asarray(
-        als_ops.init_factors(data.num_rows, params.rank, key_u)
+def upload_sharded_buckets(
+    sharded: Sequence[ShardedBucket], mesh: Mesh, axis: str
+) -> tuple:
+    """Place the legacy layout on the mesh: tables sharded ``P(axis)``,
+    scatter row-ids replicated."""
+    table = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return tuple(
+        (
+            jax.device_put(sb.row_ids, repl),
+            jax.device_put(sb.col_ids, table),
+            jax.device_put(sb.ratings, table),
+            jax.device_put(sb.mask, table),
+            jax.device_put(sb.seg_row, table),
+        )
+        for sb in sharded
     )
-    V[: data.num_cols] = np.asarray(
-        als_ops.init_factors(data.num_cols, params.rank, key_v)
-    )
-    sharding = NamedSharding(mesh, P(axis))
-    # factors persist (and all_gather) in storage_dtype: bf16 halves the
-    # per-half-iteration ICI traffic and the gathered working set — the
-    # (c) term of the memory model above — while solves still accumulate
-    # float32 (ops/als.py ALSParams.storage_dtype)
-    U_dev = jax.device_put(U, sharding)
-    V_dev = jax.device_put(V, sharding)
-    if params.storage_dtype == "int8":
-        # per-row quantization reduces over the (unsharded) rank dim
-        # only, so the row sharding of both values and scales is
-        # preserved; the all_gather/ppermute'd working set becomes the
-        # (int8 values, f32 scales) pair — ~4x fewer ICI bytes than f32
-        U_dev = als_ops.quantize_rows(U_dev)
-        V_dev = als_ops.quantize_rows(V_dev)
-    elif params.storage_dtype != "float32":
-        sd = jnp.dtype(params.storage_dtype)
-        U_dev = U_dev.astype(sd)  # elementwise: sharding preserved
-        V_dev = V_dev.astype(sd)
-    return ShardedALSState(
-        mesh=mesh,
-        axis=axis,
-        U=U_dev,
-        V=V_dev,
-        num_rows=data.num_rows,
-        num_cols=data.num_cols,
-    )
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("params", "mesh", "axis", "mode"),
-    donate_argnums=(0, 1),
-)
-def _train_fused_sharded(
-    U,
-    V,
-    row_arrays,
-    col_arrays,
-    iterations,
-    params: als_ops.ALSParams,
-    mesh,
-    axis,
-    mode: str = "gather",
-):
-    """The whole sharded training run as ONE device program.
-
-    ``lax.fori_loop`` over iterations (dynamic trip count — one compile
-    serves any iteration count); each half-step is a single ``shard_map``
-    region solving every bucket, followed by global scatters of the
-    solutions into the sharded factor matrix. Two half-step variants
-    (module docstring, "Memory model"):
-
-    - ``mode="gather"``: one ``all_gather`` of the opposite factors; each
-      bucket solves against the full gathered matrix.
-    - ``mode="ring"``: the opposite factors never materialize whole on
-      any chip. A ``fori_loop`` over the mesh size rotates opposite
-      slabs with ``ppermute``; per rotation each bucket masks its
-      entries down to the ones owned by the passing slab and
-      accumulates their Gramian/rhs contribution into persistent
-      ``(A, b)`` normal equations, which are solved once the ring
-      completes. Entry ownership is index arithmetic: factors are
-      row-contiguous over shards, so global column id ``g`` lives on
-      shard ``g // rows_per_shard`` at offset ``g % rows_per_shard``.
-
-    The implicit-feedback Gramian is psum'd from shard-local factors in
-    both variants (it never needed the gather).
-    """
-    shards = mesh.shape[axis]
-    factor_spec = NamedSharding(mesh, P(axis))
-    dt = jnp.dtype(params.compute_dtype)
-
-    def gather_shard_fn(rows_per, other_shard, *flat):
-        # int8 storage: other_shard is the (values, scales) pair; gather
-        # both leaves so the ICI collective moves quantized bytes
-        other_full = jax.tree_util.tree_map(
-            lambda t: jax.lax.all_gather(t, axis, tiled=True), other_shard
-        )
-        gram = None
-        if params.implicit:
-            gram = jax.lax.psum(
-                als_ops.compute_gram(other_shard, params.compute_dtype), axis
-            )
-        outs = []
-        for bi in range(0, len(flat) // 4):
-            col_ids, ratings, mask, seg_row = flat[bi * 4 : bi * 4 + 4]
-            outs.append(
-                als_ops._solve_bucket_inline(
-                    other_full,
-                    gram,
-                    (col_ids, ratings, mask),
-                    params,
-                    seg_row=seg_row,
-                    num_solved_rows=rows_per[bi],
-                )
-            )
-        return tuple(outs)
-
-    def ring_shard_fn(rows_per, other_shard, *flat):
-        # tables arrive OWNER-PARTITIONED (`ring_partition_bucket`):
-        # [B_loc, S, K_sub], slot [:, s, :] holding the entries whose
-        # opposite factor row lives on shard s — each rotation slices out
-        # exactly the sub-table the passing slab can serve, keeping ring
-        # compute at parity with gather mode.
-        slab_rows = als_ops.table_rows(other_shard)
-        D = als_ops.table_dim(other_shard)
-        me = jax.lax.axis_index(axis)
-        gram = None
-        if params.implicit:
-            gram = jax.lax.psum(
-                als_ops.compute_gram(other_shard, params.compute_dtype), axis
-            )
-        nb = len(flat) // 4
-        # zero accumulators are constants; mark them device-varying so
-        # they sit in the fori_loop carry beside the ppermute'd slab
-        varying = lambda x: pcast_varying(x, axis)
-        buckets3 = [flat[bi * 4 : bi * 4 + 3] for bi in range(nb)]
-        accs = tuple(
-            (
-                varying(jnp.zeros((col_ids.shape[0], D, D), jnp.float32)),
-                varying(jnp.zeros((col_ids.shape[0], D), jnp.float32)),
-            )
-            for col_ids, _r, _m in buckets3
-        )
-        # send my slab to the next shard each step; after t rotations I
-        # hold the slab of shard (me - t) mod S
-        perm = [(i, (i + 1) % shards) for i in range(shards)]
-
-        def owner_slice(x, owner):
-            # [B, S, K_sub] -> the current owner's [B, K_sub] sub-table
-            return jax.lax.dynamic_slice_in_dim(x, owner, 1, axis=1)[:, 0]
-
-        def accumulate(owner, slab, accs):
-            new_accs = []
-            for (col_ids, ratings, mask), (A, b) in zip(buckets3, accs):
-                sub_ids = owner_slice(col_ids, owner)
-                # weights are computed on the sliced [B, K_sub] sub-table
-                # per rotation (elementwise, negligible) rather than
-                # precomputed whole — ring mode exists for HBM relief
-                w, r = als_ops._bucket_weights(
-                    owner_slice(ratings, owner),
-                    owner_slice(mask, owner),
-                    params,
-                    params.alpha,
-                )
-                # padding slots hold col_id 0 with zero weight; clip keeps
-                # their local index in range, the weight kills the term
-                lid = jnp.clip(sub_ids - owner * slab_rows, 0, slab_rows - 1)
-                A_c, b_c = als_ops._gramian_rhs_gathered(
-                    slab, lid, w, r, dt, params.gather_chunk_bytes
-                )
-                new_accs.append((A + A_c, b + b_c))
-            return tuple(new_accs)
-
-        def rotate(t, carry):
-            slab, accs = carry
-            accs = accumulate(jnp.mod(me - t, shards), slab, accs)
-            # int8 slabs rotate as (values, scales) — quantized ICI hops
-            slab = jax.tree_util.tree_map(
-                lambda x: jax.lax.ppermute(x, axis, perm), slab
-            )
-            return slab, accs
-
-        # S-1 rotate-and-accumulate steps, then the final slab's
-        # accumulation peeled out of the loop: S-1 collective hops per
-        # half-step, not S (the last rotation's result would be unused)
-        slab, accs = jax.lax.fori_loop(
-            0, shards - 1, rotate, (other_shard, accs)
-        )
-        accs = accumulate(jnp.mod(me - (shards - 1), shards), slab, accs)
-        outs = []
-        for bi, (A, b) in enumerate(accs):
-            mask, seg_row = flat[bi * 4 + 2], flat[bi * 4 + 3]
-            outs.append(
-                als_ops._finish_bucket_solve(
-                    A,
-                    b,
-                    mask.sum(axis=(1, 2)),
-                    gram,
-                    params,
-                    seg_row,
-                    rows_per[bi],
-                    params.reg,
-                )
-            )
-        return tuple(outs)
-
-    shard_fn = {"gather": gather_shard_fn, "ring": ring_shard_fn}[mode]
-
-    def half(target, other, buckets):
-        # per-bucket solved-rows-per-shard, static at trace time
-        rows_per = [b[0].shape[0] // shards for b in buckets]
-        flat = []
-        for _row_ids, col_ids, ratings, mask, seg_row in buckets:
-            flat += [col_ids, ratings, mask, seg_row]
-        # int8 factor tables are (values, scales) pairs: spell out the
-        # matching spec structure (both leaves row-sharded over axis)
-        other_spec = (
-            (P(axis), P(axis)) if isinstance(other, tuple) else P(axis)
-        )
-        xs = shard_map(
-            functools.partial(shard_fn, rows_per),
-            mesh=mesh,
-            in_specs=(other_spec,) + (P(axis),) * len(flat),
-            out_specs=(P(axis),) * len(buckets),
-        )(other, *flat)
-        for x, (row_ids, *_rest) in zip(xs, buckets):
-            target = als_ops._scatter_rows(target, row_ids, x)
-        return jax.tree_util.tree_map(
-            lambda t: jax.lax.with_sharding_constraint(t, factor_spec), target
-        )
-
-    def step(_, carry):
-        U, V = carry
-        U = half(U, V, row_arrays)
-        V = half(V, U, col_arrays)
-        return (U, V)
-
-    return jax.lax.fori_loop(0, iterations, step, (U, V))
-
-
-def choose_sharded_mode(
-    data: als_ops.RatingsData, params: als_ops.ALSParams, shards: int
-) -> str:
-    """Pick the half-step variant for a run: ``gather`` while the larger
-    gathered side fits ``params.sharded_gather_budget_bytes`` per chip,
-    ``ring`` past it (module docstring, "Memory model")."""
-    rows = max(
-        _padded_len(data.num_rows, shards), _padded_len(data.num_cols, shards)
-    )
-    gathered = rows * _factor_row_bytes(params)
-    return "ring" if gathered > params.sharded_gather_budget_bytes else "gather"
-
-
-def _factor_row_bytes(params: als_ops.ALSParams) -> int:
-    """Bytes one gathered factor row costs in storage form (int8 rows
-    carry their f32 per-row scale alongside the quantized values)."""
-    if params.storage_dtype == "int8":
-        return params.rank + 4
-    return params.rank * jnp.dtype(params.storage_dtype).itemsize
 
 
 def _table_bytes_per_chip(sbs: Sequence[ShardedBucket], shards: int) -> int:
-    """Per-chip bytes of a bucket-table set (col_ids/ratings/mask at 12
-    bytes per slot) — same formula for the flat ``[S*B, K]`` layout and
-    the ring-partitioned ``[S*B, S, K_sub]`` one, so the two layouts are
-    directly comparable."""
+    """Per-chip bytes of a legacy bucket-table set (col_ids/ratings/mask
+    at 12 bytes per slot) — same formula for the flat ``[S*B, K]`` layout
+    and the ring-partitioned ``[S*B, S, K_sub]`` one, so the two layouts
+    are directly comparable."""
     return sum(sb.col_ids.size * 12 for sb in sbs) // max(1, shards)
-
-
-def sharded_als_train(
-    data: als_ops.RatingsData,
-    params: als_ops.ALSParams,
-    mesh: Mesh,
-    axis: str = "data",
-    mode: str = "auto",
-) -> tuple[jax.Array, jax.Array]:
-    """Full multi-chip ALS with mesh-resident factors.
-
-    Exact on arbitrarily hot rows: segmented buckets are consumed as-is
-    (segments colocated per shard — see ``shard_bucket``), so results
-    match single-chip ``als_train`` for the same seed. ``mode`` is
-    ``"gather"``, ``"ring"``, or ``"auto"`` (default: pick by the
-    per-chip budget — ``choose_sharded_mode``). Returns (U, V) trimmed
-    to the true row counts (still sharded device arrays)."""
-    import dataclasses
-
-    if axis not in mesh.shape:
-        raise ValueError(
-            f"mesh has axes {tuple(mesh.axis_names)} but the sharded ALS "
-            f"trainer shards over {axis!r}; name one mesh axis {axis!r} "
-            f"(e.g. --mesh {axis}=N) or pass axis="
-        )
-    shards = mesh.shape[axis]
-    if mode == "auto":
-        mode = choose_sharded_mode(data, params, shards)
-    elif mode not in ("gather", "ring"):
-        raise ValueError(f"mode must be auto|gather|ring, got {mode!r}")
-    state = init_sharded_factors(data, params, mesh, axis)
-    u_len = als_ops.table_rows(state.U)
-    v_len = als_ops.table_rows(state.V)
-    row_sb = [shard_bucket(b, shards, u_len - 1) for b in data.row_buckets]
-    col_sb = [shard_bucket(b, shards, v_len - 1) for b in data.col_buckets]
-    if mode == "ring":
-        # partition each table by opposite-slab owner so every rotation
-        # consumes only the sub-table the passing slab can serve
-        def partition(rsb, csb):
-            return (
-                [ring_partition_bucket(sb, v_len // shards, shards) for sb in rsb],
-                [ring_partition_bucket(sb, u_len // shards, shards) for sb in csb],
-            )
-
-        flat_bytes = _table_bytes_per_chip(row_sb + col_sb, shards)
-        row_rp, col_rp = partition(row_sb, col_sb)
-        part_bytes = _table_bytes_per_chip(row_rp + col_rp, shards)
-        budget = params.sharded_gather_budget_bytes
-        if part_bytes > 2 * flat_bytes and part_bytes > budget:
-            # adversarial owner skew: some (row, owner) pair concentrates
-            # most of a row's entries, so K_sub -> K and EVERY table row
-            # pays S * K_sub slots (ring_partition_bucket docstring).
-            # Re-segment just the offending rows through the hot-row
-            # machinery (seg_row scatter-add): splitting them into
-            # sub-rows capped at ceil(K/S) entries per owner restores
-            # K_sub to the spread-case value, so only the skewed rows
-            # grow (extra segments) instead of the whole table.
-            logger.warning(
-                "ring-mode bucket tables blow up under owner skew: %d "
-                "bytes/chip partitioned vs %d flat (budget %d); "
-                "re-segmenting skewed rows",
-                part_bytes, flat_bytes, budget,
-            )
-            row_sb2 = [
-                resegment_skewed_rows(sb, v_len // shards, shards)
-                for sb in row_sb
-            ]
-            col_sb2 = [
-                resegment_skewed_rows(sb, u_len // shards, shards)
-                for sb in col_sb
-            ]
-            row_rp2, col_rp2 = partition(row_sb2, col_sb2)
-            part2 = _table_bytes_per_chip(row_rp2 + col_rp2, shards)
-            if part2 < part_bytes:
-                # narrower segments contained the skew (only the
-                # offending rows multiplied, the rest shrank)
-                row_rp, col_rp, part_bytes = row_rp2, col_rp2, part2
-            if part_bytes > budget:
-                raise ValueError(
-                    f"ring-mode bucket tables need {part_bytes} bytes/chip "
-                    f"even after re-segmentation (flat layout: {flat_bytes}), "
-                    f"over sharded_gather_budget_bytes={budget}; raise the "
-                    "budget, add chips, or thin the skewed rows"
-                )
-        row_sb, col_sb = row_rp, col_rp
-    row_arrays = upload_sharded_buckets(row_sb, mesh, axis)
-    col_arrays = upload_sharded_buckets(col_sb, mesh, axis)
-    # iterations rides as a dynamic loop bound (shared compile across
-    # iteration counts, like the single-chip _train_fused)
-    static_params = dataclasses.replace(params, iterations=0)
-    U, V = _train_fused_sharded(
-        state.U,
-        state.V,
-        row_arrays,
-        col_arrays,
-        params.iterations,
-        static_params,
-        mesh,
-        axis,
-        mode,
-    )
-    return (
-        als_ops.slice_rows(U, data.num_rows),
-        als_ops.slice_rows(V, data.num_cols),
-    )
-
-
-def train_for_context(
-    data: als_ops.RatingsData,
-    params: als_ops.ALSParams,
-    ctx=None,
-    sharded: bool = False,
-) -> tuple[jax.Array, jax.Array]:
-    """Framework dispatch point: the engine-param ``shardedTrain`` knob.
-
-    Templates call this from ``Algorithm.train``; with ``sharded`` the
-    run executes on the WorkflowContext's device mesh (the production
-    multi-chip path — the TPU replacement for MLlib ALS's Spark-cluster
-    execution, reference examples/scala-parallel-recommendation/
-    custom-prepartor/src/main/scala/ALSAlgorithm.scala:72), otherwise on
-    the single default device.
-    """
-    if not sharded or ctx is None:
-        return als_ops.als_train(data, params)
-    mesh = ctx.mesh
-    # shard over "data" when present; a 1-D mesh shards over its only axis
-    if "data" in mesh.shape:
-        axis = "data"
-    elif len(mesh.axis_names) == 1:
-        axis = mesh.axis_names[0]
-    else:
-        raise ValueError(
-            f"shardedTrain needs a 'data' axis on the mesh; got axes "
-            f"{tuple(mesh.axis_names)}"
-        )
-    U, V = sharded_als_train(data, params, mesh, axis)
-    if jax.process_count() > 1:
-        # multi-host: shards live on other hosts' devices; templates
-        # np.asarray the factors for persistence, so gather them to
-        # host-replicated arrays (every host gets the full model)
-        from jax.experimental import multihost_utils
-
-        U = multihost_utils.process_allgather(U, tiled=True)
-        V = multihost_utils.process_allgather(V, tiled=True)
-    return U, V

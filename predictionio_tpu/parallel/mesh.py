@@ -107,3 +107,20 @@ def make_mesh(axes: Sequence[tuple[str, int]] | None = None):
             f"have {len(devices)}"
         )
     return Mesh(np.array(devices[:total]).reshape(sizes), tuple(names))
+
+
+def factor_sharding(mesh, axis: str = "data"):
+    """Row sharding for factor tables / packed bucket tables: ``P(axis)``
+    on dim 0. A pytree-prefix of this covers the int8 ``(values, scales)``
+    pair too (both leaves row-sharded), which is how the fused trainer
+    spells its ``in_shardings``."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated_sharding(mesh):
+    """Fully replicated placement (scatter row-id vectors, scalars)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
