@@ -108,6 +108,8 @@ def cmd_version(args) -> int:
 
 
 def cmd_status(args) -> int:
+    if getattr(args, "json", False):
+        return _status_json()
     from predictionio_tpu.cli import commands
 
     try:
@@ -119,6 +121,45 @@ def cmd_status(args) -> int:
         return 1
     print(json.dumps(info, indent=2))
     print("(sanity check) All storage repositories verified.")
+    return 0
+
+
+def _status_json() -> int:
+    """``pio status --json``: one compact JSON line merging ``/metrics``
+    + ``/stats.json`` from every running daemon (live pid files), in
+    the bench summary-line convention. Endpoints that refuse (the event
+    server's /stats.json wants an access key) are skipped, not fatal."""
+    import urllib.request
+
+    from predictionio_tpu.cli import daemon
+    from predictionio_tpu.obs import metrics as obs_metrics
+
+    def fetch(url: str):
+        try:
+            with urllib.request.urlopen(url, timeout=2.0) as r:
+                return r.read()
+        except Exception:
+            return None
+
+    services: dict = {}
+    for name in daemon.known_services():
+        pid = daemon.read_pid(name)
+        if pid is None:
+            continue
+        port = daemon.DEFAULT_PORTS.get(name, 0)
+        entry: dict = {"pid": pid, "port": port}
+        base = f"http://127.0.0.1:{port}"
+        raw = fetch(f"{base}/metrics")
+        if raw is not None:
+            entry["metrics"] = obs_metrics.parse_prometheus(raw)
+        raw = fetch(f"{base}/stats.json")
+        if raw is not None:
+            try:
+                entry["stats"] = json.loads(raw)
+            except ValueError:
+                pass
+        services[name] = entry
+    print(json.dumps({"services": services}, separators=(",", ":")))
     return 0
 
 
@@ -763,7 +804,14 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command")
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
-    sub.add_parser("status").set_defaults(fn=cmd_status)
+    st = sub.add_parser("status")
+    st.add_argument(
+        "--json",
+        action="store_true",
+        help="one compact JSON line merging /metrics + /stats.json "
+        "from running daemons",
+    )
+    st.set_defaults(fn=cmd_status)
 
     b = sub.add_parser("build")
     b.add_argument("--engine-factory")

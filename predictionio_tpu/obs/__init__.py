@@ -1,0 +1,26 @@
+"""Unified observability layer: metrics + request tracing.
+
+Dependency-free instruments shared by every framework process
+(data/.../api/Stats.scala in the reference only ever grew minute
+buckets; this is the layer a production scoring tier actually needs —
+per-stage latency histograms and queue-wait accounting, the
+prerequisite arxiv 2501.10546 names for running at qps, and the
+tracing-timeline argument of the TensorFlow system paper 1605.08695):
+
+- :mod:`predictionio_tpu.obs.metrics` — a process-global registry of
+  counters, gauges, and log-bucketed latency histograms, rendered as
+  Prometheus text format (``GET /metrics`` on every server) and merged
+  as a compact ``obs`` block into the existing ``/stats.json`` payloads.
+- :mod:`predictionio_tpu.obs.trace` — per-request spans: each HTTP
+  request gets a trace id (honoring ``X-PIO-Trace``), stage boundaries
+  record spans, and a fixed-size ring retains the N slowest recent
+  traces (``GET /traces.json``; waterfall table on the dashboard).
+
+Instrumentation is ALWAYS-ON and cheap (<2% serving qps, gated by the
+bench ``obs`` section); ``PIO_OBS=0`` turns every instrument into a
+no-op for A/B measurement.
+"""
+
+from predictionio_tpu.obs import metrics, trace  # noqa: F401
+
+__all__ = ["metrics", "trace"]

@@ -780,7 +780,10 @@ def als_train(data: RatingsData, params: ALSParams):
     # static params key so runs differing only in iteration count share
     # one compiled program
     static_params = dataclasses.replace(params, iterations=0)
-    return _train_fused(
+    import time as _time
+
+    t0 = _time.perf_counter()
+    out = _train_fused(
         U,
         V,
         _device_bucket_arrays(data.row_buckets),
@@ -788,6 +791,23 @@ def als_train(data: RatingsData, params: ALSParams):
         static_params,
         params.iterations,
     )
+    jax.block_until_ready(out)
+    total = _time.perf_counter() - t0
+    from predictionio_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.histogram(
+        "pio_als_train_seconds",
+        "Whole-run ALS training time",
+        path="single",
+    ).observe(total)
+    if params.iterations > 0:
+        # one fused fori_loop program — per-half-step is derived
+        obs_metrics.histogram(
+            "pio_als_halfstep_seconds",
+            "Derived per-half-step time of the fused sharded ALS loop",
+            mode="single",
+        ).observe(total / (2 * params.iterations))
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("params",), donate_argnums=(0, 1))

@@ -80,6 +80,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.parallel.compat import shard_map
 from predictionio_tpu.parallel.mesh import factor_sharding, replicated_sharding
@@ -766,7 +767,26 @@ def sharded_als_train(
     # iteration counts, like the single-chip _train_fused)
     static_params = dataclasses.replace(params, iterations=0)
     trainer = _fused_trainer(mesh, axis, mode, static_params)
+    import time as _time
+
+    t0 = _time.perf_counter()
     U, V = trainer(state.U, state.V, row_pack, col_pack, params.iterations)
+    jax.block_until_ready((U, V))
+    total = _time.perf_counter() - t0
+    # the whole loop is ONE scan-fused jit program, so per-half-step
+    # timing is derived: total / (2 * iterations). First-call totals
+    # include the XLA compile — read p50, not max.
+    if params.iterations > 0:
+        obs_metrics.histogram(
+            "pio_als_halfstep_seconds",
+            "Derived per-half-step time of the fused sharded ALS loop",
+            mode=mode,
+        ).observe(total / (2 * params.iterations))
+    obs_metrics.histogram(
+        "pio_als_train_seconds",
+        "Whole-run ALS training time",
+        path="sharded",
+    ).observe(total)
     # tables are in SideLayout (degree-balanced) order: un-permute ONCE
     # per training run back to original row order
     factor = factor_sharding(mesh, axis)

@@ -26,10 +26,22 @@ import threading
 import time
 
 from predictionio_tpu.data import store
+from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.realtime.foldin import ALSFoldIn, FoldInConfig
 from predictionio_tpu.realtime.tailer import EventTailer
 
 logger = logging.getLogger(__name__)
+
+_m_fold = obs_metrics.histogram(
+    "pio_foldin_solve_seconds",
+    "Fold+patch time per speed-layer cycle that saw events",
+)
+_m_poll = obs_metrics.histogram(
+    "pio_tailer_poll_seconds", "Event-tailer poll time per cycle"
+)
+_m_tailed = obs_metrics.counter(
+    "pio_tailer_events_total", "Events returned by tailer polls"
+)
 
 
 def _is_als_model(m) -> bool:
@@ -116,11 +128,14 @@ class SpeedLayer:
             self._caught_up_at = time.time()
             return "superseded"
 
+        t_p0 = time.perf_counter()
         events = self.tailer.poll()
+        _m_poll.observe(time.perf_counter() - t_p0)
         if not events:
             if (self.tailer.events_behind() or 0) == 0:
                 self._caught_up_at = time.time()
             return "idle"
+        _m_tailed.inc(len(events))
 
         t0 = time.perf_counter()
         for _attempt in range(3):
@@ -144,6 +159,7 @@ class SpeedLayer:
                 if self.server.query_cache is not None:
                     self.cache_invalidations += 1
                 self._last_fold_s = time.perf_counter() - t0
+                _m_fold.observe(self._last_fold_s)
                 if stats is not None:
                     self.events_folded += stats.rating_events
                     self.users_touched += stats.users_touched
@@ -164,6 +180,7 @@ class SpeedLayer:
             # same instance (another patch or same-instance reload):
             # re-fold this batch against the fresh models
         self._last_fold_s = time.perf_counter() - t0
+        _m_fold.observe(self._last_fold_s)
         logger.warning("speed layer lost the epoch fence 3 times; retrying next poll")
         return "fenced"
 

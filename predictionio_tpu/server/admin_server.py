@@ -13,7 +13,13 @@ import logging
 
 from predictionio_tpu.cli import commands
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+from predictionio_tpu.server.http import (
+    HTTPApp,
+    Request,
+    Response,
+    Router,
+    add_obs_routes,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -21,7 +27,9 @@ logger = logging.getLogger(__name__)
 class AdminServer:
     def __init__(self, storage: Storage | None = None, host: str = "0.0.0.0", port: int = 7071):
         self.storage = storage or get_storage()
-        self.app = HTTPApp(self._router(), host=host, port=port)
+        self.app = HTTPApp(
+            self._router(), host=host, port=port, name="adminserver"
+        )
         self.host = host
 
     def _router(self) -> Router:
@@ -93,6 +101,7 @@ class AdminServer:
                 return Response.json({"status": 0, "message": str(e)}, status=404)
             return Response.json({"status": 1})
 
+        add_obs_routes(router)
         return router
 
     def start(self, background: bool = True) -> int:

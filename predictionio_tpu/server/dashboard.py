@@ -13,7 +13,14 @@ import json
 import logging
 
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+from predictionio_tpu.obs import trace as obs_trace
+from predictionio_tpu.server.http import (
+    HTTPApp,
+    Request,
+    Response,
+    Router,
+    add_obs_routes,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -49,6 +56,49 @@ def _result_summary(instance) -> tuple[str, str]:
     return "<br>".join(html.escape(s) for s in scores), html.escape(params_str)
 
 
+def render_waterfall(traces: list[dict], source: str) -> str:
+    """Slowest-traces waterfall: one block per trace, one proportional
+    bar per span (offset -> left margin, duration -> width). Pure
+    inline-styled HTML — the dashboard ships no assets."""
+    blocks = []
+    for t in traces:
+        total_ms = max(t.get("durationMs", 0.0), 1e-6)
+        rows = []
+        for s in t.get("spans", []):
+            left = 100.0 * s.get("offsetMs", 0.0) / total_ms
+            width = max(100.0 * s.get("durationMs", 0.0) / total_ms, 0.3)
+            width = min(width, 100.0 - min(left, 99.7))
+            rows.append(
+                "<tr>"
+                f"<td style='white-space:nowrap;padding:1px 8px 1px 2px;"
+                f"font-family:monospace'>{html.escape(str(s.get('name', '')))}"
+                f"</td>"
+                f"<td style='width:70%'><div style='margin-left:{left:.2f}%;"
+                f"width:{width:.2f}%;background:#4a90d9;height:12px;"
+                f"min-width:2px'></div></td>"
+                f"<td style='font-family:monospace;text-align:right'>"
+                f"{s.get('durationMs', 0.0):.3f} ms</td>"
+                "</tr>"
+            )
+        blocks.append(
+            f"<h3 style='margin-bottom:2px'>{html.escape(str(t.get('name', '')))}"
+            f" — {total_ms:.3f} ms"
+            f" <small>(trace {html.escape(str(t.get('traceId', '')))},"
+            f" status {html.escape(str(t.get('status')))})</small></h3>"
+            f"<table style='width:100%;border-collapse:collapse'>"
+            f"{''.join(rows)}</table>"
+        )
+    body = "".join(blocks) or "<p>No traces retained yet.</p>"
+    return (
+        "<html><head><title>Slowest traces</title></head><body>"
+        f"<h1>Slowest recent traces</h1>"
+        f"<p>source: {html.escape(source)} — slowest first; the ring "
+        "retains outliers, not a uniform sample. Fetch another server "
+        "with <code>?src=http://host:port</code>.</p>"
+        f"{body}</body></html>"
+    )
+
+
 class Dashboard:
     def __init__(
         self,
@@ -69,6 +119,7 @@ class Dashboard:
             ssl_context=(
                 server_config.ssl_context() if server_config is not None else None
             ),
+            name="dashboard",
         )
 
     def _authorized(self, request: Request) -> bool:
@@ -150,6 +201,34 @@ class Dashboard:
                 ),
             )
 
+        @router.route("GET", "/traces")
+        def traces_page(request: Request) -> Response:
+            """Waterfall of this process's slowest traces, or — with
+            ``?src=http://host:port`` — of another server's
+            ``/traces.json`` fetched server-side (the engine/event
+            servers don't speak CORS, so the browser can't)."""
+            if not server._authorized(request):
+                return Response.error("Not authenticated", 401)
+            src = request.query.get("src")
+            if src:
+                if not src.startswith(("http://", "https://")):
+                    return Response.error("src must be an http(s) URL", 400)
+                import urllib.request
+
+                try:
+                    with urllib.request.urlopen(
+                        f"{src.rstrip('/')}/traces.json", timeout=2
+                    ) as resp:
+                        traces = json.loads(resp.read()).get("traces", [])
+                except Exception as e:
+                    return Response.error(f"fetch from {src} failed: {e}", 502)
+                source = src
+            else:
+                traces = obs_trace.TRACES.snapshot()
+                source = "this dashboard process"
+            return Response.html(render_waterfall(traces, source))
+
+        add_obs_routes(router)
         return router
 
     def _get(self, iid: str):

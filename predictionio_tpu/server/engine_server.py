@@ -38,9 +38,17 @@ from typing import Any
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.workflow import prepare_deploy
 from predictionio_tpu.data.storage import EngineInstance, Storage, get_storage
+from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import jsonx
 from predictionio_tpu.server import plugins as plugin_mod
-from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+from predictionio_tpu.server.http import (
+    HTTPApp,
+    Request,
+    Response,
+    Router,
+    add_obs_routes,
+)
 from predictionio_tpu.server.query_cache import (
     QueryCache,
     canonical_query_bytes,
@@ -133,6 +141,36 @@ class _MicroBatcher:
                 self.dispatch_cost_s * 1e3,
                 window_ms,
             )
+        else:
+            logger.info(
+                "micro-batch: measured dispatch %.2f ms > window %.1f ms "
+                "on this attachment; window-waiting to grow batches",
+                self.dispatch_cost_s * 1e3,
+                window_ms,
+            )
+        # the numbers the ROADMAP "make the batcher win" item needs:
+        # where requests wait, how big batches actually get, and what a
+        # dispatch costs
+        self._m_batch_size = obs_metrics.histogram(
+            "pio_batch_size", "Queries coalesced per device dispatch",
+            bounds=(1, 2, 4, 8, 16, 32, 64, 128),
+        )
+        self._m_queue_wait = obs_metrics.histogram(
+            "pio_batch_queue_wait_seconds",
+            "Per-query wait from submit to batch collection",
+        )
+        self._m_dispatch = obs_metrics.histogram(
+            "pio_batch_dispatch_seconds",
+            "batch_predict device-dispatch time per micro-batch",
+        )
+        obs_metrics.gauge(
+            "pio_batch_engaged",
+            "1 when the micro-batcher serves queries, 0 when disengaged",
+        ).set(1.0 if self.engaged else 0.0)
+        obs_metrics.gauge(
+            "pio_batch_dispatch_cost_seconds",
+            "Measured per-device-call dispatch cost at deploy",
+        ).set(self.dispatch_cost_s)
         self._thread = None
         if self.engaged:  # disengaged: the route never submits
             self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -172,7 +210,11 @@ class _MicroBatcher:
             if self._stopped:
                 f.set_exception(RuntimeError("server stopping"))
                 return f
-            self._q.put((body, f, time.perf_counter()))
+            # the request thread's trace rides the queue item — the
+            # worker thread can't see this thread's thread-local
+            self._q.put(
+                (body, f, time.perf_counter(), obs_trace.current_trace())
+            )
         return f
 
     def stop(self) -> None:
@@ -190,7 +232,7 @@ class _MicroBatcher:
             self._thread.join(timeout=5)
         while True:
             try:
-                _, f, _ = self._q.get_nowait()
+                _, f, *_ = self._q.get_nowait()
             except queue.Empty:
                 break
             if not f.done():
@@ -223,11 +265,12 @@ class _MicroBatcher:
                     batch.append(self._q.get(timeout=remaining))
                 except queue.Empty:
                     break
+            self._m_batch_size.observe(float(len(batch)))
             try:
                 self._server._handle_query_batch(batch)
             except Exception:  # pragma: no cover - worker must survive
                 logger.exception("micro-batch worker failed")
-                for _, f, _ in batch:
+                for _, f, *_ in batch:
                     if not f.done():
                         f.set_exception(RuntimeError("batch worker failed"))
 
@@ -281,6 +324,14 @@ class EngineServer:
         self.serving_seconds = 0.0
         self.last_serving_sec = 0.0
         self.start_time = time.time()
+        self._m_serving = obs_metrics.histogram(
+            "pio_serving_seconds",
+            "Per-query scoring+serve time (parse through plugins)",
+        )
+        self._m_cache_lookup = obs_metrics.histogram(
+            "pio_cache_lookup_seconds",
+            "Query-cache canonicalize+lookup time (hits and misses)",
+        )
 
         self.plugins = plugin_mod.load_plugins(plugin_mod.EngineServerPlugin)
         self.plugin_context: dict[str, Any] = {"storage": self.storage}
@@ -330,6 +381,7 @@ class EngineServer:
                 server_config.ssl_context() if server_config is not None else None
             ),
             reuse_port=reuse_port,
+            name="engine",
         )
 
     def _load(self, instance: EngineInstance) -> None:
@@ -372,6 +424,7 @@ class EngineServer:
         cache = self.query_cache
         key = None
         if cache is not None:
+            t_c0 = time.perf_counter()
             with self._lock:
                 epoch = self._epoch
                 variant = self.instance.engine_variant
@@ -379,14 +432,21 @@ class EngineServer:
                 key = (variant, canonical_query_bytes(body), epoch)
             except (TypeError, ValueError):
                 key = None  # non-canonicalizable body: uncacheable
-            if key is not None:
-                payload = cache.get(key)
-                if payload is not None:
-                    # a hit is still a served request; it adds ~0 to
-                    # serving_seconds by construction
-                    with self._lock:
-                        self.request_count += 1
-                    return payload
+            payload = cache.get(key) if key is not None else None
+            t_c1 = time.perf_counter()
+            self._m_cache_lookup.observe(t_c1 - t_c0)
+            tr = obs_trace.current_trace()
+            if tr is not None:
+                tr.add_span(
+                    "cache.hit" if payload is not None else "cache.miss",
+                    t_c0, t_c1,
+                )
+            if payload is not None:
+                # a hit is still a served request; it adds ~0 to
+                # serving_seconds by construction
+                with self._lock:
+                    self.request_count += 1
+                return payload
         if (
             self.batcher is not None
             and self.batcher.active
@@ -430,17 +490,21 @@ class EngineServer:
         return query, serving.supplement(query)
 
     def _finish_query(
-        self, body, query, predictions, serving, t0
+        self, body, query, predictions, serving, t0, trace=None
     ) -> dict[str, Any]:
         """Per-query tail shared by the per-request and micro-batched
-        paths: serve, feedback, plugins, bookkeeping."""
+        paths: serve, feedback, plugins, bookkeeping. ``trace`` is passed
+        explicitly from the batch worker (whose thread-local is not the
+        request thread's); the per-request path falls back to it."""
+        if trace is None:
+            trace = obs_trace.current_trace()
         result = serving.serve(query, predictions)
         response = _to_jsonable(result)
 
         pr_id: str | None = None
         if self.feedback:
             pr_id = body.get("prId") or uuid.uuid4().hex[:16]
-            self._send_feedback(body, response, pr_id)
+            self._send_feedback(body, response, pr_id, trace=trace)
             if isinstance(response, dict):
                 response = {**response, "prId": pr_id}
 
@@ -454,7 +518,11 @@ class EngineServer:
                     self.instance.engine_variant, body, response, self.plugin_context
                 )
 
-        dt = time.perf_counter() - t0
+        t_end = time.perf_counter()
+        dt = t_end - t0
+        self._m_serving.observe(dt)
+        if trace is not None:
+            trace.add_span("serve", t0, t_end)
         with self._lock:
             self.request_count += 1
             self.serving_seconds += dt
@@ -468,18 +536,26 @@ class EngineServer:
         request can't fail its batchmates."""
         with self._lock:
             algorithms, models, serving = self.algorithms, self.models, self.serving
+        batcher = self.batcher
+        t_collect = time.perf_counter()
         parsed = []
-        for body, fut, t0 in items:
+        for body, fut, t0, tr in items:
+            if batcher is not None:
+                batcher._m_queue_wait.observe(t_collect - t0)
+            if tr is not None:
+                tr.add_span("batch.queue_wait", t0, t_collect)
             try:
                 query, sup = self._parse_query(body, algorithms, serving)
-                parsed.append((body, fut, t0, query, sup))
+                parsed.append((body, fut, t0, tr, query, sup))
             except Exception as e:
                 fut.set_exception(e)
         if not parsed:
             return
         per_algo: list[dict] | None
         try:
-            indexed = [(i, sup) for i, (_, _, _, _, sup) in enumerate(parsed)]
+            indexed = [
+                (i, sup) for i, (_, _, _, _, _, sup) in enumerate(parsed)
+            ]
             # pad to a power-of-two batch size with copies of the first
             # query (padding results are discarded): jitted batch
             # programs specialize on the batch shape, and
@@ -490,14 +566,21 @@ class EngineServer:
             indexed = indexed + [
                 (n_real + j, indexed[0][1]) for j in range(pad_to - n_real)
             ]
+            t_d0 = time.perf_counter()
             per_algo = [
                 dict(a.batch_predict(m, indexed))
                 for a, m in zip(algorithms, models)
             ]
+            t_d1 = time.perf_counter()
+            if batcher is not None:
+                batcher._m_dispatch.observe(t_d1 - t_d0)
+            for _, _, _, tr, _, _ in parsed:
+                if tr is not None:
+                    tr.add_span(f"batch.dispatch[{n_real}]", t_d0, t_d1)
         except Exception:
             logger.exception("batched scoring failed; retrying per query")
             per_algo = None
-        for i, (body, fut, t0, query, sup) in enumerate(parsed):
+        for i, (body, fut, t0, tr, query, sup) in enumerate(parsed):
             try:
                 if per_algo is None:
                     predictions = [
@@ -506,7 +589,9 @@ class EngineServer:
                 else:
                     predictions = [d[i] for d in per_algo]
                 fut.set_result(
-                    self._finish_query(body, query, predictions, serving, t0)
+                    self._finish_query(
+                        body, query, predictions, serving, t0, trace=tr
+                    )
                 )
             except Exception as e:
                 fut.set_exception(e)
@@ -532,7 +617,9 @@ class EngineServer:
 
         threading.Thread(target=post, daemon=True).start()
 
-    def _send_feedback(self, query: dict, prediction: Any, pr_id: str) -> None:
+    def _send_feedback(
+        self, query: dict, prediction: Any, pr_id: str, trace=None
+    ) -> None:
         """Async predict-event POST back to the event server
         (CreateServer.scala:514-577)."""
         if not (self.event_server_url and self.access_key):
@@ -551,10 +638,11 @@ class EngineServer:
             f"{self.event_server_url.rstrip('/')}/events.json"
             f"?accessKey={self.access_key}"
         )
-        self._post_async(
-            url, payload, "feedback event",
-            headers={"Content-Type": "application/json"},
-        )
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            # the event server's ingest hop joins this query's timeline
+            headers[obs_trace.TRACE_HEADER] = trace.trace_id
+        self._post_async(url, payload, "feedback event", headers=headers)
 
     def _remote_log(self, message: str) -> None:
         """Best-effort POST of a serving error to ``log_url`` (reference
@@ -700,6 +788,8 @@ class EngineServer:
                 if cache is not None
                 else {"enabled": False}
             )
+            # additive: existing consumers keep their fields untouched
+            body["obs"] = obs_metrics.stats_block()
             return Response.json(body)
 
         @router.route("POST", "/queries.json")
@@ -766,6 +856,7 @@ class EngineServer:
                     return Response.json(p.handle_rest(dict(request.query)))
             return Response.error("plugin not found", 404)
 
+        add_obs_routes(router)
         return router
 
     def _auth_control(self, request: Request) -> bool:

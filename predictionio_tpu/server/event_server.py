@@ -28,6 +28,7 @@ ingestion.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -38,8 +39,16 @@ from predictionio_tpu.data.event import (
     validate,
 )
 from predictionio_tpu.data.storage import AccessKey, Storage, get_storage
+from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import plugins as plugin_mod
-from predictionio_tpu.server.http import HTTPApp, Request, Response, Router
+from predictionio_tpu.server.http import (
+    HTTPApp,
+    Request,
+    Response,
+    Router,
+    add_obs_routes,
+)
 from predictionio_tpu.server.stats import Stats
 from predictionio_tpu.server.webhooks import (
     ConnectorError,
@@ -80,8 +89,30 @@ class EventServer:
         self.plugin_context: dict[str, Any] = {"storage": self.storage}
         for p in self.plugins:
             p.start(self.plugin_context)
+        self._m_validate = obs_metrics.histogram(
+            "pio_ingest_validate_seconds",
+            "Per-event plugin+parse+validate time",
+        )
+        self._m_append = obs_metrics.histogram(
+            "pio_ingest_append_seconds",
+            "Single-event storage insert time (row log write + fsync)",
+        )
+        self._m_group_commit = obs_metrics.histogram(
+            "pio_ingest_group_commit_seconds",
+            "Batch storage insert time (one lock+append+fsync per request)",
+        )
+        self._m_accepted = obs_metrics.counter(
+            "pio_ingest_events_total", "Events ingested", result="created"
+        )
+        self._m_rejected = obs_metrics.counter(
+            "pio_ingest_events_total", "Events ingested", result="rejected"
+        )
         self.app = HTTPApp(
-            self._router(), host=host, port=port, reuse_port=reuse_port
+            self._router(),
+            host=host,
+            port=port,
+            reuse_port=reuse_port,
+            name="eventserver",
         )
 
     # -- auth --------------------------------------------------------------
@@ -131,12 +162,23 @@ class EventServer:
 
     def _ingest_one(self, auth: AuthData, event_json: dict) -> tuple[int, dict]:
         """Returns (status_code, body) per event."""
+        t0 = time.perf_counter()
         prepared = self._prepare_one(auth, event_json)
+        t1 = time.perf_counter()
+        self._m_validate.observe(t1 - t0)
         if not isinstance(prepared, Event):
+            self._m_rejected.inc()
             return prepared
         event_id = self.storage.get_events().insert(
             prepared, auth.app_id, auth.channel_id
         )
+        t2 = time.perf_counter()
+        self._m_append.observe(t2 - t1)
+        self._m_accepted.inc()
+        tr = obs_trace.current_trace()
+        if tr is not None:
+            tr.add_span("ingest.validate", t0, t1)
+            tr.add_span("ingest.append", t1, t2)
         if self.stats_enabled:
             self.stats.update(
                 auth.app_id, 201, prepared.event, prepared.entity_type
@@ -150,6 +192,7 @@ class EventServer:
         is still written before any 201 is returned, so per-event
         durability is exactly the single-insert path's). The response
         keeps the reference's per-event status list, in request order."""
+        t0 = time.perf_counter()
         results: list[dict | None] = [None] * len(body)
         events: list[Event] = []
         slots: list[int] = []
@@ -164,10 +207,22 @@ class EventServer:
             else:
                 status, payload = prepared
                 results[i] = {"status": status, **payload}
+        t1 = time.perf_counter()
+        self._m_validate.observe(t1 - t0)
+        n_rejected = len(body) - len(events)
+        if n_rejected:
+            self._m_rejected.inc(n_rejected)
         if events:
             ids = self.storage.get_events().batch_insert(
                 events, auth.app_id, auth.channel_id
             )
+            t2 = time.perf_counter()
+            self._m_group_commit.observe(t2 - t1)
+            self._m_accepted.inc(len(events))
+            tr = obs_trace.current_trace()
+            if tr is not None:
+                tr.add_span(f"ingest.validate[{len(body)}]", t0, t1)
+                tr.add_span(f"ingest.group_commit[{len(events)}]", t1, t2)
             for i, event, event_id in zip(slots, events, ids):
                 results[i] = {"status": 201, "eventId": event_id}
                 if self.stats_enabled:
@@ -272,7 +327,10 @@ class EventServer:
                 return Response.error(
                     "To see stats, launch Event Server with --stats argument.", 404
                 )
-            return Response.json(server.stats.get(auth.app_id))
+            payload = server.stats.get(auth.app_id)
+            # additive: existing consumers keep their fields untouched
+            payload["obs"] = obs_metrics.stats_block()
+            return Response.json(payload)
 
         @router.route("GET", "/plugins.json")
         def plugins_json(request: Request) -> Response:
@@ -324,6 +382,7 @@ class EventServer:
         def webhook_check_form(request: Request) -> Response:
             return server._webhook_check(request, FormConnector)
 
+        add_obs_routes(router)
         return router
 
     def _webhook(self, request: Request, form: bool) -> Response:

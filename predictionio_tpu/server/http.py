@@ -11,12 +11,15 @@ import json
 import logging
 import re
 import threading
+import time
 from dataclasses import dataclass, field
 from http.client import responses as _RESPONSES
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import jsonx
 
 logger = logging.getLogger(__name__)
@@ -199,6 +202,25 @@ class Router:
         return Response.error("not found", 404)
 
 
+_PROM_CT = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def add_obs_routes(router: Router) -> None:
+    """Mount ``GET /metrics`` (Prometheus text format) and
+    ``GET /traces.json`` (slowest recent traces). Unauthenticated on
+    every server — standard scraper behavior; neither endpoint exposes
+    event data."""
+
+    def _metrics_route(_req: Request) -> Response:
+        return Response(200, body=(_PROM_CT, obs_metrics.render_prometheus()))
+
+    def _traces_route(_req: Request) -> Response:
+        return Response.json({"traces": obs_trace.TRACES.snapshot()})
+
+    router.add("GET", "/metrics", _metrics_route)
+    router.add("GET", "/traces.json", _traces_route)
+
+
 class _ConnReader:
     """Per-connection request reader over ONE reusable ``recv_into``
     buffer.
@@ -295,10 +317,30 @@ class HTTPApp:
         reuse_port: bool = False,
         read_timeout: float = 120.0,
         recv_buffer: bool = True,
+        name: str = "server",
     ):
         self.router = router
         self.host = host
         self.port = port
+        # server role label on this app's request metrics ("eventserver",
+        # "engine", ...) — one process can host several HTTPApps (tests)
+        self.name = name
+        self._m_request = obs_metrics.histogram(
+            "pio_http_request_seconds",
+            "End-to-end request handling time (read+parse+dispatch+send)",
+            server=name,
+        )
+        self._m_read_parse = obs_metrics.histogram(
+            "pio_http_read_parse_seconds",
+            "Request read+parse time, excluding keep-alive idle wait",
+            server=name,
+        )
+        self._m_requests = obs_metrics.counter(
+            "pio_http_requests_total", "Requests handled", server=name
+        )
+        self._m_errors = obs_metrics.counter(
+            "pio_http_errors_total", "Requests answered with 5xx", server=name
+        )
         # server-side TLS (reference SSLConfiguration sslContext wiring
         # into spray; here an ssl.SSLContext wrapping the listen socket)
         self.ssl_context = ssl_context
@@ -369,6 +411,10 @@ class HTTPApp:
                     return
                 if not line:
                     return
+                # request clock starts when the first line ARRIVES, so a
+                # keep-alive connection's idle wait never pollutes the
+                # read/parse span
+                t_start = time.perf_counter()
                 if len(line) > 65536:
                     self._send_simple(414, "URI Too Long")
                     return
@@ -461,6 +507,21 @@ class HTTPApp:
                     headers=headers,
                     body=body,
                 )
+                tr = None
+                t_parsed = 0.0
+                if obs_metrics.enabled():
+                    # trace anchored at first-line arrival; an incoming
+                    # X-PIO-Trace id stitches this hop into the caller's
+                    # timeline (read/parse happened before the header was
+                    # known, so its span is added retroactively)
+                    t_parsed = time.perf_counter()
+                    tr = obs_trace.Trace(
+                        f"{method} {parsed.path}",
+                        trace_id=headers.get("x-pio-trace"),
+                        t0=t_start,
+                    )
+                    tr.add_span("http.read_parse", t_start, t_parsed)
+                    obs_trace.set_current_trace(tr)
                 try:
                     response = app.router.dispatch(request)
                 except json.JSONDecodeError:
@@ -470,6 +531,26 @@ class HTTPApp:
                         "unhandled error on %s %s", method, parsed.path
                     )
                     response = Response.error("internal error", 500)
+                finally:
+                    if tr is not None:
+                        obs_trace.set_current_trace(None)
+                if tr is not None:
+                    # bookkeeping runs BEFORE the response bytes leave:
+                    # once the client unblocks it starts contending for
+                    # the GIL, and post-send bookkeeping then costs two
+                    # forced thread switches per request — far more than
+                    # the few µs of work itself. The measured duration
+                    # excludes only the final buffered socket write.
+                    t_end = time.perf_counter()
+                    tr.add_span("dispatch", t_parsed, t_end)
+                    tr.status = response.status
+                    tr.duration_s = t_end - t_start
+                    app._m_request.observe(t_end - t_start)
+                    app._m_read_parse.observe(t_parsed - t_start)
+                    app._m_requests.inc()
+                    if response.status >= 500:
+                        app._m_errors.inc()
+                    obs_trace.TRACES.offer(tr)
                 self._send(response)
 
             def _send_simple(self, status: int, phrase: str) -> None:

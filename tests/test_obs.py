@@ -1,0 +1,472 @@
+"""Observability layer tests: histogram math vs numpy, concurrent update
+integrity, Prometheus text golden, trace-ring retention semantics, the
+bounded ingestion stats window, and the /metrics + /traces.json
+endpoints live over a real socket."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.cli import commands
+from predictionio_tpu.obs import metrics, trace
+from predictionio_tpu.obs.metrics import (
+    BUCKET_BOUNDS,
+    Histogram,
+    Registry,
+    _percentile_from_counts,
+    parse_prometheus,
+)
+
+
+def _get(url: str, headers: dict | None = None):
+    req = urllib.request.Request(url, method="GET")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.headers, resp.read()
+
+
+class TestHistogram:
+    def test_percentiles_vs_numpy(self):
+        """Interpolated percentiles land within one ~2x bucket of the
+        exact sample percentile, across a 6-decade lognormal spread."""
+        rng = np.random.default_rng(7)
+        vals = rng.lognormal(mean=-7.0, sigma=1.2, size=20_000)
+        h = Histogram("t_seconds", "")
+        for v in vals:
+            h.observe(float(v))
+        for q in (0.50, 0.90, 0.99):
+            est = h.percentile(q)
+            true = float(np.percentile(vals, q * 100))
+            assert 0.45 * true <= est <= 2.2 * true, (q, est, true)
+
+    def test_zero_and_overflow(self):
+        h = Histogram("t_seconds", "")
+        h.observe(0.0)
+        h.observe(-3.0)  # clamped to the zero bucket, not dropped
+        h.observe(1e9)  # far past the last bound -> overflow cell
+        counts, total, n = h.merged()
+        assert n == 3
+        assert counts[0] == 2
+        assert counts[-1] == 1
+        # overflow percentile interpolates within [last bound, 2x last]
+        p99 = _percentile_from_counts(counts, n, 0.99)
+        assert BUCKET_BOUNDS[-1] < p99 <= BUCKET_BOUNDS[-1] * 2
+
+    def test_custom_bounds(self):
+        """Count-shaped histograms (batch sizes) use their own buckets
+        instead of the latency layout."""
+        h = Histogram("batch", "", bounds=(1, 2, 4, 8))
+        for size in (1, 1, 3, 8, 30):
+            h.observe(float(size))
+        counts, total, n = h.merged()
+        assert len(counts) == 5
+        assert counts == [2, 0, 1, 1, 1]
+        assert total == 43.0 and n == 5
+
+    def test_concurrent_updates_lose_nothing(self):
+        """8 threads hammering one histogram: every observation lands
+        exactly once (striped locks, no torn counts)."""
+        h = Histogram("stress_seconds", "")
+        per_thread = 25_000
+
+        def work():
+            for _ in range(per_thread):
+                h.observe(1e-3)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        counts, total, n = h.merged()
+        assert n == 8 * per_thread
+        assert sum(counts) == 8 * per_thread
+        assert abs(total - 8 * per_thread * 1e-3) < 1e-6
+
+    def test_percentile_empty(self):
+        assert Histogram("e_seconds", "").percentile(0.5) == 0.0
+
+
+class TestPrometheus:
+    def test_render_golden(self):
+        """Exact text-format output for a small registry: HELP/TYPE once
+        per family, cumulative buckets, +Inf, _sum/_count."""
+        reg = Registry()
+        reg.counter("c_total", "test counter", role="x").inc(2)
+        reg.gauge("g_val", "test gauge").set(1.5)
+        h = reg.histogram("h_seconds", "test hist", bounds=(1.0, 2.0))
+        for v in (0.5, 1.5, 9.25):
+            h.observe(v)
+        assert reg.render_prometheus().decode() == (
+            "# HELP c_total test counter\n"
+            "# TYPE c_total counter\n"
+            'c_total{role="x"} 2\n'
+            "# HELP g_val test gauge\n"
+            "# TYPE g_val gauge\n"
+            "g_val 1.5\n"
+            "# HELP h_seconds test hist\n"
+            "# TYPE h_seconds histogram\n"
+            'h_seconds_bucket{le="1"} 1\n'
+            'h_seconds_bucket{le="2"} 2\n'
+            'h_seconds_bucket{le="+Inf"} 3\n'
+            "h_seconds_sum 11.25\n"
+            "h_seconds_count 3\n"
+        )
+
+    def test_parse_round_trip(self):
+        reg = Registry()
+        reg.counter("a_total").inc(5)
+        reg.gauge("b_val", labelled="yes").set(0.25)
+        parsed = parse_prometheus(reg.render_prometheus())
+        assert parsed["a_total"] == 5.0
+        assert parsed['b_val{labelled="yes"}'] == 0.25
+
+    def test_get_or_create_and_type_conflict(self):
+        reg = Registry()
+        assert reg.counter("x_total", app="1") is reg.counter(
+            "x_total", app="1"
+        )
+        assert reg.counter("x_total", app="2") is not reg.counter(
+            "x_total", app="1"
+        )
+        with pytest.raises(TypeError):
+            reg.gauge("x_total", app="1")
+
+    def test_stats_block_prefix_filter(self):
+        """Only pio_-named metrics ride /stats.json; scratch instruments
+        (the bench's) stay out."""
+        reg = Registry()
+        reg.counter("pio_things_total").inc(3)
+        reg.histogram("bench_scratch_seconds").observe(0.1)
+        block = reg.stats_block()
+        assert block == {"pio_things_total": 3}
+
+    def test_histogram_summary_shape(self):
+        reg = Registry()
+        h = reg.histogram("pio_x_seconds")
+        for _ in range(100):
+            h.observe(1e-3)
+        s = reg.stats_block()["pio_x_seconds"]
+        assert s["count"] == 100
+        assert set(s) == {"count", "sum", "p50", "p90", "p99"}
+        # all mass in one bucket: every percentile inside its bounds
+        assert 512e-6 <= s["p50"] <= 1024e-6 * 2
+
+
+class TestDisabled:
+    def test_disabled_instruments_are_noops(self):
+        reg = Registry()
+        c = reg.counter("d_total")
+        g = reg.gauge("d_val")
+        h = reg.histogram("d_seconds")
+        ring = trace.TraceRing(capacity=4)
+        tr = trace.Trace("x")
+        tr.finish(200)
+        prior = metrics.enabled()
+        try:
+            metrics.set_enabled(False)
+            c.inc()
+            g.set(9.0)
+            h.observe(1.0)
+            ring.offer(tr)
+            assert c.value() == 0
+            assert g.value() == 0.0
+            assert h.merged()[2] == 0
+            assert ring.snapshot() == []
+            metrics.set_enabled(True)
+            c.inc()
+            assert c.value() == 1
+        finally:
+            metrics.set_enabled(prior)
+
+
+class TestTrace:
+    def test_trace_id_honored_and_lazily_minted(self):
+        tr = trace.Trace("x", trace_id="cafe")
+        assert tr.trace_id == "cafe"
+        tr2 = trace.Trace("y")
+        tid = tr2.trace_id
+        assert len(tid) == 16 and tid == tr2.trace_id
+        assert tid != trace.Trace("z").trace_id
+
+    def test_span_offsets(self):
+        tr = trace.Trace("POST /q", t0=100.0)
+        tr.add_span("stage", 100.25, 100.5)
+        tr.finish(200)
+        d = tr.to_dict()
+        assert d["status"] == 200
+        span = d["spans"][0]
+        assert span["name"] == "stage"
+        assert span["offsetMs"] == 250.0
+        assert span["durationMs"] == 250.0
+
+    def test_span_context_manager(self):
+        tr = trace.Trace("x")
+        with tr.span("inner"):
+            pass
+        assert tr.to_dict()["spans"][0]["name"] == "inner"
+
+    def test_ring_keeps_slowest(self):
+        """Capacity 4: durations 5,1,2,3 all admitted; 4 evicts the
+        fastest (1); a faster-than-floor trace is rejected."""
+        ring = trace.TraceRing(capacity=4, max_age_s=3600)
+
+        def offer(duration):
+            tr = trace.Trace(f"d{duration}")
+            tr.duration_s = float(duration)
+            tr.status = 200
+            ring.offer(tr)
+
+        for d in (5, 1, 2, 3):
+            offer(d)
+        offer(4)
+        snap = ring.snapshot()
+        assert [t["durationMs"] for t in snap] == [5000, 4000, 3000, 2000]
+        offer(0.5)  # below the retained floor: rejected
+        assert len(ring.snapshot()) == 4
+        offer(10)  # evicts the current fastest (2)
+        assert [t["durationMs"] for t in ring.snapshot()] == [
+            10_000, 5000, 4000, 3000,
+        ]
+
+    def test_ring_age_pruning(self):
+        import time as _time
+
+        ring = trace.TraceRing(capacity=8, max_age_s=10.0)
+        old = trace.Trace("old", t0=_time.perf_counter() - 3600)
+        old.duration_s = 9.0
+        fresh = trace.Trace("fresh")
+        fresh.duration_s = 0.001
+        ring.offer(old)
+        ring.offer(fresh)
+        names = [t["name"] for t in ring.snapshot()]
+        assert names == ["fresh"]
+
+    def test_current_trace_thread_local(self):
+        tr = trace.Trace("x")
+        trace.set_current_trace(tr)
+        try:
+            assert trace.current_trace() is tr
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(trace.current_trace())
+            )
+            t.start()
+            t.join()
+            assert seen == [None]
+        finally:
+            trace.set_current_trace(None)
+
+
+class TestBoundedStats:
+    def test_minute_buckets_bounded_totals_exact(self, monkeypatch):
+        """Three simulated days of one-event-per-minute traffic: the
+        live window never exceeds retention+1 buckets and all-time
+        totals stay exact (the reference grew its minute map forever)."""
+        from predictionio_tpu.server import stats as stats_mod
+
+        class _FakeTime:
+            now = 1_700_000_000.0
+
+            @classmethod
+            def time(cls):
+                return cls.now
+
+        monkeypatch.setattr(stats_mod, "time", _FakeTime)
+        s = stats_mod.Stats(retention_minutes=60)
+        total = 0
+        for _ in range(3 * 1440):
+            _FakeTime.now += 60.0
+            s.update(7, 201, "rate", "user")
+            s.update(7, 400, "rate", "user")
+            total += 1
+            assert s.bucket_count() <= 61
+        g = s.get(7)
+        assert g["statusCount"]["201"] == total
+        assert g["statusCount"]["400"] == total
+        assert g["eventCount"]["rate"] == 2 * total
+        assert g["lastEventSeq"] == total
+        assert g["lastIngestTime"] == _FakeTime.now
+
+    def test_idle_gap_folds_in_one_call(self, monkeypatch):
+        from predictionio_tpu.server import stats as stats_mod
+
+        class _FakeTime:
+            now = 1_700_000_000.0
+
+            @classmethod
+            def time(cls):
+                return cls.now
+
+        monkeypatch.setattr(stats_mod, "time", _FakeTime)
+        s = stats_mod.Stats(retention_minutes=5)
+        for _ in range(5):
+            _FakeTime.now += 60.0
+            s.update(1, 201, "rate", "user")
+        _FakeTime.now += 7 * 24 * 3600.0  # a week idle
+        s.update(1, 201, "rate", "user")
+        assert s.bucket_count() == 1  # the week-old buckets all folded
+        assert s.get(1)["statusCount"]["201"] == 6
+
+
+@pytest.fixture()
+def obs_event_server(storage):
+    from predictionio_tpu.server.event_server import EventServer
+
+    info = commands.app_new("ObsApp", storage=storage)
+    server = EventServer(storage=storage, host="127.0.0.1", port=0, stats=True)
+    port = server.start()
+    yield {
+        "base": f"http://127.0.0.1:{port}",
+        "key": info["access_key"],
+    }
+    server.stop()
+
+
+EVENT = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.5},
+}
+
+
+class TestEndpoints:
+    def test_metrics_endpoint(self, obs_event_server):
+        base, key = obs_event_server["base"], obs_event_server["key"]
+        req = urllib.request.Request(
+            f"{base}/events.json?accessKey={key}",
+            data=json.dumps(EVENT).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+        status, headers, body = _get(f"{base}/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+        parsed = parse_prometheus(body)
+        assert parsed['pio_ingest_events_total{result="created"}'] >= 1
+        assert (
+            'pio_http_requests_total{server="eventserver"}' in parsed
+        )
+        assert (
+            'pio_ingest_validate_seconds_count' in "\n".join(parsed)
+            or any(k.startswith("pio_ingest_validate_seconds_count")
+                   for k in parsed)
+        )
+
+    def test_stats_json_obs_block(self, obs_event_server):
+        base, key = obs_event_server["base"], obs_event_server["key"]
+        status, _, body = _get(f"{base}/stats.json?accessKey={key}")
+        assert status == 200
+        payload = json.loads(body)
+        # additive: the legacy fields survive, obs summaries ride along
+        assert "obs" in payload
+        assert any(k.startswith("pio_http_request_seconds")
+                   for k in payload["obs"])
+
+    def test_traces_endpoint_and_header_propagation(self, obs_event_server):
+        base, key = obs_event_server["base"], obs_event_server["key"]
+        trace.TRACES.clear()
+        req = urllib.request.Request(
+            f"{base}/events.json?accessKey={key}",
+            data=json.dumps(EVENT).encode(), method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "X-PIO-Trace": "feedbeef00000001",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+        status, _, body = _get(f"{base}/traces.json")
+        assert status == 200
+        traces = json.loads(body)["traces"]
+        mine = [t for t in traces if t["traceId"] == "feedbeef00000001"]
+        assert mine, traces
+        names = [s["name"] for s in mine[0]["spans"]]
+        assert "http.read_parse" in names
+        assert "ingest.validate" in names
+        assert "ingest.append" in names
+        assert mine[0]["status"] == 201
+
+
+class TestMicroBatcherMetrics:
+    def test_batch_metrics_populated(self, storage):
+        """A forced-engaged micro-batcher records batch sizes, queue
+        waits, and dispatch timings; the engaged gauge reads 1."""
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.models import recommendation as rec
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        info = commands.app_new("ObsBatchApp", storage=storage)
+        events = storage.get_events()
+        rng = np.random.default_rng(0)
+        for u in range(10):
+            for _ in range(5):
+                events.insert(
+                    Event(
+                        event="rate", entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{int(rng.integers(0, 6))}",
+                        properties={"rating": float(rng.integers(1, 6))},
+                    ),
+                    info["id"],
+                )
+        engine = rec.engine()
+        ep = EngineParams(
+            datasource=("", rec.DataSourceParams(app_name="ObsBatchApp")),
+            algorithms=[
+                ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=2))
+            ],
+        )
+        run_train(engine, ep, engine_id="obs-batch", storage=storage)
+        instance = storage.get_metadata_engine_instances() \
+            .get_latest_completed("obs-batch", "0", "default")
+        server = EngineServer(
+            engine, instance, storage=storage, host="127.0.0.1", port=0,
+            batch_window_ms=40.0, dispatch_cost_s=0.005,  # force engaged
+        )
+        h_size = metrics.histogram("pio_batch_size")
+        h_wait = metrics.histogram("pio_batch_queue_wait_seconds")
+        size_before = h_size.merged()[2]
+        wait_before = h_wait.merged()[2]
+        port = server.start()
+        try:
+            assert server.batcher.engaged
+            assert metrics.gauge("pio_batch_engaged").value() == 1.0
+
+            def one(u):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps({"user": u, "num": 3}).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert resp.status == 200
+
+            threads = [
+                threading.Thread(target=one, args=(f"u{i}",))
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            server.stop()
+        assert h_size.merged()[2] > size_before
+        assert h_wait.merged()[2] >= wait_before + 4
+        assert metrics.gauge("pio_batch_dispatch_cost_seconds").value() \
+            == 0.005
