@@ -278,6 +278,12 @@ def cmd_train(args) -> int:
 
     if getattr(args, "no_columnar_cache", False):
         os.environ["PIO_COLUMNAR_CACHE"] = "0"
+    if getattr(args, "checkpoint_every", None):
+        os.environ["PIO_CHECKPOINT_EVERY"] = str(args.checkpoint_every)
+    if getattr(args, "resume", False):
+        os.environ["PIO_RESUME"] = "1"
+    if getattr(args, "checkpoint_dir", None):
+        os.environ["PIO_CHECKPOINT_DIR"] = args.checkpoint_dir
     if getattr(args, "multihost", False):
         # join the global mesh BEFORE anything touches JAX: afterwards
         # jax.devices() is the pod-wide set and --mesh axes span hosts
@@ -885,6 +891,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-columnar-cache", action="store_true",
         help="read training events from the row logs instead of the "
         "columnar segment cache (sets PIO_COLUMNAR_CACHE=0 for this run)",
+    )
+    t.add_argument(
+        "--checkpoint-every", type=int, metavar="N",
+        help="snapshot the ALS factor carry atomically every N "
+        "iterations so a killed run can resume (sets "
+        "PIO_CHECKPOINT_EVERY; see docs/robustness.md)",
+    )
+    t.add_argument(
+        "--resume", action="store_true",
+        help="restore the latest checkpoint whose data fingerprint "
+        "matches this run and continue bit-identically from its "
+        "iteration (sets PIO_RESUME=1; no-op when none matches)",
+    )
+    t.add_argument(
+        "--checkpoint-dir", metavar="DIR",
+        help="where checkpoints live (sets PIO_CHECKPOINT_DIR; "
+        "default ~/.pio_tpu/checkpoints)",
     )
     t.set_defaults(fn=cmd_train)
 

@@ -50,6 +50,8 @@ from pathlib import Path
 
 import numpy as np
 
+from predictionio_tpu import faults
+
 logger = logging.getLogger(__name__)
 
 MAGIC = b"PIOCOLC1"
@@ -279,6 +281,7 @@ def store(
     payload_base = _aligned(len(MAGIC) + 8 + len(hdr))
     tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
     try:
+        faults.fault_point("colcache.store")
         with open(tmp, "wb") as f:
             f.write(MAGIC)
             f.write(len(hdr).to_bytes(8, "little"))
@@ -287,7 +290,9 @@ def store(
                 f.seek(payload_base + off)
                 f.write(arr.tobytes())
             f.flush()
+            faults.fault_point("storage.fsync")
             os.fsync(f.fileno())
+        faults.fault_point("storage.rename")
         tmp.replace(path)
         return True
     except OSError as e:  # pragma: no cover - disk full / perms

@@ -46,6 +46,8 @@ import logging
 import os
 import threading
 
+from predictionio_tpu import faults
+
 logger = logging.getLogger(__name__)
 
 
@@ -111,6 +113,7 @@ class FsyncCoalescer:
                 ok = True
             else:
                 try:
+                    faults.fault_point("storage.fsync")
                     os.fsync(fd)
                     ok = True
                 finally:

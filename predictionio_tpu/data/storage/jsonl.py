@@ -30,7 +30,9 @@ try:  # advisory cross-process locks; Unix-only (this framework targets Linux)
 except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
     fcntl = None
 
+from predictionio_tpu import faults
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.data.storage import base, columnar_cache
 from predictionio_tpu.data.storage.memory import query_events
 
@@ -54,11 +56,28 @@ def fold_jsonl_file(
     if not path.exists():
         return
     with open(path) as f:
-        for line in f:
-            line = line.strip()
+        for raw in f:
+            # only the FINAL line of a log can legitimately be torn (a
+            # writer killed mid-append before its newline); a corrupt
+            # line anywhere else is real damage and still raises
+            complete = raw.endswith("\n")
+            line = raw.strip()
             if not line:
                 continue
-            rec = json.loads(line)
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if complete:
+                    raise
+                logger.warning(
+                    "dropping torn trailing record in %s (writer died "
+                    "mid-append; the event was never acked)", path
+                )
+                obs_metrics.counter(
+                    "pio_storage_torn_tail_dropped_total",
+                    "Torn (unacked) trailing log records dropped at replay",
+                ).inc()
+                break
             if "$delete" in rec:
                 eid = rec["$delete"]
                 table.pop(eid, None)
@@ -69,6 +88,56 @@ def fold_jsonl_file(
                 table[e.event_id] = e
                 if deleted is not None:
                     deleted.discard(e.event_id)
+
+
+def truncate_torn_tail(path: Path) -> int:
+    """Crash recovery for an append-only log: if the final line lacks
+    its newline (a writer was killed mid-append), truncate back to the
+    last complete record; returns the bytes dropped.
+
+    Must run BEFORE the first post-crash append — a new record written
+    after torn bytes would concatenate into one corrupt MID-file line,
+    which replay correctly refuses (only a final line may be torn).
+    Dropping the tail is safe: acks happen after write+flush at minimum,
+    and a completed flush puts the whole line in the page cache, which
+    a process kill does not tear — so torn bytes are never acked."""
+    try:
+        with open(path, "r+b") as f:
+            size = os.fstat(f.fileno()).st_size
+            if size == 0:
+                return 0
+            f.seek(size - 1)
+            if f.read(1) == b"\n":
+                return 0
+            pos = size
+            last_nl = -1
+            while pos > 0:
+                step = min(65536, pos)
+                f.seek(pos - step)
+                block = f.read(step)
+                nl = block.rfind(b"\n")
+                if nl >= 0:
+                    last_nl = pos - step + nl
+                    break
+                pos -= step
+            keep = last_nl + 1
+            f.truncate(keep)
+            f.flush()
+            os.fsync(f.fileno())
+    except FileNotFoundError:
+        return 0
+    except OSError:  # pragma: no cover - unreadable log: replay will say
+        return 0
+    dropped = size - keep
+    logger.warning(
+        "truncated %d torn (unacked) trailing bytes of %s before "
+        "reopening for append", dropped, path,
+    )
+    obs_metrics.counter(
+        "pio_storage_torn_tail_truncated_total",
+        "Torn trailing bytes truncated at append-reopen after a crash",
+    ).inc()
+    return dropped
 
 
 def _maybe_blank_lines(buf: bytes) -> bool:
@@ -341,6 +410,9 @@ class JSONLEvents(base.Events):
                 f.close()
             except OSError:  # pragma: no cover
                 pass
+        # first open of this log in this process: recover from a torn
+        # tail left by a crashed writer before any new bytes land
+        truncate_torn_tail(path)
         f = open(path, "ab")
         self._c.cache_fd(self._c.append_fds, key, f)
         return f
@@ -389,6 +461,7 @@ class JSONLEvents(base.Events):
             # the on-disk size is the true pre-append length
             pre_size = os.fstat(f.fileno()).st_size
             try:
+                faults.fault_point("storage.write")
                 f.write(blob)
                 f.flush()
             except Exception:
@@ -517,7 +590,9 @@ class JSONLEvents(base.Events):
             # fsync BEFORE replace: previously-acked (durable) records
             # are being rewritten — replacing them with an unsynced file
             # would un-durable them for a crash window
+            faults.fault_point("storage.fsync")
             os.fsync(f.fileno())
+        faults.fault_point("storage.rename")
         tmp.replace(path)
         # the replaced log has a new (mtime_ns, size) so a cached
         # columnar block could never serve stale — dropping it just

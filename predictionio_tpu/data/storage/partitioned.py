@@ -62,6 +62,7 @@ try:  # advisory cross-process locks; Unix-only (this framework targets Linux)
 except ImportError:  # pragma: no cover - non-POSIX fallback: thread lock only
     fcntl = None
 
+from predictionio_tpu import faults
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base, columnar_cache
 from predictionio_tpu.data.storage.jsonl import (
@@ -71,6 +72,7 @@ from predictionio_tpu.data.storage.jsonl import (
     has_delete_markers,
     prove_clean,
     prove_clean_chunked,
+    truncate_torn_tail,
 )
 from predictionio_tpu.data.storage.memory import query_events
 
@@ -132,6 +134,9 @@ class PartitionedStorageClient:
         # replay-clean (unique ids, no delete markers): lets scan_ratings
         # skip the uniqueness pass until any file changes
         self.clean_stat: dict[Path, tuple] = {}
+        # active logs already checked for a torn tail this process life —
+        # crash recovery runs once per log, before its first append
+        self.torn_checked: set[str] = set()
 
     def close(self) -> None:
         """Stop the interval syncer thread (Storage.close)."""
@@ -463,7 +468,9 @@ class PartitionedEvents(base.Events):
         # appends may still be awaiting their fsync, and once renamed
         # their coalescer would fsync a different (fresh) active file
         with open(active, "rb") as f:
+            faults.fault_point("storage.fsync")
             os.fsync(f.fileno())
+        faults.fault_point("storage.rename")
         active.rename(seg)
         # the rename preserves the file's bytes, size, and mtime, so a
         # columnar cache built for the active log stays valid — carry it
@@ -582,10 +589,22 @@ class PartitionedEvents(base.Events):
                 self._c.ns_partitions.pop(str(ns), None)
         return had_meta
 
+    def _recover_torn_locked(self, pdir: Path) -> None:
+        """Once per process per active log (caller holds the partition
+        lock): drop a torn tail left by a crashed writer before the
+        first new append lands after it."""
+        key = str(pdir / "active.jsonl")
+        if key not in self._c.torn_checked:
+            self._c.torn_checked.add(key)
+            truncate_torn_tail(Path(key))
+
     def _append_locked(self, pdir: Path, blob: bytes) -> None:
+        self._recover_torn_locked(pdir)
         with open(pdir / "active.jsonl", "ab") as f:
+            faults.fault_point("storage.write")
             f.write(blob)
             f.flush()
+            faults.fault_point("storage.fsync")
             os.fsync(f.fileno())
 
     def _log_supersede_locked(
@@ -650,8 +669,10 @@ class PartitionedEvents(base.Events):
         The flush-before-note_write ordering and the outside-the-lock
         wait are the group-commit protocol's invariants (groupcommit.py);
         every group-committed append must go through here."""
+        self._recover_torn_locked(pdir)
         active = pdir / "active.jsonl"
         with open(active, "ab") as f:
+            faults.fault_point("storage.write")
             f.write(blob)
             f.flush()
         committer = self._c.committers.get(active)

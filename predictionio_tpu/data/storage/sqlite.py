@@ -18,6 +18,7 @@ from datetime import datetime, timedelta, timezone
 from pathlib import Path
 from typing import Sequence
 
+from predictionio_tpu import faults
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
@@ -620,6 +621,7 @@ class SQLiteEvents(base.Events):
             rows.append(self._to_row(event, event_id))
         sql = f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)"
         with self._c.lock:
+            faults.fault_point("storage.sqlite.commit")
             try:
                 with self._c.conn:
                     self._c.conn.executemany(sql, rows)

@@ -767,12 +767,23 @@ def _device_bucket_arrays(buckets: Sequence[PaddedBucket]):
     )
 
 
-def als_train(data: RatingsData, params: ALSParams):
+def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
     """Run ALS; returns (user_factors, item_factors) as jax arrays.
 
     The full iteration loop runs as a single fused device program (one
     compile per unique set of bucket shapes; see _train_fused).
+
+    Checkpointing (``checkpoint_cfg`` or the PIO_CHECKPOINT_* env vars;
+    see core/checkpoint.py): the dynamic trip count lets the run be
+    dispatched as segments of ``every`` iterations feeding the donated
+    (U, V) carry back through the SAME compiled program — bit-identical
+    to one full-length dispatch, zero recompiles — with an atomic
+    snapshot of the carry persisted at each segment boundary. ``resume``
+    restores the latest fingerprint-matched snapshot and continues.
     """
+    from predictionio_tpu import faults
+    from predictionio_tpu.core import checkpoint as ckpt
+
     key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
     U = to_storage(init_factors(data.num_rows, params.rank, key_u), params.storage_dtype)
     V = to_storage(init_factors(data.num_cols, params.rank, key_v), params.storage_dtype)
@@ -780,17 +791,47 @@ def als_train(data: RatingsData, params: ALSParams):
     # static params key so runs differing only in iteration count share
     # one compiled program
     static_params = dataclasses.replace(params, iterations=0)
+    row_arrays = _device_bucket_arrays(data.row_buckets)
+    col_arrays = _device_bucket_arrays(data.col_buckets)
+
+    cfg = checkpoint_cfg if checkpoint_cfg is not None else ckpt.from_env()
+    start_iter = 0
+    fingerprint = None
+    if cfg is not None and cfg.active:
+        fingerprint = ckpt.data_fingerprint(
+            data.rows, data.cols, data.vals, static_params, mesh="single"
+        )
+        if cfg.resume:
+            snap = ckpt.load_checkpoint(cfg, fingerprint)
+            if snap is not None and snap.iteration <= params.iterations:
+                U = jax.device_put(snap.U)
+                V = jax.device_put(snap.V)
+                start_iter = snap.iteration
     import time as _time
 
     t0 = _time.perf_counter()
-    out = _train_fused(
-        U,
-        V,
-        _device_bucket_arrays(data.row_buckets),
-        _device_bucket_arrays(data.col_buckets),
-        static_params,
-        params.iterations,
-    )
+    if cfg is None or cfg.every <= 0:
+        faults.fault_point("device.dispatch")
+        out = _train_fused(
+            U, V, row_arrays, col_arrays, static_params,
+            params.iterations - start_iter,
+        )
+    else:
+        out = (U, V)
+        it = start_iter
+        while it < params.iterations:
+            seg = min(cfg.every, params.iterations - it)
+            faults.fault_point("device.dispatch")
+            out = _train_fused(
+                out[0], out[1], row_arrays, col_arrays, static_params, seg
+            )
+            it += seg
+            if it < params.iterations:
+                jax.block_until_ready(out)
+                ckpt.save_checkpoint(
+                    cfg, fingerprint, out[0], out[1], it, params.seed,
+                    mesh="single",
+                )
     jax.block_until_ready(out)
     total = _time.perf_counter() - t0
     from predictionio_tpu.obs import metrics as obs_metrics
@@ -800,13 +841,13 @@ def als_train(data: RatingsData, params: ALSParams):
         "Whole-run ALS training time",
         path="single",
     ).observe(total)
-    if params.iterations > 0:
+    if params.iterations > start_iter:
         # one fused fori_loop program — per-half-step is derived
         obs_metrics.histogram(
             "pio_als_halfstep_seconds",
             "Derived per-half-step time of the fused sharded ALS loop",
             mode="single",
-        ).observe(total / (2 * params.iterations))
+        ).observe(total / (2 * (params.iterations - start_iter)))
     return out
 
 

@@ -730,6 +730,7 @@ def sharded_als_train(
     mesh: Mesh,
     axis: str = "data",
     mode: str = "auto",
+    checkpoint_cfg=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Full multi-chip ALS with mesh-resident factors.
 
@@ -738,7 +739,16 @@ def sharded_als_train(
     match single-chip ``als_train`` for the same seed. ``mode`` is
     ``"gather"``, ``"ring"``, or ``"auto"`` (default: pick by the
     per-chip budget — ``choose_sharded_mode``). Returns (U, V) trimmed
-    to the true row counts (still sharded device arrays)."""
+    to the true row counts (still sharded device arrays).
+
+    Checkpointing (``checkpoint_cfg`` or PIO_CHECKPOINT_*; see
+    core/checkpoint.py): like single-chip ``als_train``, the dynamic
+    fori_loop bound lets the run dispatch in ``every``-iteration
+    segments through the one cached trainer, persisting the
+    layout-ordered sharded carry at each boundary. The fingerprint
+    carries the mesh descriptor (axis size + half-step mode), so a
+    snapshot can only resume onto the identical layout. Single-host
+    only: multi-host runs skip checkpointing with a warning."""
     if axis not in mesh.shape:
         raise ValueError(
             f"mesh has axes {tuple(mesh.axis_names)} but the sharded ALS "
@@ -769,19 +779,67 @@ def sharded_als_train(
     trainer = _fused_trainer(mesh, axis, mode, static_params)
     import time as _time
 
+    from predictionio_tpu import faults
+    from predictionio_tpu.core import checkpoint as ckpt
+
+    cfg = checkpoint_cfg if checkpoint_cfg is not None else ckpt.from_env()
+    if cfg is not None and cfg.active and jax.process_count() > 1:
+        logger.warning(
+            "checkpointing is single-host only; disabling for this "
+            "multi-host run"
+        )
+        cfg = None
+    factor = factor_sharding(mesh, axis)
+    start_iter = 0
+    fingerprint = None
+    mesh_desc = f"sharded:{axis}={shards}:{mode}"
+    if cfg is not None and cfg.active:
+        fingerprint = ckpt.data_fingerprint(
+            data.rows, data.cols, data.vals, static_params, mesh=mesh_desc
+        )
+        if cfg.resume:
+            snap = ckpt.load_checkpoint(cfg, fingerprint)
+            if snap is not None and snap.iteration <= params.iterations:
+                # the snapshot holds the layout-ordered padded tables;
+                # layouts derive deterministically from the (fingerprint-
+                # matched) data, so positions line up exactly
+                state.U = jax.device_put(snap.U, factor)
+                state.V = jax.device_put(snap.V, factor)
+                start_iter = snap.iteration
+
     t0 = _time.perf_counter()
-    U, V = trainer(state.U, state.V, row_pack, col_pack, params.iterations)
+    if cfg is None or cfg.every <= 0:
+        faults.fault_point("device.dispatch")
+        U, V = trainer(
+            state.U, state.V, row_pack, col_pack,
+            params.iterations - start_iter,
+        )
+    else:
+        U, V = state.U, state.V
+        it = start_iter
+        while it < params.iterations:
+            seg = min(cfg.every, params.iterations - it)
+            faults.fault_point("device.dispatch")
+            U, V = trainer(U, V, row_pack, col_pack, seg)
+            it += seg
+            if it < params.iterations:
+                # save_checkpoint host-copies the carry (np.asarray)
+                # before the next dispatch donates its buffers
+                jax.block_until_ready((U, V))
+                ckpt.save_checkpoint(
+                    cfg, fingerprint, U, V, it, params.seed, mesh=mesh_desc
+                )
     jax.block_until_ready((U, V))
     total = _time.perf_counter() - t0
     # the whole loop is ONE scan-fused jit program, so per-half-step
     # timing is derived: total / (2 * iterations). First-call totals
     # include the XLA compile — read p50, not max.
-    if params.iterations > 0:
+    if params.iterations > start_iter:
         obs_metrics.histogram(
             "pio_als_halfstep_seconds",
             "Derived per-half-step time of the fused sharded ALS loop",
             mode=mode,
-        ).observe(total / (2 * params.iterations))
+        ).observe(total / (2 * (params.iterations - start_iter)))
     obs_metrics.histogram(
         "pio_als_train_seconds",
         "Whole-run ALS training time",

@@ -25,6 +25,8 @@ import logging
 import threading
 import time
 
+from predictionio_tpu import faults
+from predictionio_tpu.common.breaker import CircuitBreaker
 from predictionio_tpu.data import store
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.realtime.foldin import ALSFoldIn, FoldInConfig
@@ -65,9 +67,18 @@ class SpeedLayer:
         interval: float = 5.0,
         cursor_path=None,
         batch_limit: int = 5000,
+        breaker: CircuitBreaker | None = None,
     ):
         self.server = server
         self.interval = float(interval)
+        # trips after repeated fold-in failures so a broken fold path
+        # stops consuming events (poll is gated on allow(), so events
+        # stay in the log, not tailed-and-dropped); the engine keeps
+        # serving the last good epoch-fenced model while open
+        self.breaker = breaker or CircuitBreaker(
+            "foldin", failure_threshold=3, base_backoff_s=2.0,
+            max_backoff_s=60.0,
+        )
         ds_params = server.engine_params.datasource[1]
         algo_params = server.engine_params.algorithms[0][1]
         self._config = FoldInConfig(
@@ -111,7 +122,7 @@ class SpeedLayer:
     def step(self) -> str:
         """One poll+fold+patch cycle; returns what happened (for tests
         and logs): "superseded" | "idle" | "patched" | "fenced" |
-        "skipped"."""
+        "skipped" | "breaker_open" | "fold_failed"."""
         inst_id, models, epoch = self.server.model_snapshot()
         if inst_id != self._instance_id:
             # retrain won: the new instance's training read covered the
@@ -127,6 +138,11 @@ class SpeedLayer:
             self.foldin.cold_items.clear()
             self._caught_up_at = time.time()
             return "superseded"
+
+        if not self.breaker.allow():
+            # open breaker: don't poll — a poll persists the cursor, so
+            # tailing events we then can't fold would silently drop them
+            return "breaker_open"
 
         t_p0 = time.perf_counter()
         events = self.tailer.poll()
@@ -144,12 +160,28 @@ class SpeedLayer:
             stats = None
             for m in models:
                 if _is_als_model(m):
-                    patched, stats = self.foldin.fold(m, events)
+                    try:
+                        faults.fault_point("foldin.fold")
+                        patched, stats = self.foldin.fold(m, events)
+                    except Exception:
+                        # the poll already persisted the cursor, so this
+                        # batch is lost to fold-in (at-most-once; the
+                        # next retrain covers it) — count the failure
+                        # and let the breaker decide whether to keep
+                        # attempting future batches
+                        self.breaker.record_failure()
+                        self._last_fold_s = time.perf_counter() - t0
+                        logger.exception(
+                            "fold-in failed (%d events not folded; "
+                            "breaker %s)", len(events), self.breaker.state,
+                        )
+                        return "fold_failed"
                     if patched is not None:
                         new_models.append(patched)
                         patched_any = True
                         continue
                 new_models.append(m)
+            self.breaker.record_success()
             if not patched_any:
                 self._last_fold_s = time.perf_counter() - t0
                 return "skipped"  # no foldable events for any model
@@ -205,6 +237,7 @@ class SpeedLayer:
             "cold_start_items": len(self.foldin.cold_items),
             "last_fold_s": round(self._last_fold_s, 6),
             "query_cache_invalidations": self.cache_invalidations,
+            "breaker": self.breaker.snapshot(),
         }
 
     # -- lifecycle ----------------------------------------------------------

@@ -34,7 +34,9 @@ import os
 import time
 from pathlib import Path
 
+from predictionio_tpu import faults
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.obs import metrics as obs_metrics
 
 logger = logging.getLogger(__name__)
 
@@ -145,27 +147,42 @@ class EventTailer:
     def _load(self) -> bool:
         if self._cursor_path is None or not self._cursor_path.exists():
             return False
+        # any corruption — torn/truncated JSON, valid JSON with the wrong
+        # structure (non-dict, missing _FileCursor fields, non-numeric
+        # watermark) — degrades to False: the caller re-attaches at the
+        # watermark (reset()) instead of crashing the speed layer
         try:
             state = json.loads(self._cursor_path.read_text())
-        except (OSError, ValueError):
-            logger.warning("unreadable tailer cursor %s; resetting", self._cursor_path)
-            return False
-        if state.get("version") != _CURSOR_VERSION or state.get("mode") != self.mode:
+            if state.get("version") != _CURSOR_VERSION or state.get("mode") != self.mode:
+                logger.warning(
+                    "tailer cursor %s is for mode %r (we are %r); resetting",
+                    self._cursor_path,
+                    state.get("mode"),
+                    self.mode,
+                )
+                return False
+            watermark = float(state.get("watermark", 0.0))
+            seen = set(state.get("seen", ()))
+            seq = state.get("seq")
+            files = {
+                p: _FileCursor(c["offset"], c["ino"], c["mtime_ns"], c["size"])
+                for p, c in state.get("files", {}).items()
+            }
+        except (OSError, ValueError, KeyError, TypeError, AttributeError):
             logger.warning(
-                "tailer cursor %s is for mode %r (we are %r); resetting",
+                "corrupt tailer cursor %s; re-attaching at the watermark",
                 self._cursor_path,
-                state.get("mode"),
-                self.mode,
             )
+            obs_metrics.counter(
+                "pio_tailer_cursor_recovered",
+                "Tailer restarts that discarded a corrupt cursor file",
+            ).inc()
             return False
-        self._watermark = float(state.get("watermark", 0.0))
-        self._seen = set(state.get("seen", ()))
-        self._seq = state.get("seq")
+        self._watermark = watermark
+        self._seen = seen
+        self._seq = seq
         self._token = None  # change tokens don't survive restart; re-scan
-        self._files = {
-            p: _FileCursor(c["offset"], c["ino"], c["mtime_ns"], c["size"])
-            for p, c in state.get("files", {}).items()
-        }
+        self._files = files
         return True
 
     def _save(self) -> None:
@@ -187,6 +204,7 @@ class EventTailer:
         self._cursor_path.parent.mkdir(parents=True, exist_ok=True)
         tmp = self._cursor_path.with_name(self._cursor_path.name + ".tmp")
         tmp.write_text(json.dumps(state))
+        faults.fault_point("storage.rename")
         os.replace(tmp, self._cursor_path)
 
     # -- polling ------------------------------------------------------------

@@ -29,12 +29,16 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import threading
 import time
 import urllib.request
 import uuid
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any
 
+from predictionio_tpu import faults
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.workflow import prepare_deploy
 from predictionio_tpu.data.storage import EngineInstance, Storage, get_storage
@@ -55,6 +59,12 @@ from predictionio_tpu.server.query_cache import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+class QueryDeadlineExceeded(Exception):
+    """A query overran the configured per-query deadline
+    (PIO_QUERY_DEADLINE_MS); the route maps this to 503 + Retry-After
+    instead of letting the client hang."""
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -294,6 +304,7 @@ class EngineServer:
         dispatch_cost_s: float | None = None,
         reuse_port: bool = False,
         query_cache_mb: float = 0.0,
+        query_deadline_ms: float | None = None,
     ):
         self.engine = engine
         self.storage = storage or get_storage()
@@ -318,6 +329,34 @@ class EngineServer:
         self._foldin_epoch = 0
         self.speed_layer = None  # attached by realtime.SpeedLayer
         self.query_cache: QueryCache | None = None
+        # set while deploy warmup overlaps live traffic (reuse_port
+        # workers, late warmups): /queries.json answers 503 +
+        # Retry-After instead of paying a compile it didn't order.
+        # /reload does NOT set this — the old model serves through the
+        # whole swap (prepare_deploy runs off-lock, the swap is atomic)
+        self._swapping = threading.Event()
+        # per-query deadline (PIO_QUERY_DEADLINE_MS or query_deadline_ms
+        # arg): a query that overruns it gets 503 + Retry-After instead
+        # of hanging its connection; None = unbounded (the default)
+        if query_deadline_ms is None:
+            try:
+                query_deadline_ms = float(
+                    os.environ.get("PIO_QUERY_DEADLINE_MS", "0").strip() or 0
+                )
+            except ValueError:
+                logger.warning("ignoring non-numeric PIO_QUERY_DEADLINE_MS")
+                query_deadline_ms = 0.0
+        self.query_deadline_s = (
+            query_deadline_ms / 1e3 if query_deadline_ms > 0 else None
+        )
+        # unbatched queries only need a watcher thread when a deadline is
+        # configured; sized for concurrency, not parallelism (scoring
+        # remains device-bound)
+        self._deadline_pool = (
+            ThreadPoolExecutor(max_workers=32, thread_name_prefix="query-ddl")
+            if self.query_deadline_s is not None
+            else None
+        )
         self._load(instance)
 
         self.request_count = 0
@@ -452,9 +491,35 @@ class EngineServer:
             and self.batcher.active
             and self.batcher.engaged
         ):
-            response_obj = self.batcher.submit(body).result(timeout=60)
+            try:
+                response_obj = self.batcher.submit(body).result(
+                    timeout=self.query_deadline_s or 60
+                )
+            except FuturesTimeout:
+                obs_metrics.counter(
+                    "pio_query_deadline_exceeded_total",
+                    "Queries 503'd for overrunning PIO_QUERY_DEADLINE_MS",
+                    path="batched",
+                ).inc()
+                raise QueryDeadlineExceeded(
+                    "query exceeded the per-query deadline"
+                ) from None
+            except RuntimeError as e:
+                # batcher INFRASTRUCTURE failure (dead worker / stopping
+                # server), not a query error: degrade to the unbatched
+                # path so the request still serves
+                if str(e) not in ("batch worker failed", "server stopping"):
+                    raise
+                obs_metrics.counter(
+                    "pio_batcher_fallback_total",
+                    "Queries served unbatched after a micro-batcher failure",
+                ).inc()
+                logger.warning(
+                    "micro-batcher unavailable (%s); serving unbatched", e
+                )
+                response_obj = self._query_with_deadline(body)
         else:
-            response_obj = self.handle_query(body)
+            response_obj = self._query_with_deadline(body)
         payload = jsonx.dumps_bytes(response_obj)
         if key is not None and self._query_cacheable(body):
             cache.put(key, payload)
@@ -473,7 +538,28 @@ class EngineServer:
             return False
         return all(a.cacheable_query(supplemented) for a in algorithms)
 
+    def _query_with_deadline(self, body: dict[str, Any]) -> dict[str, Any]:
+        """Unbatched scoring under the per-query deadline (a plain
+        ``handle_query`` call when no deadline is configured — the
+        zero-cost default path)."""
+        if self.query_deadline_s is None:
+            return self.handle_query(body)
+        fut = self._deadline_pool.submit(self.handle_query, body)
+        try:
+            return fut.result(timeout=self.query_deadline_s)
+        except FuturesTimeout:
+            fut.cancel()  # best-effort; a started call finishes discarded
+            obs_metrics.counter(
+                "pio_query_deadline_exceeded_total",
+                "Queries 503'd for overrunning PIO_QUERY_DEADLINE_MS",
+                path="unbatched",
+            ).inc()
+            raise QueryDeadlineExceeded(
+                "query exceeded the per-query deadline"
+            ) from None
+
     def handle_query(self, body: dict[str, Any]) -> dict[str, Any]:
+        faults.fault_point("serve.query")
         t0 = time.perf_counter()
         with self._lock:
             algorithms, models, serving = self.algorithms, self.models, self.serving
@@ -567,6 +653,7 @@ class EngineServer:
                 (n_real + j, indexed[0][1]) for j in range(pad_to - n_real)
             ]
             t_d0 = time.perf_counter()
+            faults.fault_point("serve.batch_dispatch")
             per_algo = [
                 dict(a.batch_predict(m, indexed))
                 for a, m in zip(algorithms, models)
@@ -676,6 +763,10 @@ class EngineServer:
         )
         if latest is None:
             return False
+        # the expensive prepare_deploy runs OFF the server lock and the
+        # swap itself is atomic (_load), so the OLD model keeps serving
+        # 200s for the whole reload — never 503 here; failing queries a
+        # working model could answer would be degradation, not grace
         self._load(latest)
         return True
 
@@ -794,11 +885,33 @@ class EngineServer:
 
         @router.route("POST", "/queries.json")
         def queries(request: Request) -> Response:
+            if server._swapping.is_set():
+                obs_metrics.counter(
+                    "pio_query_unavailable_total",
+                    "Queries 503'd while unavailable",
+                    reason="swap",
+                ).inc()
+                return Response(
+                    status=503,
+                    body={"message": "model swap in progress; retry shortly"},
+                    headers={"Retry-After": "1"},
+                )
             body = request.json()
             if not isinstance(body, dict):
                 return Response.error("request body must be a JSON object", 400)
             try:
                 return Response.json_bytes(server.serve_query_bytes(body))
+            except QueryDeadlineExceeded as e:
+                obs_metrics.counter(
+                    "pio_query_unavailable_total",
+                    "Queries 503'd while unavailable",
+                    reason="deadline",
+                ).inc()
+                return Response(
+                    status=503,
+                    body={"message": str(e)},
+                    headers={"Retry-After": "1"},
+                )
             except (TypeError, KeyError, ValueError) as e:
                 # reference: MappingException -> 400 + remote log
                 # (CreateServer.scala:596-604)
@@ -889,23 +1002,31 @@ class EngineServer:
         with self._lock:
             algorithms, models = self.algorithms, self.models
         warmed = 0
-        for a, m in zip(algorithms, models):
-            try:
-                q = a.warmup_query(m)
-                if q is None:
-                    continue
-                t0 = time.perf_counter()
-                a.batch_predict(m, [(0, q)])
-                logger.info(
-                    "warmup: %s compiled+scored in %.3fs",
-                    type(a).__name__, time.perf_counter() - t0,
-                )
-                warmed += 1
-            except Exception:
-                logger.exception(
-                    "warmup predict failed for %s (serving unaffected)",
-                    type(a).__name__,
-                )
+        # normally warmup runs before the port binds, but reuse_port
+        # workers and late warmups can overlap live traffic — those
+        # queries get 503 + Retry-After instead of queueing behind the
+        # warm-up compile
+        self._swapping.set()
+        try:
+            for a, m in zip(algorithms, models):
+                try:
+                    q = a.warmup_query(m)
+                    if q is None:
+                        continue
+                    t0 = time.perf_counter()
+                    a.batch_predict(m, [(0, q)])
+                    logger.info(
+                        "warmup: %s compiled+scored in %.3fs",
+                        type(a).__name__, time.perf_counter() - t0,
+                    )
+                    warmed += 1
+                except Exception:
+                    logger.exception(
+                        "warmup predict failed for %s (serving unaffected)",
+                        type(a).__name__,
+                    )
+        finally:
+            self._swapping.clear()
         return warmed
 
     def start(self, background: bool = True) -> int:
@@ -918,4 +1039,6 @@ class EngineServer:
             self.speed_layer.stop()
         if self.batcher is not None:
             self.batcher.stop()
+        if self._deadline_pool is not None:
+            self._deadline_pool.shutdown(wait=False)
         self.app.stop()

@@ -18,6 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu import faults
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import jsonx
@@ -406,6 +407,7 @@ class HTTPApp:
                         else self.rfile
                     )
                 try:
+                    faults.fault_point("http.read")
                     line = reader.readline(65537)
                 except OSError:
                     return
@@ -634,6 +636,7 @@ class HTTPApp:
                     # handshake happens lazily on first read in the worker
                     # thread, so a silent client (TCP health probe) can't
                     # stall the accept loop
+                    faults.fault_point("http.accept")
                     sock, addr = self.socket.accept()
                     sock.settimeout(read_timeout)
                     tls = ssl_context.wrap_socket(
@@ -643,7 +646,16 @@ class HTTPApp:
 
             server_cls = _TLSServer
         else:
-            server_cls = ThreadingHTTPServer
+
+            class _PlainServer(ThreadingHTTPServer):
+                def get_request(self):
+                    # FaultError subclasses OSError, so an injected accept
+                    # failure takes the same socketserver swallow-and-
+                    # continue path a real transient accept error does
+                    faults.fault_point("http.accept")
+                    return super().get_request()
+
+            server_cls = _PlainServer
         if self.reuse_port:
             if self.port == 0:
                 raise ValueError(

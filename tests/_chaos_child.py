@@ -1,0 +1,63 @@
+"""Subprocess body for the kill-9 / chaos tests (underscore prefix: not
+collected by pytest). The parent passes a JSON config path; PIO_FAULTS
+in the inherited environment arms the kill. The child ingests events
+one at a time and prints a flushed ``ACK <event_id>`` line after each
+insert RETURNS — the durability contract under test is exactly "an
+acked event survives the kill, an unacked one never half-appears".
+
+Config keys:
+  env           storage env dict for Storage(env=...)
+  app_id        int
+  n_events      how many events to insert
+  seed          rng seed for the deterministic user/item/rating stream
+  explicit_ids  optional bool: stamp deterministic event ids (ev0000,
+                ev0001, ...) so a post-crash RE-RUN of the whole stream
+                is idempotent — inserts with an existing id replace in
+                place, leaving the final replay identical to a clean run
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage
+
+
+def event_stream(seed: int, n: int, explicit_ids: bool = False):
+    """The deterministic ingest workload; the parent re-derives the same
+    stream to check recovered content, so keep this pure."""
+    rng = random.Random(seed)
+    for i in range(n):
+        yield Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"u{rng.randrange(10)}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.randrange(8)}",
+            properties={"rating": float(rng.randrange(1, 6)), "n": i},
+            event_id=f"ev{i:04d}" if explicit_ids else None,
+        )
+
+
+def main() -> int:
+    with open(sys.argv[1]) as f:
+        cfg = json.load(f)
+    storage = Storage(env=cfg["env"])
+    events = storage.get_events()
+    for ev in event_stream(
+        cfg["seed"], cfg["n_events"], cfg.get("explicit_ids", False)
+    ):
+        eid = events.insert(ev, cfg["app_id"])
+        # flushed BEFORE the next insert: everything printed is acked-
+        # durable, anything in flight at the kill is not printed
+        print(f"ACK {eid}", flush=True)
+    storage.close()
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,192 @@
+"""Seeded end-to-end chaos: a live ingest -> train -> deploy -> query
+pipeline is SIGKILLed at a randomly chosen (but seeded) storage fault
+point, restarted, and driven to completion. The acceptance contract
+from the robustness issue:
+
+  * zero acked-event loss — every ``ACK``ed event is present after the
+    restart, and
+
+  * query parity — the recovered pipeline's deployed model answers every
+    probe query with byte-identical responses to an uninterrupted twin
+    run over the same event stream.
+
+Parity leans on idempotent re-runs: the child stamps deterministic
+explicit event ids, so replaying the whole stream after the crash
+replaces the already-durable prefix in place and the final replay order
+matches the clean run exactly — which makes training bit-identical and
+the serialized query responses byte-equal.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from predictionio_tpu.data.storage import Storage, set_storage
+from predictionio_tpu.cli import commands
+
+from tests.test_storage import _backend_env, _run_chaos_child
+
+N_EVENTS = 60
+SEED = 11
+PROBES = [{"user": f"u{u}", "num": 5} for u in range(10)]
+
+
+def _make_app(env_dict):
+    """App metadata must exist before the child ingests (the child only
+    talks to the events DAO)."""
+    storage = Storage(env=env_dict)
+    try:
+        info = commands.app_new("ChaosApp", storage=storage)
+    finally:
+        storage.close()
+    return info["id"]
+
+
+def _run_child(tmp_path, env_dict, app_id, faults_spec):
+    """test_storage's harness, extended with explicit ids + app id."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    cfg = {
+        "env": env_dict,
+        "app_id": app_id,
+        "n_events": N_EVENTS,
+        "seed": SEED,
+        "explicit_ids": True,
+    }
+    cfg_path = tmp_path / "chaos_cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+    child = Path(__file__).with_name("_chaos_child.py")
+    env = dict(os.environ)
+    if faults_spec:
+        env["PIO_FAULTS"] = faults_spec
+    else:
+        env.pop("PIO_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", str(child.parent.parent))
+    proc = subprocess.run(
+        [sys.executable, str(child), str(cfg_path)],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    acked = [
+        line.split(" ", 1)[1]
+        for line in proc.stdout.splitlines()
+        if line.startswith("ACK ")
+    ]
+    done = any(line == "DONE" for line in proc.stdout.splitlines())
+    return proc, acked, done, signal
+
+
+def _train_and_probe(env_dict, app_name="ChaosApp"):
+    """Train on whatever the store holds and answer the probe queries
+    through the real serving path (no socket needed); returns the raw
+    response bytes keyed by probe index."""
+    from predictionio_tpu.core import EngineParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.models import recommendation as rec
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    storage = Storage(env=env_dict)
+    # the datasource resolves app names through the process singleton
+    set_storage(storage)
+    try:
+        engine = rec.engine()
+        ep = EngineParams(
+            datasource=("", rec.DataSourceParams(app_name=app_name)),
+            algorithms=[
+                ("als", rec.ALSAlgorithmParams(rank=4, num_iterations=3))
+            ],
+        )
+        run_train(engine, ep, engine_id="chaos", storage=storage)
+        instance = (
+            storage.get_metadata_engine_instances().get_latest_completed(
+                "chaos", "0", "default"
+            )
+        )
+        server = EngineServer(
+            engine, instance, storage=storage, host="127.0.0.1", port=0,
+            server_key="secret",
+        )
+        try:
+            return [bytes(server.serve_query_bytes(dict(q))) for q in PROBES]
+        finally:
+            server.stop()
+    finally:
+        set_storage(None)
+        storage.close()
+
+
+@pytest.mark.chaos
+class TestChaosPipeline:
+    def test_kill9_restart_zero_loss_and_query_parity(self, tmp_path):
+        # the seeded chaos schedule: which durability fault point fires,
+        # and after how many calls
+        rng = random.Random(SEED)
+        point = rng.choice(["storage.write", "storage.fsync"])
+        nth = rng.randrange(10, 45)
+        spec = f"{point}:nth={nth}:kill"
+
+        chaos_dir = tmp_path / "chaos"
+        clean_dir = tmp_path / "clean"
+        chaos_dir.mkdir()
+        clean_dir.mkdir()
+
+        # -- uninterrupted twin: same stream, no faults ------------------
+        clean_env = _backend_env("jsonl", clean_dir)
+        clean_app = _make_app(clean_env)
+        proc, clean_acked, done, _ = _run_child(
+            clean_dir, clean_env, clean_app, ""
+        )
+        assert proc.returncode == 0 and done, proc.stderr
+        assert len(clean_acked) == N_EVENTS
+
+        # -- chaos run: kill-9 mid-ingest --------------------------------
+        chaos_env = _backend_env("jsonl", chaos_dir)
+        chaos_app = _make_app(chaos_env)
+        proc, acked, done, signal = _run_child(
+            chaos_dir, chaos_env, chaos_app, spec
+        )
+        assert proc.returncode == -signal.SIGKILL, (spec, proc.stderr)
+        assert not done
+        assert acked, f"kill {spec} landed before any ack"
+
+        # zero acked-event loss on the reopened store
+        recovered = Storage(env=chaos_env)
+        try:
+            ids = {
+                e.event_id
+                for e in recovered.get_events().find(chaos_app)
+            }
+        finally:
+            recovered.close()
+        lost = set(acked) - ids
+        assert not lost, f"acked events lost after {spec}: {lost}"
+
+        # restart: replay the whole stream idempotently to completion
+        proc, acked2, done, _ = _run_child(chaos_dir, chaos_env, chaos_app, "")
+        assert proc.returncode == 0 and done, proc.stderr
+        assert len(acked2) == N_EVENTS
+
+        # -- train + deploy + query both, compare raw response bytes -----
+        chaos_answers = _train_and_probe(chaos_env)
+        clean_answers = _train_and_probe(clean_env)
+        for probe, a, b in zip(PROBES, chaos_answers, clean_answers):
+            assert a == b, f"query diverged after recovery: {probe}"
+
+    def test_seeded_schedule_is_deterministic(self):
+        """The chaos schedule itself must be reproducible — two draws
+        from the same seed pick the same fault point and call count."""
+        draws = []
+        for _ in range(2):
+            rng = random.Random(SEED)
+            draws.append(
+                (rng.choice(["storage.write", "storage.fsync"]),
+                 rng.randrange(10, 45))
+            )
+        assert draws[0] == draws[1]

@@ -1,0 +1,265 @@
+"""Crash-safe checkpointed training: atomic snapshot round-trips,
+fingerprint gating, and the ISSUE acceptance bar — ``--resume``
+continues bit-identically on a single chip AND on the virtual 8-device
+mesh (conftest forces ``--xla_force_host_platform_device_count=8``)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu import faults
+from predictionio_tpu.core import checkpoint as ckpt
+from predictionio_tpu.ops import als
+
+
+def _data(seed=0, n_u=30, n_i=20, nnz=200):
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, n_u, nnz).astype(np.int32)
+    cols = rng.integers(0, n_i, nnz).astype(np.int32)
+    vals = (1 + 4 * rng.random(nnz)).astype(np.float32)
+    return als.build_ratings_data(rows, cols, vals, n_u, n_i)
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("every", 2)
+    return ckpt.CheckpointConfig(directory=str(tmp_path / "ckpt"), **kw)
+
+
+def _host(table):
+    """Comparable host copy of a factor table (dense or int8 pair)."""
+    if isinstance(table, tuple):
+        return tuple(np.asarray(t) for t in table)
+    return np.asarray(table)
+
+
+def _same(a, b) -> bool:
+    a, b = _host(a), _host(b)
+    if isinstance(a, tuple) != isinstance(b, tuple):
+        return False
+    if isinstance(a, tuple):
+        return all(np.array_equal(x, y) for x, y in zip(a, b))
+    return np.array_equal(a, b)
+
+
+class TestSnapshotFile:
+    def test_save_load_roundtrip(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        U = np.arange(12, dtype=np.float32).reshape(3, 4)
+        V = np.arange(8, dtype=np.float32).reshape(2, 4)
+        assert ckpt.save_checkpoint(cfg, "fp1", U, V, iteration=5, seed=9)
+        snap = ckpt.load_checkpoint(cfg, "fp1")
+        assert snap is not None
+        assert snap.iteration == 5 and snap.seed == 9 and snap.mesh == "single"
+        assert np.array_equal(snap.U, U) and np.array_equal(snap.V, V)
+
+    def test_int8_pair_roundtrip(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        U = (
+            np.arange(12, dtype=np.int8).reshape(3, 4),
+            np.ones(3, dtype=np.float32),
+        )
+        V = np.zeros((2, 4), np.float32)
+        assert ckpt.save_checkpoint(cfg, "fp1", U, V, iteration=1, seed=0)
+        snap = ckpt.load_checkpoint(cfg, "fp1")
+        assert isinstance(snap.U, tuple) and _same(snap.U, U)
+        assert not isinstance(snap.V, tuple)
+
+    def test_missing_and_corrupt_load_to_none(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        assert ckpt.load_checkpoint(cfg, "nope") is None
+        path = ckpt.checkpoint_path(cfg, "torn")
+        path.parent.mkdir(parents=True)
+        path.write_bytes(b"PK\x03\x04 definitely not a whole npz")
+        assert ckpt.load_checkpoint(cfg, "torn") is None
+
+    def test_fingerprint_mismatch_refused(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        U = np.zeros((2, 2), np.float32)
+        ckpt.save_checkpoint(cfg, "fpA", U, U, iteration=1, seed=0)
+        # same file name, different expected fingerprint (e.g. operator
+        # copied a checkpoint dir between runs)
+        path = ckpt.checkpoint_path(cfg, "fpA")
+        path.rename(ckpt.checkpoint_path(cfg, "fpB"))
+        assert ckpt.load_checkpoint(cfg, "fpB") is None
+
+    def test_failed_write_is_best_effort(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        U = np.zeros((2, 2), np.float32)
+        with faults.injected("train.checkpoint:times=1"):
+            assert not ckpt.save_checkpoint(cfg, "fp", U, U, 1, 0)
+        # a kill between tmp write and rename leaves no visible file
+        with faults.injected("storage.rename:times=1"):
+            assert not ckpt.save_checkpoint(cfg, "fp", U, U, 1, 0)
+        assert ckpt.load_checkpoint(cfg, "fp") is None
+        assert ckpt.save_checkpoint(cfg, "fp", U, U, 1, 0)  # clean retry
+
+    def test_fingerprint_ignores_iterations_but_not_data(self):
+        d = _data()
+        p6 = als.ALSParams(rank=4, iterations=6, reg=0.1)
+        p10 = als.ALSParams(rank=4, iterations=10, reg=0.1)
+        fp = ckpt.data_fingerprint(d.rows, d.cols, d.vals, p6)
+        assert fp == ckpt.data_fingerprint(d.rows, d.cols, d.vals, p10)
+        other = _data(seed=1)
+        assert fp != ckpt.data_fingerprint(other.rows, other.cols, other.vals, p6)
+        p_reg = als.ALSParams(rank=4, iterations=6, reg=0.2)
+        assert fp != ckpt.data_fingerprint(d.rows, d.cols, d.vals, p_reg)
+        assert fp != ckpt.data_fingerprint(d.rows, d.cols, d.vals, p6, mesh="sharded:data=8:gather")
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv("PIO_CHECKPOINT_EVERY", raising=False)
+        monkeypatch.delenv("PIO_RESUME", raising=False)
+        assert ckpt.from_env() is None
+        monkeypatch.setenv("PIO_CHECKPOINT_EVERY", "3")
+        monkeypatch.setenv("PIO_CHECKPOINT_DIR", "/tmp/x")
+        cfg = ckpt.from_env()
+        assert cfg.every == 3 and cfg.directory == "/tmp/x" and not cfg.resume
+        monkeypatch.setenv("PIO_RESUME", "1")
+        assert ckpt.from_env().resume
+
+
+class TestSingleChipResume:
+    def test_checkpointed_run_matches_plain(self, tmp_path):
+        data, params = _data(), als.ALSParams(rank=4, iterations=6, reg=0.1)
+        U0, V0 = als.als_train(data, params)
+        U1, V1 = als.als_train(data, params, checkpoint_cfg=_cfg(tmp_path))
+        assert _same(U0, U1) and _same(V0, V1)
+
+    def test_resume_after_kill_is_bit_identical(self, tmp_path):
+        """Kill a 6-iteration run after 4 (emulated by training a 4-iter
+        twin, which leaves the iteration-2 snapshot on disk), then
+        --resume the full run: factors must equal the uninterrupted run
+        bit for bit."""
+        data, cfg = _data(), _cfg(tmp_path)
+        full = als.ALSParams(rank=4, iterations=6, reg=0.1)
+        U0, V0 = als.als_train(data, full)
+        als.als_train(
+            data, als.ALSParams(rank=4, iterations=4, reg=0.1),
+            checkpoint_cfg=cfg,
+        )
+        snap = ckpt.load_checkpoint(
+            cfg, ckpt.data_fingerprint(data.rows, data.cols, data.vals, full)
+        )
+        assert snap is not None and snap.iteration == 2
+        U2, V2 = als.als_train(
+            data, full, checkpoint_cfg=_cfg(tmp_path, resume=True)
+        )
+        assert _same(U0, U2) and _same(V0, V2)
+
+    def test_resume_int8_storage_bit_identical(self, tmp_path):
+        data, cfg = _data(), _cfg(tmp_path)
+        full = als.ALSParams(rank=4, iterations=6, reg=0.1, storage_dtype="int8")
+        U0, V0 = als.als_train(data, full)
+        als.als_train(
+            data,
+            als.ALSParams(rank=4, iterations=4, reg=0.1, storage_dtype="int8"),
+            checkpoint_cfg=cfg,
+        )
+        U2, V2 = als.als_train(
+            data, full, checkpoint_cfg=_cfg(tmp_path, resume=True)
+        )
+        assert _same(U0, U2) and _same(V0, V2)
+
+    def test_resume_without_checkpoint_trains_from_scratch(self, tmp_path):
+        data, params = _data(), als.ALSParams(rank=4, iterations=3, reg=0.1)
+        U0, V0 = als.als_train(data, params)
+        U1, V1 = als.als_train(
+            data, params, checkpoint_cfg=_cfg(tmp_path, every=0, resume=True)
+        )
+        assert _same(U0, U1) and _same(V0, V1)
+
+    def test_corrupt_checkpoint_degrades_to_scratch(self, tmp_path):
+        data, cfg = _data(), _cfg(tmp_path)
+        params = als.ALSParams(rank=4, iterations=4, reg=0.1)
+        als.als_train(data, params, checkpoint_cfg=cfg)
+        fp = ckpt.data_fingerprint(data.rows, data.cols, data.vals, params)
+        ckpt.checkpoint_path(cfg, fp).write_bytes(b"garbage")
+        U0, V0 = als.als_train(data, params)
+        U1, V1 = als.als_train(
+            data, params, checkpoint_cfg=_cfg(tmp_path, resume=True)
+        )
+        assert _same(U0, U1) and _same(V0, V1)
+
+
+class TestShardedResume:
+    def _sharded_data(self):
+        rng = np.random.default_rng(6)
+        hot = 85
+        rows = np.concatenate(
+            [np.zeros(hot, np.int32), rng.integers(1, 30, 120).astype(np.int32)]
+        )
+        cols = np.concatenate(
+            [
+                np.arange(hot, dtype=np.int32) % 40,
+                rng.integers(0, 40, 120).astype(np.int32),
+            ]
+        )
+        vals = (1 + 4 * rng.random(len(rows))).astype(np.float32)
+        return als.build_ratings_data(rows, cols, vals, 30, 40, bucket_widths=(4, 8))
+
+    def test_resume_on_virtual_8_device_mesh_bit_identical(self, tmp_path):
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh([("data", 8)])
+        data, cfg = self._sharded_data(), _cfg(tmp_path)
+        full = als.ALSParams(rank=4, iterations=6, reg=0.1)
+        U0, V0 = sharded_als_train(data, full, mesh)
+        # checkpointed run is itself bit-identical
+        U1, V1 = sharded_als_train(data, full, mesh, checkpoint_cfg=cfg)
+        assert _same(U0, U1) and _same(V0, V1)
+        # kill-after-4 twin, then resume the 6-iteration run
+        sharded_als_train(
+            data, als.ALSParams(rank=4, iterations=4, reg=0.1), mesh,
+            checkpoint_cfg=cfg,
+        )
+        U2, V2 = sharded_als_train(
+            data, full, mesh, checkpoint_cfg=_cfg(tmp_path, resume=True)
+        )
+        assert _same(U0, U2) and _same(V0, V2)
+
+    def test_single_chip_snapshot_never_restores_into_mesh(self, tmp_path):
+        """The mesh descriptor is part of the fingerprint: a sharded run
+        must not restore a single-chip carry (layout-permuted tables)."""
+        from predictionio_tpu.parallel.als_sharded import sharded_als_train
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh([("data", 8)])
+        data, cfg = self._sharded_data(), _cfg(tmp_path)
+        params = als.ALSParams(rank=4, iterations=4, reg=0.1)
+        als.als_train(data, params, checkpoint_cfg=cfg)  # single-chip snapshot
+        U0, V0 = sharded_als_train(data, params, mesh)
+        U1, V1 = sharded_als_train(
+            data, params, mesh, checkpoint_cfg=_cfg(tmp_path, resume=True)
+        )
+        assert _same(U0, U1) and _same(V0, V1)  # trained from scratch
+
+
+class TestTrainCLIPlumbing:
+    def test_train_flags_set_env(self, monkeypatch, tmp_path):
+        from predictionio_tpu.cli import main as cli_main
+
+        captured = {}
+
+        def fake_engine_from_args(args):
+            raise SystemExit(0)  # stop before real training
+
+        monkeypatch.delenv("PIO_CHECKPOINT_EVERY", raising=False)
+        monkeypatch.delenv("PIO_RESUME", raising=False)
+        monkeypatch.delenv("PIO_CHECKPOINT_DIR", raising=False)
+        monkeypatch.setattr(cli_main, "_engine_from_args", fake_engine_from_args)
+        parser = cli_main.build_parser()
+        args = parser.parse_args(
+            [
+                "train", "--checkpoint-every", "5", "--resume",
+                "--checkpoint-dir", str(tmp_path),
+            ]
+        )
+        with pytest.raises(SystemExit):
+            args.fn(args)
+        import os
+
+        assert os.environ["PIO_CHECKPOINT_EVERY"] == "5"
+        assert os.environ["PIO_RESUME"] == "1"
+        assert os.environ["PIO_CHECKPOINT_DIR"] == str(tmp_path)
+        assert captured == {}

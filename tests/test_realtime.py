@@ -878,3 +878,163 @@ class TestQueryCacheEpochFence:
         status, body = http("GET", cached_deployed["base"] + "/stats.json")
         assert status == 200
         assert body["realtime"]["query_cache_invalidations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# robustness PR: corrupt-cursor recovery + fold-in circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCursorCorruptionRecovery:
+    """Satellite: a truncated/corrupt cursor JSON must fall back to a
+    watermark re-attach (reset) instead of crashing the speed layer,
+    and count the recovery."""
+
+    APP = 7
+
+    def _recovered_counter(self):
+        from predictionio_tpu.obs import metrics as obs_metrics
+
+        return obs_metrics.counter(
+            "pio_tailer_cursor_recovered",
+            "Tailer restarts that discarded a corrupt cursor file",
+        )
+
+    def _tailer_with_cursor(self, tmp_path):
+        events = _jsonl_events(tmp_path)
+        cursor = tmp_path / "cursor.json"
+        t = EventTailer(events, self.APP, cursor_path=cursor)
+        events.insert(_rate("u1", "i1", 4), self.APP)
+        assert len(t.poll()) == 1  # persists a real cursor
+        return events, cursor
+
+    @pytest.mark.parametrize(
+        "corruption",
+        [
+            "torn-json",
+            "not-a-dict",
+            "watermark-wrong-type",
+            "files-missing-fields",
+            "seen-not-a-list",
+        ],
+    )
+    def test_corrupt_cursor_falls_back_to_reattach(
+        self, tmp_path, corruption
+    ):
+        events, cursor = self._tailer_with_cursor(tmp_path)
+        good = json.loads(cursor.read_text())
+        if corruption == "torn-json":
+            cursor.write_text(cursor.read_text()[: len(cursor.read_text()) // 2])
+        elif corruption == "not-a-dict":
+            cursor.write_text("[1, 2, 3]")
+        elif corruption == "watermark-wrong-type":
+            good["watermark"] = ["not", "a", "number"]
+            cursor.write_text(json.dumps(good))
+        elif corruption == "files-missing-fields":
+            good["files"] = {p: {"offset": 0} for p in good.get("files", {})}
+            cursor.write_text(json.dumps(good))
+        elif corruption == "seen-not-a-list":
+            good["seen"] = 42
+            cursor.write_text(json.dumps(good))
+        before = self._recovered_counter().value()
+        # events already in the log predate the re-attach watermark
+        events.insert(_rate("u2", "i2", 3), self.APP)
+        t2 = EventTailer(events, self.APP, cursor_path=cursor)
+        if corruption != "seen-not-a-list":
+            # set(42) raises; set of a list is fine — either way no crash
+            assert self._recovered_counter().value() >= before
+        assert t2.poll() == []  # re-attached at the end, not at zero
+        events.insert(_rate("u3", "i3", 5), self.APP)
+        got = t2.poll()
+        assert [e.entity_id for e in got] == ["u3"]
+        # the recovered tailer persists a fresh, valid cursor
+        assert json.loads(cursor.read_text())["version"] == 1
+
+    def test_structurally_corrupt_cursor_counts_recovery(self, tmp_path):
+        events, cursor = self._tailer_with_cursor(tmp_path)
+        good = json.loads(cursor.read_text())
+        good["files"] = {p: {"offset": 0} for p in good.get("files", {})}
+        cursor.write_text(json.dumps(good))
+        before = self._recovered_counter().value()
+        EventTailer(events, self.APP, cursor_path=cursor)
+        assert self._recovered_counter().value() == before + 1
+
+
+class TestFoldInCircuitBreaker:
+    """Tentpole: repeated fold-in failures trip the breaker; the engine
+    keeps serving the last good epoch-fenced model; the breaker
+    half-opens after backoff and closes on a successful fold."""
+
+    def _speed_layer(self, deployed, tmp_path, clock):
+        from predictionio_tpu.common.breaker import CircuitBreaker
+
+        breaker = CircuitBreaker(
+            "foldin", failure_threshold=3, base_backoff_s=2.0,
+            max_backoff_s=60.0, jitter=0.0, clock=clock,
+        )
+        return SpeedLayer(
+            deployed["server"],
+            cursor_path=tmp_path / "cursor.json",
+            breaker=breaker,
+        )
+
+    def test_breaker_trips_half_opens_and_recovers(self, deployed, tmp_path):
+        from predictionio_tpu import faults
+
+        clock = {"t": 1000.0}
+        sl = self._speed_layer(deployed, tmp_path, lambda: clock["t"])
+        app_id = deployed["app_id"]
+        events = deployed["storage"].get_events()
+        _, models_before, _ = deployed["server"].model_snapshot()
+
+        with faults.injected("foldin.fold:always"):
+            for i in range(3):
+                events.insert(_rate("u1", f"i{i % 3}", 5), app_id)
+                assert sl.step() == "fold_failed"
+            assert sl.breaker.state == "open"
+            # while open: no poll, no fold, model untouched
+            events.insert(_rate("u1", "i1", 5), app_id)
+            assert sl.step() == "breaker_open"
+        _, models_now, _ = deployed["server"].model_snapshot()
+        # last good model still served (same objects, no patch applied)
+        assert all(a is b for a, b in zip(models_now, models_before))
+
+        snap = sl.gauges()["breaker"]
+        assert snap["state"] == "open" and snap["trips_total"] == 1
+        assert snap["failures_total"] == 3 and snap["retry_in_s"] > 0
+
+        # backoff elapses -> half-open trial -> successful fold closes it
+        clock["t"] += 2.5
+        assert sl.step() == "patched"
+        assert sl.breaker.state == "closed"
+        _, models_after, _ = deployed["server"].model_snapshot()
+        assert any(a is not b for a, b in zip(models_after, models_before))
+
+    def test_open_breaker_does_not_consume_events(self, deployed, tmp_path):
+        """The poll is gated on allow(): events arriving while the
+        breaker is open must survive to be folded after recovery (a
+        poll would persist the cursor and silently drop them)."""
+        from predictionio_tpu import faults
+
+        clock = {"t": 0.0}
+        sl = self._speed_layer(deployed, tmp_path, lambda: clock["t"])
+        app_id = deployed["app_id"]
+        events = deployed["storage"].get_events()
+        with faults.injected("foldin.fold:always"):
+            for i in range(3):
+                events.insert(_rate("u2", f"i{i % 3}", 4), app_id)
+                assert sl.step() == "fold_failed"
+            events.insert(_rate("u3", "i1", 5), app_id)  # lands while open
+            assert sl.step() == "breaker_open"
+        clock["t"] += 2.5
+        before = sl.events_folded
+        assert sl.step() == "patched"  # the held-back event folds now
+        assert sl.events_folded == before + 1
+
+    def test_breaker_state_rides_stats_json(self, deployed, tmp_path):
+        clock = {"t": 0.0}
+        self._speed_layer(deployed, tmp_path, lambda: clock["t"])
+        status, body = http("GET", deployed["base"] + "/stats.json")
+        assert status == 200
+        assert body["realtime"]["breaker"]["state"] == "closed"
+        assert body["realtime"]["breaker"]["trips_total"] == 0

@@ -1466,3 +1466,175 @@ class TestHTTPFastPathPieces:
             assert reader.read(3) == b""
         finally:
             a.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation under failure (robustness PR): 503 + Retry-After
+# during model swaps and deadline overruns, micro-batcher fallback
+# ---------------------------------------------------------------------------
+
+
+def http_full(method, url, body=None, headers=None):
+    """Like http() but also returns response headers (Retry-After)."""
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}"), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            parsed = json.loads(payload or b"{}")
+        except json.JSONDecodeError:
+            parsed = {"raw": payload.decode()}
+        return e.code, parsed, dict(e.headers)
+
+
+class TestGracefulDegradation:
+    def test_reload_in_flight_keeps_serving_old_model(
+        self, deployed_engine
+    ):
+        """The satellite regression: hold a /reload open and prove the
+        OLD model keeps answering 200 for the whole swap window —
+        prepare_deploy runs off the server lock and the swap itself is
+        atomic, so a reload never degrades availability. (Deploy warmup
+        is the path that fences with 503 + Retry-After; see
+        test_warmup_blocks_queries_while_running.)"""
+        server = deployed_engine["server"]
+        base = deployed_engine["base"]
+        entered = threading.Event()
+        release = threading.Event()
+        orig_load = server._load
+
+        def slow_load(instance):
+            entered.set()
+            assert release.wait(10)
+            return orig_load(instance)
+
+        server._load = slow_load
+        try:
+            t = threading.Thread(
+                target=http,
+                args=("POST", base + "/reload?accessKey=secret"),
+            )
+            t.start()
+            assert entered.wait(10)
+            status, body, _ = http_full(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200 and body["itemScores"]
+        finally:
+            release.set()
+            server._load = orig_load
+        t.join(timeout=30)
+        status, body, _ = http_full(
+            "POST", base + "/queries.json", {"user": "u1", "num": 3}
+        )
+        assert status == 200 and body["itemScores"]
+
+    def test_query_deadline_times_out_to_503(self, deployed_engine):
+        from predictionio_tpu import faults
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        server = EngineServer(
+            deployed_engine["engine"],
+            deployed_engine["server"].instance,
+            storage=deployed_engine["storage"],
+            host="127.0.0.1", port=0, query_deadline_ms=150.0,
+        )
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            # fast query under the deadline serves normally
+            status, body, _ = http_full(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200
+            with faults.injected("serve.query:sleep=600"):
+                status, body, headers = http_full(
+                    "POST", base + "/queries.json", {"user": "u1", "num": 3}
+                )
+            assert status == 503
+            assert headers.get("Retry-After") == "1"
+            assert "deadline" in json.dumps(body)
+            # deadline overruns must not poison later queries
+            status, body, _ = http_full(
+                "POST", base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200 and body["itemScores"]
+        finally:
+            server.stop()
+
+    def test_batcher_failure_falls_back_to_unbatched(self, deployed_engine):
+        from predictionio_tpu.obs import metrics as obs_metrics
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        server = EngineServer(
+            deployed_engine["engine"],
+            deployed_engine["server"].instance,
+            storage=deployed_engine["storage"],
+            host="127.0.0.1", port=0, batch_window_ms=25.0,
+            dispatch_cost_s=10.0,  # pin engaged mode
+        )
+        port = server.start()
+        fallback_counter = obs_metrics.counter(
+            "pio_batcher_fallback_total",
+            "Queries served unbatched after a micro-batcher failure",
+        )
+        before = fallback_counter.value()
+        try:
+
+            def broken_submit(body):
+                raise RuntimeError("batch worker failed")
+
+            server.batcher.submit = broken_submit
+            status, body, _ = http_full(
+                "POST",
+                f"http://127.0.0.1:{port}/queries.json",
+                {"user": "u1", "num": 3},
+            )
+            assert status == 200 and body["itemScores"]
+            assert fallback_counter.value() == before + 1
+        finally:
+            server.stop()
+
+    def test_batcher_query_errors_still_propagate(self, deployed_engine):
+        """Only infrastructure failures fall back; a bad query through
+        the batcher stays a 400, not a silent unbatched retry."""
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        server = EngineServer(
+            deployed_engine["engine"],
+            deployed_engine["server"].instance,
+            storage=deployed_engine["storage"],
+            host="127.0.0.1", port=0, batch_window_ms=25.0,
+            dispatch_cost_s=10.0,
+        )
+        port = server.start()
+        try:
+            status, _, _ = http_full(
+                "POST", f"http://127.0.0.1:{port}/queries.json", [1, 2]
+            )
+            assert status == 400
+        finally:
+            server.stop()
+
+    def test_warmup_blocks_queries_while_running(self, deployed_engine):
+        server = deployed_engine["server"]
+        base = deployed_engine["base"]
+        server._swapping.set()  # what warm_up() holds while compiling
+        try:
+            status, _, headers = http_full(
+                "POST", base + "/queries.json", {"user": "u1"}
+            )
+            assert status == 503 and headers.get("Retry-After") == "1"
+        finally:
+            server._swapping.clear()
+        status, _, _ = http_full(
+            "POST", base + "/queries.json", {"user": "u1"}
+        )
+        assert status == 200
