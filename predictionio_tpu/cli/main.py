@@ -121,6 +121,78 @@ def cmd_status(args) -> int:
         return 1
     print(json.dumps(info, indent=2))
     print("(sanity check) All storage repositories verified.")
+    line = _training_line()
+    if line:
+        print(line)
+    return 0
+
+
+def _training_progress() -> dict | None:
+    """The live-training progress doc (obs/progress.py), or None when
+    no checkpointed ``pio train`` is currently publishing."""
+    from predictionio_tpu.obs import progress as obs_progress
+
+    doc = obs_progress.read_progress()
+    return doc if obs_progress.is_live(doc) else None
+
+
+def _training_line() -> str | None:
+    """Human one-liner for ``pio status``: "training: iter 7/20, ETA 41s"."""
+    doc = _training_progress()
+    if doc is None:
+        return None
+    parts = [f"iter {doc.get('iteration')}/{doc.get('total_iterations')}"]
+    if doc.get("eta_s") is not None:
+        parts.append(f"ETA {round(doc['eta_s'])}s")
+    rmse = doc.get("rmse")
+    if rmse:
+        parts.append(f"RMSE {rmse[-1]:.4f}")
+    if doc.get("events_per_s"):
+        parts.append(f"{doc['events_per_s']:,.0f} events/s")
+    return "training: " + ", ".join(parts)
+
+
+def cmd_profile(args) -> int:
+    """``pio profile --seconds N [--url]``: on-demand jax.profiler trace
+    capture — in-process (with a small jit workload so the trace is
+    never empty), or via ``POST /profile`` on a running daemon so the
+    capture sees that server's real traffic. Prints one compact JSON
+    summary line either way (trace dir, window, file count/bytes)."""
+    if args.url:
+        import urllib.parse
+        import urllib.request
+
+        query = {"seconds": str(args.seconds)}
+        if args.out:
+            query["out"] = args.out
+        url = (
+            args.url.rstrip("/")
+            + "/profile?"
+            + urllib.parse.urlencode(query)
+        )
+        req = urllib.request.Request(url, method="POST")
+        try:
+            # the server captures synchronously: allow the window + slack
+            with urllib.request.urlopen(
+                req, timeout=args.seconds + 30.0
+            ) as r:
+                body = r.read()
+        except Exception as e:
+            print(f"profile request failed: {e}", file=sys.stderr)
+            return 1
+        print(body.decode().strip())
+        return 0
+
+    from predictionio_tpu.obs import device as obs_device
+
+    try:
+        result = obs_device.profile_capture(
+            args.seconds, out_dir=args.out, burn=True
+        )
+    except RuntimeError as e:
+        print(f"profile failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(result, separators=(",", ":")))
     return 0
 
 
@@ -159,7 +231,13 @@ def _status_json() -> int:
             except ValueError:
                 pass
         services[name] = entry
-    print(json.dumps({"services": services}, separators=(",", ":")))
+    summary: dict = {"services": services}
+    # live checkpointed training on this host, if any (the per-service
+    # device blocks already ride in services.*.stats.device)
+    progress = _training_progress()
+    if progress is not None:
+        summary["training"] = progress
+    print(json.dumps(summary, separators=(",", ":")))
     return 0
 
 
@@ -818,6 +896,22 @@ def build_parser() -> argparse.ArgumentParser:
         "from running daemons",
     )
     st.set_defaults(fn=cmd_status)
+
+    pr = sub.add_parser("profile")
+    pr.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="capture window (clamped to 120s)",
+    )
+    pr.add_argument(
+        "--url",
+        help="POST /profile on a running daemon (e.g. "
+        "http://127.0.0.1:8000) instead of capturing in-process",
+    )
+    pr.add_argument(
+        "--out", help="trace output directory (default: a timestamped "
+        "dir under $PIO_RUN_DIR/profiles)",
+    )
+    pr.set_defaults(fn=cmd_profile)
 
     b = sub.add_parser("build")
     b.add_argument("--engine-factory")

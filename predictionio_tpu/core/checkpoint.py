@@ -38,6 +38,7 @@ from pathlib import Path
 import numpy as np
 
 from predictionio_tpu import faults
+from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import metrics as obs_metrics
 
 logger = logging.getLogger(__name__)
@@ -141,6 +142,10 @@ def save_checkpoint(
         arrays: dict = {}
         _pack_table("U", U, arrays)
         _pack_table("V", V, arrays)
+        # _pack_table's np.asarray pulled the carry off the device
+        obs_device.count_transfer(
+            "d2h", "checkpoint", sum(a.nbytes for a in arrays.values())
+        )
         with open(tmp, "wb") as f:
             np.savez(
                 f,

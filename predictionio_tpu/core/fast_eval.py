@@ -21,6 +21,8 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from predictionio_tpu.obs import device as obs_device
+
 from predictionio_tpu.core.base import Algorithm, FirstServing
 from predictionio_tpu.core.context import WorkflowContext
 from predictionio_tpu.core.engine import Engine, WorkflowParams
@@ -56,6 +58,7 @@ class FastEvalEngineWorkflow:
         self.hits = {"datasource": 0, "preparator": 0, "algorithms": 0, "topk": 0}
         self.misses = {"datasource": 0, "preparator": 0, "algorithms": 0, "topk": 0}
         self.swept_candidates = 0  # candidates trained via vmapped sweeps
+        self.jit_compiles = 0  # XLA compiles this sweep (set by batch_eval)
         self.fast_path_candidates = 0  # candidates scored via eval_device
         self.phase_seconds = {"train": 0.0, "predict": 0.0, "metric": 0.0}
         self._active_phases: set[str] = set()
@@ -320,12 +323,21 @@ class FastEvalEngine(Engine):
         workflow_params: WorkflowParams | None = None,
     ):
         workflow = FastEvalEngineWorkflow(self, ctx)
+        jit_before = obs_device.compile_snapshot()
         workflow.prewarm_sweeps(engine_params_list)
         out = [(ep, workflow.eval(ep)) for ep in engine_params_list]
+        # the sweep's device work routes through tracked jit entry points
+        # (ranking_metrics_batch, the trainers); a per-sweep compile delta
+        # says whether candidate shapes reused programs or churned XLA
+        jit_after = obs_device.compile_snapshot()
+        workflow.jit_compiles = sum(
+            s["compiles"] for s in jit_after.values()
+        ) - sum(s["compiles"] for s in jit_before.values())
         logger.info(
-            "FastEvalEngine cache hits=%s misses=%s swept=%d",
+            "FastEvalEngine cache hits=%s misses=%s swept=%d jit_compiles=%d",
             workflow.hits,
             workflow.misses,
             workflow.swept_candidates,
+            workflow.jit_compiles,
         )
         return out

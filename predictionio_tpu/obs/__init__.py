@@ -15,12 +15,24 @@ tracing-timeline argument of the TensorFlow system paper 1605.08695):
   request gets a trace id (honoring ``X-PIO-Trace``), stage boundaries
   record spans, and a fixed-size ring retains the N slowest recent
   traces (``GET /traces.json``; waterfall table on the dashboard).
+- :mod:`predictionio_tpu.obs.device` — the device side of the story:
+  XLA compile tracking per jitted entry point, per-device memory
+  gauges, host<->device transfer byte accounting, and on-demand
+  ``jax.profiler`` capture (``pio profile`` / ``POST /profile``).
+- :mod:`predictionio_tpu.obs.progress` — live training progress via an
+  atomic file written at checkpoint segment boundaries, read by
+  ``pio status`` and the dashboard while a run is underway.
 
 Instrumentation is ALWAYS-ON and cheap (<2% serving qps, gated by the
 bench ``obs`` section); ``PIO_OBS=0`` turns every instrument into a
 no-op for A/B measurement.
+
+``device`` and ``progress`` are intentionally NOT imported here:
+``obs.device`` must stay importable-but-inert on jax-free processes,
+and eagerly importing it from every ``obs`` user would register its
+instruments even where they can never fire. Import them explicitly.
 """
 
 from predictionio_tpu.obs import metrics, trace  # noqa: F401
 
-__all__ = ["metrics", "trace"]
+__all__ = ["metrics", "trace", "device", "progress"]

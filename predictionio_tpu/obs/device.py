@@ -1,0 +1,417 @@
+"""Device-level observability: XLA compile tracking, device memory and
+transfer telemetry, and on-demand profiler capture.
+
+PR 7's obs layer measures host wall clock; this module opens the device
+black box — the telemetry ALX (arxiv 2112.02194) uses to attribute TPU
+time between gather, solve, and collectives, and that arxiv 2501.10546
+treats as first-class production signals:
+
+- **Compile tracking** — :func:`track_jit` wraps a jitted entry point
+  and detects recompiles by the executable-cache-size delta across each
+  call (``fn._cache_size()``), exporting ``pio_jit_compiles_total{fn}``
+  / ``pio_jit_cache_hits_total{fn}`` and a per-function hit-ratio
+  gauge. A process-global ``jax.monitoring`` listener feeds backend
+  compile durations into ``pio_jit_compile_seconds``. Shape-churn
+  recompiles (the micro-batcher's known failure mode) become a counter
+  on ``/metrics`` instead of mystery latency.
+- **Memory & transfer telemetry** — per-device gauges evaluated at
+  scrape time from ``device.memory_stats()`` (None-tolerant: CPU
+  backends report no stats and export zeros with a ``supported`` gauge
+  saying so), plus byte-accounting counters
+  (``pio_device_transfer_bytes_total{direction,op}``) fed by the
+  explicit host<->device copy sites: training bucket upload, sharded
+  pack upload, checkpoint snapshot gather, deploy/patch model put.
+- **On-demand profiling** — :func:`profile_capture` runs a bounded
+  ``jax.profiler`` trace capture behind a process lock (one capture at
+  a time), backing ``pio profile`` and the ``POST /profile`` endpoint.
+
+Everything is lazy about jax: importing this module never imports jax,
+and scrape-time paths only look at devices when ``jax`` is already in
+``sys.modules`` — ``/metrics`` on a jax-free server stays jax-free.
+All instruments honor the global ``PIO_OBS=0`` kill switch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+
+from predictionio_tpu.obs import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "track_jit",
+    "count_transfer",
+    "transfer_totals",
+    "compile_snapshot",
+    "ensure_device_gauges",
+    "device_block",
+    "profile_capture",
+    "profile_active",
+]
+
+
+# -- compile tracking ---------------------------------------------------------
+
+_lock = threading.Lock()
+_listener_installed = False
+
+_m_compile_seconds = _metrics.histogram(
+    "pio_jit_compile_seconds",
+    "XLA backend compile time per compiled program",
+)
+
+
+class _JitStats:
+    """Per-tracked-function call/compile/hit counters (host-side; the
+    source of truth for the compile counters and /stats.json block)."""
+
+    __slots__ = ("calls", "compiles", "cache_hits")
+
+    def __init__(self) -> None:
+        self.calls = 0
+        self.compiles = 0
+        self.cache_hits = 0
+
+
+_jit_stats: dict[str, _JitStats] = {}
+
+
+def _install_compile_listener() -> None:
+    """Register the global jax.monitoring duration listener once per
+    process. Called from the first tracked call (jax is importable by
+    then — the wrapped function IS a jit). Failures are swallowed: the
+    cache-size tracker still counts compiles without durations."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    with _lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
+        try:
+            import jax
+
+            def _on_duration(event: str, duration: float, **_kw) -> None:
+                if event == "/jax/core/compile/backend_compile_duration":
+                    _m_compile_seconds.observe(duration)
+
+            jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:  # pragma: no cover - telemetry must never break jit
+            logger.debug("jax.monitoring listener unavailable", exc_info=True)
+
+
+def track_jit(name: str):
+    """Wrap a jitted callable so every call updates the compile tracker.
+
+    The compile test is the executable-cache-size delta across the call
+    (``fn._cache_size()``): a new (shape, static-args) specialization
+    grew the cache -> one compile; an unchanged cache -> a hit. This is
+    exact per USER-LEVEL program — the monitoring listener sees several
+    backend_compile events per jit (sub-compiles), so durations come
+    from the listener while counts come from here.
+
+    Apply ABOVE the ``jax.jit`` decoration (outermost). Overhead when
+    enabled is two getattr+int reads and two counter incs per call;
+    disabled cost is one flag check (bench obs/device gates it <1%).
+    """
+    stats = _jit_stats.setdefault(name, _JitStats())
+    m_compiles = _metrics.counter(
+        "pio_jit_compiles_total",
+        "XLA compiles triggered by tracked jit entry points",
+        fn=name,
+    )
+    m_hits = _metrics.counter(
+        "pio_jit_cache_hits_total",
+        "Tracked jit calls served from the executable cache",
+        fn=name,
+    )
+    _metrics.gauge(
+        "pio_jit_cache_hit_ratio",
+        "Fraction of tracked jit calls served without a compile",
+        fn=name,
+    ).set_function(
+        lambda s=stats: (s.cache_hits / s.calls) if s.calls else 0.0
+    )
+
+    def deco(fn):
+        cache_size = getattr(fn, "_cache_size", None)
+
+        def wrapper(*args, **kwargs):
+            if not _metrics.enabled() or cache_size is None:
+                return fn(*args, **kwargs)
+            _install_compile_listener()
+            try:
+                before = cache_size()
+            except Exception:
+                before = -1
+            out = fn(*args, **kwargs)
+            stats.calls += 1
+            try:
+                after = cache_size()
+            except Exception:
+                after = before
+            if before >= 0 and after > before:
+                stats.compiles += after - before
+                m_compiles.inc(after - before)
+            else:
+                stats.cache_hits += 1
+                m_hits.inc()
+            return out
+
+        wrapper.__name__ = getattr(fn, "__name__", name)
+        wrapper.__doc__ = getattr(fn, "__doc__", None)
+        wrapper.__wrapped__ = fn
+        # keep the jit surface callers rely on (tests/tooling introspect
+        # the executable cache and AOT-compile through the wrapper)
+        for attr in ("_cache_size", "lower", "trace", "clear_cache"):
+            val = getattr(fn, attr, None)
+            if val is not None:
+                setattr(wrapper, attr, val)
+        return wrapper
+
+    return deco
+
+
+def compile_snapshot() -> dict[str, dict[str, int]]:
+    """Per-tracked-function {calls, compiles, cache_hits} — the delta
+    source for per-sweep compile accounting (core/fast_eval.py) and the
+    /stats.json device block."""
+    return {
+        name: {
+            "calls": s.calls,
+            "compiles": s.compiles,
+            "cache_hits": s.cache_hits,
+        }
+        for name, s in sorted(_jit_stats.items())
+    }
+
+
+# -- transfer byte accounting -------------------------------------------------
+
+_transfer_lock = threading.Lock()
+_transfer_totals: dict[tuple[str, str], int] = {}
+
+
+def count_transfer(direction: str, op: str, nbytes: int) -> None:
+    """Account one host<->device copy: ``direction`` is ``h2d``/``d2h``,
+    ``op`` names the site (train.buckets, checkpoint, serve.model_put,
+    ...). Feeds ``pio_device_transfer_bytes_total`` and the stats
+    block's transfer table."""
+    if not _metrics.enabled() or nbytes <= 0:
+        return
+    _metrics.counter(
+        "pio_device_transfer_bytes_total",
+        "Bytes moved between host and device, by site",
+        direction=direction, op=op,
+    ).inc(int(nbytes))
+    _metrics.counter(
+        "pio_device_transfers_total",
+        "Host<->device copies, by site",
+        direction=direction, op=op,
+    ).inc()
+    with _transfer_lock:
+        key = (direction, op)
+        _transfer_totals[key] = _transfer_totals.get(key, 0) + int(nbytes)
+
+
+def transfer_totals() -> dict[str, int]:
+    with _transfer_lock:
+        return {
+            f"{d}.{op}": n for (d, op), n in sorted(_transfer_totals.items())
+        }
+
+
+# -- device memory gauges -----------------------------------------------------
+
+_gauges_registered = False
+# memory_stats() keys worth exporting, normalized to a short gauge kind
+_MEM_KINDS = (
+    ("bytes_in_use", "in_use"),
+    ("bytes_limit", "limit"),
+    ("peak_bytes_in_use", "peak"),
+)
+
+
+def _mem_stat(device, key: str) -> float:
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return 0.0
+    return float(stats.get(key, 0))
+
+
+def ensure_device_gauges() -> bool:
+    """Register per-device memory gauges (scrape-time callbacks), once.
+
+    Deliberately a no-op until ``jax`` is already imported — the
+    /metrics route calls this on every scrape, and a jax-free server
+    (dashboard, event server before any training) must never pay a jax
+    import for a scrape. Returns True when gauges are live."""
+    global _gauges_registered
+    if _gauges_registered:
+        return True
+    if "jax" not in sys.modules:
+        return False
+    with _lock:
+        if _gauges_registered:
+            return True
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:  # pragma: no cover - broken backend
+            logger.debug("jax.local_devices unavailable", exc_info=True)
+            return False
+        platforms: dict[str, int] = {}
+        for d in devices:
+            label = f"{d.platform}:{d.id}"
+            platforms[d.platform] = platforms.get(d.platform, 0) + 1
+            supported = False
+            try:
+                supported = bool(d.memory_stats())
+            except Exception:
+                supported = False
+            _metrics.gauge(
+                "pio_device_memory_stats_supported",
+                "1 when the backend reports allocator memory stats "
+                "(CPU backends report none and export zeros)",
+                device=label,
+            ).set_function(lambda s=supported: 1.0 if s else 0.0)
+            for key, kind in _MEM_KINDS:
+                _metrics.gauge(
+                    "pio_device_memory_bytes",
+                    "Device allocator memory, read at scrape time "
+                    "(0 when the backend reports no stats)",
+                    device=label, kind=kind,
+                ).set_function(lambda d=d, k=key: _mem_stat(d, k))
+        for platform, n in platforms.items():
+            _metrics.gauge(
+                "pio_device_count", "Local devices visible to this process",
+                platform=platform,
+            ).set(float(n))
+        _gauges_registered = True
+        return True
+
+
+def device_block() -> dict:
+    """The additive ``device`` block for ``/stats.json``: per-device
+    memory (None-tolerant), transfer byte totals, and the compile
+    tracker summary. Safe on a jax-free process (empty device list)."""
+    devices = []
+    if "jax" in sys.modules:
+        ensure_device_gauges()
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                try:
+                    stats = d.memory_stats()
+                except Exception:
+                    stats = None
+                devices.append(
+                    {
+                        "device": f"{d.platform}:{d.id}",
+                        "kind": getattr(d, "device_kind", ""),
+                        "memory": (
+                            {
+                                kind: int(stats.get(key, 0))
+                                for key, kind in _MEM_KINDS
+                            }
+                            if stats
+                            else None
+                        ),
+                    }
+                )
+        except Exception:  # pragma: no cover - stats must never 500
+            logger.debug("device stats read failed", exc_info=True)
+    return {
+        "devices": devices,
+        "transfer_bytes": transfer_totals(),
+        "jit": compile_snapshot(),
+    }
+
+
+# -- on-demand profiling ------------------------------------------------------
+
+_profile_lock = threading.Lock()
+_profile_running = False
+
+MAX_PROFILE_SECONDS = 120.0
+
+
+def profile_active() -> bool:
+    return _profile_running
+
+
+def _default_profile_dir() -> str:
+    base = os.path.join(
+        os.path.expanduser(os.environ.get("PIO_RUN_DIR", "~/.pio_tpu/run")),
+        "profiles",
+    )
+    return os.path.join(base, time.strftime("%Y%m%d-%H%M%S"))
+
+
+def profile_capture(
+    seconds: float, out_dir: str | None = None, burn: bool = False
+) -> dict:
+    """Capture a ``jax.profiler`` trace for ``seconds`` and return
+    {trace_dir, seconds, files, bytes}.
+
+    One capture at a time (RuntimeError when one is already running —
+    the /profile route maps it to 409); seconds is clamped to
+    ``MAX_PROFILE_SECONDS`` so a fat-fingered request can't profile a
+    production server for an hour. ``burn`` keeps a tiny jitted op
+    looping during the window so an otherwise-idle process still
+    produces a non-empty trace (the in-process ``pio profile`` path);
+    servers capture whatever traffic is actually running."""
+    global _profile_running
+    seconds = min(max(float(seconds), 0.05), MAX_PROFILE_SECONDS)
+    trace_dir = out_dir or _default_profile_dir()
+    if not _profile_lock.acquire(blocking=False):
+        raise RuntimeError("a profile capture is already running")
+    try:
+        _profile_running = True
+        import jax
+        import jax.profiler
+
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            deadline = time.perf_counter() + seconds
+            if burn:
+                import jax.numpy as jnp
+
+                f = jax.jit(lambda x: (x @ x.T).sum())
+                x = jnp.ones((256, 256), jnp.float32)
+                while time.perf_counter() < deadline:
+                    f(x).block_until_ready()
+            else:
+                while time.perf_counter() < deadline:
+                    time.sleep(min(0.05, max(deadline - time.perf_counter(), 0)))
+        finally:
+            jax.profiler.stop_trace()
+    finally:
+        _profile_running = False
+        _profile_lock.release()
+    n_files = 0
+    n_bytes = 0
+    for root, _dirs, files in os.walk(trace_dir):
+        for f in files:
+            n_files += 1
+            try:
+                n_bytes += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return {
+        "trace_dir": trace_dir,
+        "seconds": round(seconds, 3),
+        "files": n_files,
+        "bytes": n_bytes,
+    }

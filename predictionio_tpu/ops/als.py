@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.obs import device as obs_device
+
 logger = logging.getLogger(__name__)
 
 DEFAULT_BUCKETS = (8, 32, 128, 512, 2048)
@@ -290,6 +292,7 @@ def pack_entries(keys: np.ndarray, width: int):
 # ---------------------------------------------------------------------------
 
 
+@obs_device.track_jit("als.solve_bucket_explicit")
 @functools.partial(
     jax.jit, static_argnames=("weighted_reg", "compute_dtype")
 )
@@ -322,6 +325,7 @@ def solve_bucket_explicit(
     return _psd_solve(A, b)
 
 
+@obs_device.track_jit("als.solve_bucket_implicit")
 @functools.partial(
     jax.jit, static_argnames=("weighted_reg", "compute_dtype")
 )
@@ -608,6 +612,7 @@ def init_factors(num: int, rank: int, key, scale: float | None = None):
     return scale * jax.random.normal(key, (num, rank), dtype="float32")
 
 
+@obs_device.track_jit("als.solve_bucket_step")
 @functools.partial(jax.jit, static_argnames=("params", "num_solved_rows"))
 def _solve_bucket_step(
     factors_other, gram, col_ids, ratings, mask, seg_row, params, num_solved_rows
@@ -713,6 +718,7 @@ def _finish_bucket_solve(
     return _psd_solve(A, b)
 
 
+@obs_device.track_jit("als.train_fused")
 @functools.partial(jax.jit, static_argnames=("params",), donate_argnums=(0, 1))
 def _train_fused(U, V, row_arrays, col_arrays, params: ALSParams, iterations):
     """The whole training run as ONE device program: lax.fori_loop over
@@ -755,6 +761,16 @@ def _train_fused(U, V, row_arrays, col_arrays, params: ALSParams, iterations):
 
 def _device_bucket_arrays(buckets: Sequence[PaddedBucket]):
     """Upload bucket arrays once; returned as a tuple usable as a jit arg."""
+    obs_device.count_transfer(
+        "h2d",
+        "train.buckets",
+        sum(
+            b.row_ids.nbytes + b.col_ids.nbytes + b.ratings.nbytes
+            + b.mask.nbytes
+            + (b.seg_row.nbytes if b.seg_row is not None else 0)
+            for b in buckets
+        ),
+    )
     return tuple(
         (
             jnp.asarray(b.row_ids),
@@ -809,19 +825,29 @@ def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
                 start_iter = snap.iteration
     import time as _time
 
+    from predictionio_tpu.obs import progress as obs_progress
+
+    nnz = len(data.vals)
+    prog = obs_progress.ProgressPublisher(
+        params.iterations, mesh="single", trainer="single"
+    )
     t0 = _time.perf_counter()
     if cfg is None or cfg.every <= 0:
+        prog.publish(start_iter)
         faults.fault_point("device.dispatch")
         out = _train_fused(
             U, V, row_arrays, col_arrays, static_params,
             params.iterations - start_iter,
         )
     else:
+        prog.publish(start_iter)
         out = (U, V)
         it = start_iter
+        epochs = 0
         while it < params.iterations:
             seg = min(cfg.every, params.iterations - it)
             faults.fault_point("device.dispatch")
+            t_seg = _time.perf_counter()
             out = _train_fused(
                 out[0], out[1], row_arrays, col_arrays, static_params, seg
             )
@@ -832,7 +858,21 @@ def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
                     cfg, fingerprint, out[0], out[1], it, params.seed,
                     mesh="single",
                 )
+                epochs += 1
+            seg_wall = _time.perf_counter() - t_seg
+            prog.publish(
+                it,
+                rmse=(
+                    rmse(out[0], out[1], data.rows, data.cols, data.vals)
+                    if prog.enabled
+                    else None
+                ),
+                events_per_s=nnz * seg / seg_wall if seg_wall > 0 else None,
+                segment_wall_s=seg_wall,
+                checkpoint_epoch=epochs,
+            )
     jax.block_until_ready(out)
+    prog.done(params.iterations)
     total = _time.perf_counter() - t0
     from predictionio_tpu.obs import metrics as obs_metrics
 
@@ -851,6 +891,7 @@ def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
     return out
 
 
+@obs_device.track_jit("als.train_fused_sweep")
 @functools.partial(jax.jit, static_argnames=("params",), donate_argnums=(0, 1))
 def _train_fused_sweep(
     U0, V0, regs, alphas, row_arrays, col_arrays, params: ALSParams, iterations

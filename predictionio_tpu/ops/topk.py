@@ -16,6 +16,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from predictionio_tpu.obs import device as obs_device
+
 NEG_INF = -1e30
 
 
@@ -27,6 +29,7 @@ def catalog_rows(item_factors) -> int:
     return table.shape[0]
 
 
+@obs_device.track_jit("topk.top_k_items")
 @functools.partial(jax.jit, static_argnames=("k",))
 def top_k_items(user_vector, item_factors, k: int, exclude_mask=None):
     """Scores one user vector against all items; returns (scores, ids).
@@ -61,6 +64,7 @@ def top_k_items(user_vector, item_factors, k: int, exclude_mask=None):
     return jax.lax.top_k(scores, k)
 
 
+@obs_device.track_jit("topk.top_k_items_batch")
 @functools.partial(jax.jit, static_argnames=("k",))
 def top_k_items_batch(user_vectors, item_factors, k: int, exclude_mask=None):
     """Batched variant: [B, D] user vectors -> ([B, k] scores, [B, k] ids)."""
@@ -83,6 +87,7 @@ def top_k_items_batch(user_vectors, item_factors, k: int, exclude_mask=None):
     return jax.lax.top_k(scores, k)
 
 
+@obs_device.track_jit("topk.ranking_metrics_batch")
 @functools.partial(jax.jit, static_argnames=("k",))
 def ranking_metrics_batch(pred_ids, actual_sorted, actual_counts, k: int):
     """Vectorized P@K / AP@K / NDCG@K over a padded top-k id matrix.
@@ -136,6 +141,7 @@ def ranking_metrics_batch(pred_ids, actual_sorted, actual_counts, k: int):
     return precision, ap, ndcg, counts > 0
 
 
+@obs_device.track_jit("topk.top_k_similar")
 @functools.partial(jax.jit, static_argnames=("k",))
 def top_k_similar(item_vector, item_factors, k: int, exclude_mask=None):
     """Cosine item-item similarity top-k (similarproduct template's scoring,

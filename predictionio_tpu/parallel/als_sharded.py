@@ -80,7 +80,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import progress as obs_progress
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.parallel.compat import shard_map
 from predictionio_tpu.parallel.mesh import factor_sharding, replicated_sharding
@@ -340,6 +342,12 @@ def pack_sharded_side(
 def upload_packed_side(ps: PackedSide, mesh: Mesh, axis: str) -> tuple:
     """Place one packed side on the mesh: tables sharded ``P(axis)`` on
     the shard-major dim, scatter row-ids replicated."""
+    obs_device.count_transfer(
+        "h2d",
+        "train.packed_side",
+        ps.row_ids.nbytes + ps.col_ids.nbytes + ps.ratings.nbytes
+        + ps.mask.nbytes + ps.seg.nbytes,
+    )
     table = factor_sharding(mesh, axis)
     repl = replicated_sharding(mesh)
     return (
@@ -630,11 +638,13 @@ def _fused_trainer(mesh: Mesh, axis: str, mode: str, params: als_ops.ALSParams):
         return jax.lax.fori_loop(0, iterations, step, (U, V))
 
     pack_s = (repl, factor, factor, factor, factor)
-    return jax.jit(
-        train,
-        donate_argnums=(0, 1),
-        in_shardings=(factor, factor, pack_s, pack_s, repl),
-        out_shardings=(factor, factor),
+    return obs_device.track_jit(f"sharded.train.{mode}")(
+        jax.jit(
+            train,
+            donate_argnums=(0, 1),
+            in_shardings=(factor, factor, pack_s, pack_s, repl),
+            out_shardings=(factor, factor),
+        )
     )
 
 
@@ -807,19 +817,32 @@ def sharded_als_train(
                 state.V = jax.device_put(snap.V, factor)
                 start_iter = snap.iteration
 
+    # per-segment RMSE is skipped here on purpose: mid-run tables are in
+    # SideLayout (degree-balanced) order, so scoring them against the
+    # original-order (rows, cols) pairs would be wrong
+    prog = obs_progress.ProgressPublisher(
+        params.iterations, mesh=mesh_desc, trainer="sharded"
+    )
+    # multi-host: every host runs this loop; one writer is enough
+    prog.enabled = prog.enabled and jax.process_index() == 0
+    nnz = len(data.vals)
     t0 = _time.perf_counter()
     if cfg is None or cfg.every <= 0:
+        prog.publish(start_iter)
         faults.fault_point("device.dispatch")
         U, V = trainer(
             state.U, state.V, row_pack, col_pack,
             params.iterations - start_iter,
         )
     else:
+        prog.publish(start_iter)
         U, V = state.U, state.V
         it = start_iter
+        epochs = 0
         while it < params.iterations:
             seg = min(cfg.every, params.iterations - it)
             faults.fault_point("device.dispatch")
+            t_seg = _time.perf_counter()
             U, V = trainer(U, V, row_pack, col_pack, seg)
             it += seg
             if it < params.iterations:
@@ -829,7 +852,16 @@ def sharded_als_train(
                 ckpt.save_checkpoint(
                     cfg, fingerprint, U, V, it, params.seed, mesh=mesh_desc
                 )
+                epochs += 1
+            seg_wall = _time.perf_counter() - t_seg
+            prog.publish(
+                it,
+                events_per_s=nnz * seg / seg_wall if seg_wall > 0 else None,
+                segment_wall_s=seg_wall,
+                checkpoint_epoch=epochs,
+            )
     jax.block_until_ready((U, V))
+    prog.done(params.iterations)
     total = _time.perf_counter() - t0
     # the whole loop is ONE scan-fused jit program, so per-half-step
     # timing is derived: total / (2 * iterations). First-call totals

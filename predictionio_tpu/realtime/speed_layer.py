@@ -29,6 +29,7 @@ from predictionio_tpu import faults
 from predictionio_tpu.common.breaker import CircuitBreaker
 from predictionio_tpu.data import store
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.realtime.foldin import ALSFoldIn, FoldInConfig
 from predictionio_tpu.realtime.tailer import EventTailer
 
@@ -113,6 +114,7 @@ class SpeedLayer:
         # watch this against cache_hit_rate: a fold interval shorter
         # than the traffic's repeat window makes the cache useless
         self.cache_invalidations = 0
+        self._last_fold_trace: str | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         server.speed_layer = self
@@ -122,7 +124,32 @@ class SpeedLayer:
     def step(self) -> str:
         """One poll+fold+patch cycle; returns what happened (for tests
         and logs): "superseded" | "idle" | "patched" | "fenced" |
-        "skipped" | "breaker_open" | "fold_failed"."""
+        "skipped" | "breaker_open" | "fold_failed".
+
+        Each cycle that reaches the fold carries a ``speedlayer.fold``
+        trace (spans: tail.poll, foldin.fold, server.patch) offered to
+        the slow-trace ring, and the trace id is exported in
+        :meth:`gauges` — fold latency visible in /traces.json is
+        attributable to the exact cycle /stats.json reported (PR 7 left
+        the fold path traceless)."""
+        tr = (
+            obs_trace.Trace("speedlayer.fold")
+            if obs_metrics.enabled()
+            else None
+        )
+        prev = obs_trace.current_trace()
+        obs_trace.set_current_trace(tr)
+        try:
+            outcome = self._step(tr)
+        finally:
+            obs_trace.set_current_trace(prev)
+        if tr is not None and outcome in ("patched", "fold_failed", "fenced"):
+            tr.finish(200 if outcome == "patched" else 500)
+            obs_trace.TRACES.offer(tr)
+            self._last_fold_trace = tr.trace_id
+        return outcome
+
+    def _step(self, tr) -> str:
         inst_id, models, epoch = self.server.model_snapshot()
         if inst_id != self._instance_id:
             # retrain won: the new instance's training read covered the
@@ -146,7 +173,10 @@ class SpeedLayer:
 
         t_p0 = time.perf_counter()
         events = self.tailer.poll()
-        _m_poll.observe(time.perf_counter() - t_p0)
+        t_p1 = time.perf_counter()
+        _m_poll.observe(t_p1 - t_p0)
+        if tr is not None:
+            tr.add_span("tail.poll", t_p0, t_p1)
         if not events:
             if (self.tailer.events_behind() or 0) == 0:
                 self._caught_up_at = time.time()
@@ -162,7 +192,12 @@ class SpeedLayer:
                 if _is_als_model(m):
                     try:
                         faults.fault_point("foldin.fold")
+                        t_f0 = time.perf_counter()
                         patched, stats = self.foldin.fold(m, events)
+                        if tr is not None:
+                            tr.add_span(
+                                "foldin.fold", t_f0, time.perf_counter()
+                            )
                     except Exception:
                         # the poll already persisted the cursor, so this
                         # batch is lost to fold-in (at-most-once; the
@@ -185,7 +220,11 @@ class SpeedLayer:
             if not patched_any:
                 self._last_fold_s = time.perf_counter() - t0
                 return "skipped"  # no foldable events for any model
-            if self.server.apply_patch(new_models, epoch):
+            t_a0 = time.perf_counter()
+            applied = self.server.apply_patch(new_models, epoch)
+            if tr is not None:
+                tr.add_span("server.patch", t_a0, time.perf_counter())
+            if applied:
                 # the epoch bump just swept the query cache (the
                 # fold-in hook mirrors /reload exactly)
                 if self.server.query_cache is not None:
@@ -236,6 +275,7 @@ class SpeedLayer:
             "users_added": self.users_added,
             "cold_start_items": len(self.foldin.cold_items),
             "last_fold_s": round(self._last_fold_s, 6),
+            "last_fold_trace": self._last_fold_trace,
             "query_cache_invalidations": self.cache_invalidations,
             "breaker": self.breaker.snapshot(),
         }

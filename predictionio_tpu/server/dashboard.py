@@ -13,6 +13,8 @@ import json
 import logging
 
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import device as obs_device
+from predictionio_tpu.obs import progress as obs_progress
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server.http import (
     HTTPApp,
@@ -96,6 +98,89 @@ def render_waterfall(traces: list[dict], source: str) -> str:
         "retains outliers, not a uniform sample. Fetch another server "
         "with <code>?src=http://host:port</code>.</p>"
         f"{body}</body></html>"
+    )
+
+
+def _kv_table(rows: list[tuple[str, str]]) -> str:
+    return (
+        "<table border='1' style='border-collapse:collapse'>"
+        + "".join(
+            f"<tr><th style='text-align:left;padding:2px 8px'>"
+            f"{html.escape(k)}</th>"
+            f"<td style='font-family:monospace;padding:2px 8px'>"
+            f"{html.escape(v)}</td></tr>"
+            for k, v in rows
+        )
+        + "</table>"
+    )
+
+
+def render_device_panel(block: dict, progress: dict | None, source: str) -> str:
+    """Device telemetry panel: per-device memory, transfer byte totals,
+    the compile tracker table, and — while a checkpointed ``pio train``
+    is live on this host — its progress."""
+    sections = []
+    devices = block.get("devices") or []
+    if devices:
+        rows = []
+        for d in devices:
+            mem = d.get("memory")
+            mem_str = (
+                f"in_use {mem['in_use']:,} / limit {mem['limit']:,} "
+                f"(peak {mem['peak']:,})"
+                if mem
+                else "no allocator stats (CPU backend)"
+            )
+            rows.append((f"{d.get('device')} {d.get('kind', '')}".strip(), mem_str))
+        sections.append("<h2>Devices</h2>" + _kv_table(rows))
+    else:
+        sections.append(
+            "<h2>Devices</h2><p>jax not initialized in this process.</p>"
+        )
+    transfers = block.get("transfer_bytes") or {}
+    if transfers:
+        sections.append(
+            "<h2>Host&harr;device transfers</h2>"
+            + _kv_table([(k, f"{v:,} bytes") for k, v in transfers.items()])
+        )
+    jit = block.get("jit") or {}
+    if jit:
+        sections.append(
+            "<h2>Compile tracker</h2>"
+            + _kv_table(
+                [
+                    (
+                        name,
+                        f"calls {s['calls']:,}, compiles {s['compiles']:,}, "
+                        f"cache hits {s['cache_hits']:,}",
+                    )
+                    for name, s in jit.items()
+                ]
+            )
+        )
+    if progress is not None:
+        rows = [
+            (
+                "iteration",
+                f"{progress.get('iteration')}/{progress.get('total_iterations')}",
+            )
+        ]
+        if progress.get("eta_s") is not None:
+            rows.append(("ETA", f"{progress['eta_s']}s"))
+        if progress.get("rmse"):
+            rows.append(("RMSE", str(progress["rmse"][-1])))
+        if progress.get("events_per_s"):
+            rows.append(("events/s", f"{progress['events_per_s']:,.0f}"))
+        if progress.get("mesh"):
+            rows.append(("mesh", str(progress["mesh"])))
+        sections.append("<h2>Training in progress</h2>" + _kv_table(rows))
+    return (
+        "<html><head><title>Device telemetry</title></head><body>"
+        "<h1>Device telemetry</h1>"
+        f"<p>source: {html.escape(source)}. Fetch a serving process "
+        "with <code>?src=http://host:port</code> (reads its "
+        "/stats.json device block).</p>"
+        f"{''.join(sections)}</body></html>"
     )
 
 
@@ -227,6 +312,37 @@ class Dashboard:
                 traces = obs_trace.TRACES.snapshot()
                 source = "this dashboard process"
             return Response.html(render_waterfall(traces, source))
+
+        @router.route("GET", "/device")
+        def device_page(request: Request) -> Response:
+            """Device telemetry panel: this process's device block (the
+            dashboard is usually jax-free, so mostly useful with
+            ``?src=`` pointing at an engine server), plus any live
+            training progress on this host."""
+            if not server._authorized(request):
+                return Response.error("Not authenticated", 401)
+            src = request.query.get("src")
+            if src:
+                if not src.startswith(("http://", "https://")):
+                    return Response.error("src must be an http(s) URL", 400)
+                import urllib.request
+
+                try:
+                    with urllib.request.urlopen(
+                        f"{src.rstrip('/')}/stats.json", timeout=2
+                    ) as resp:
+                        block = json.loads(resp.read()).get("device", {})
+                except Exception as e:
+                    return Response.error(f"fetch from {src} failed: {e}", 502)
+                source = src
+            else:
+                block = obs_device.device_block()
+                source = "this dashboard process"
+            doc = obs_progress.read_progress()
+            progress = doc if obs_progress.is_live(doc) else None
+            return Response.html(
+                render_device_panel(block, progress, source)
+            )
 
         add_obs_routes(router)
         return router

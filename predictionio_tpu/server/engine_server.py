@@ -42,6 +42,7 @@ from predictionio_tpu import faults
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.workflow import prepare_deploy
 from predictionio_tpu.data.storage import EngineInstance, Storage, get_storage
+from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import jsonx
@@ -71,6 +72,26 @@ def _to_jsonable(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return dataclasses.asdict(obj)
     return obj
+
+
+def _model_bytes(obj: Any, _depth: int = 0) -> int:
+    """Total ``nbytes`` reachable from a deployed model list — the byte
+    size of a model put/patch for the device transfer accounting. Walks
+    containers and object attributes a few levels deep (an ALS model is
+    an object holding factor arrays or int8 (values, scales) pairs);
+    anything unrecognized counts as 0 rather than guessing."""
+    if _depth > 3:
+        return 0
+    nbytes = getattr(obj, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    if isinstance(obj, (list, tuple)):
+        return sum(_model_bytes(o, _depth + 1) for o in obj)
+    if isinstance(obj, dict):
+        return sum(_model_bytes(o, _depth + 1) for o in obj.values())
+    if hasattr(obj, "__dict__"):
+        return sum(_model_bytes(v, _depth + 1) for v in vars(obj).values())
+    return 0
 
 
 def _query_from_json(query_class: type | None, data: dict[str, Any]) -> Any:
@@ -426,6 +447,9 @@ class EngineServer:
     def _load(self, instance: EngineInstance) -> None:
         engine_params, algorithms, models, serving = prepare_deploy(
             self.engine, instance, storage=self.storage
+        )
+        obs_device.count_transfer(
+            "h2d", "serve.model_put", _model_bytes(models)
         )
         with self._lock:
             self.instance = instance
@@ -793,6 +817,9 @@ class EngineServer:
             self._epoch += 1
             self._foldin_epoch += 1
             epoch = self._epoch
+        obs_device.count_transfer(
+            "h2d", "serve.model_patch", _model_bytes(models)
+        )
         # fold-in patches sweep cached results exactly like /reload:
         # the bumped epoch already makes old entries unreachable, the
         # sweep reclaims their bytes (off the server lock)
@@ -881,6 +908,7 @@ class EngineServer:
             )
             # additive: existing consumers keep their fields untouched
             body["obs"] = obs_metrics.stats_block()
+            body["device"] = obs_device.device_block()
             return Response.json(body)
 
         @router.route("POST", "/queries.json")
@@ -891,6 +919,12 @@ class EngineServer:
                     "Queries 503'd while unavailable",
                     reason="swap",
                 ).inc()
+                # a 503 burst must be visible in /traces.json, not just
+                # as a counter — mark the request's trace
+                tr = obs_trace.current_trace()
+                if tr is not None:
+                    now = time.perf_counter()
+                    tr.add_span("serve.unavailable", now, now)
                 return Response(
                     status=503,
                     body={"message": "model swap in progress; retry shortly"},

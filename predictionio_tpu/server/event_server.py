@@ -39,6 +39,7 @@ from predictionio_tpu.data.event import (
     validate,
 )
 from predictionio_tpu.data.storage import AccessKey, Storage, get_storage
+from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import plugins as plugin_mod
@@ -330,6 +331,7 @@ class EventServer:
             payload = server.stats.get(auth.app_id)
             # additive: existing consumers keep their fields untouched
             payload["obs"] = obs_metrics.stats_block()
+            payload["device"] = obs_device.device_block()
             return Response.json(payload)
 
         @router.route("GET", "/plugins.json")

@@ -19,6 +19,7 @@ from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu import faults
+from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import jsonx
@@ -207,19 +208,58 @@ _PROM_CT = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def add_obs_routes(router: Router) -> None:
-    """Mount ``GET /metrics`` (Prometheus text format) and
-    ``GET /traces.json`` (slowest recent traces). Unauthenticated on
-    every server — standard scraper behavior; neither endpoint exposes
-    event data."""
+    """Mount ``GET /metrics`` (Prometheus text format),
+    ``GET /traces.json`` (slowest recent traces; ``?limit=N`` caps the
+    list, ``?since_ms=`` drops traces that started before the given
+    epoch-milliseconds), and ``POST /profile`` (bounded on-demand
+    ``jax.profiler`` capture, ``?seconds=``/``?out=``). ``/metrics``
+    and ``/traces.json`` are unauthenticated on every server — standard
+    scraper behavior; neither exposes event data."""
 
     def _metrics_route(_req: Request) -> Response:
+        # Registers the per-device memory gauges on first scrape after
+        # jax came up; a no-op (and jax-import-free) before that.
+        obs_device.ensure_device_gauges()
         return Response(200, body=(_PROM_CT, obs_metrics.render_prometheus()))
 
-    def _traces_route(_req: Request) -> Response:
-        return Response.json({"traces": obs_trace.TRACES.snapshot()})
+    def _traces_route(req: Request) -> Response:
+        traces = obs_trace.TRACES.snapshot()
+        since_ms = req.query.get("since_ms")
+        if since_ms is not None:
+            try:
+                cutoff = float(since_ms)
+            except ValueError:
+                return Response.error("since_ms must be a number", 400)
+            traces = [t for t in traces if t["start"] * 1000.0 >= cutoff]
+        limit = req.query.get("limit")
+        if limit is not None:
+            try:
+                n = int(limit)
+            except ValueError:
+                return Response.error("limit must be an integer", 400)
+            if n < 0:
+                return Response.error("limit must be >= 0", 400)
+            traces = traces[:n]
+        return Response.json({"traces": traces})
+
+    def _profile_route(req: Request) -> Response:
+        try:
+            seconds = float(req.query.get("seconds", "2"))
+        except ValueError:
+            return Response.error("seconds must be a number", 400)
+        try:
+            result = obs_device.profile_capture(
+                seconds, out_dir=req.query.get("out") or None
+            )
+        except RuntimeError as exc:
+            return Response.error(str(exc), 409)
+        except Exception as exc:  # jax missing / profiler failure
+            return Response.error(f"profile capture failed: {exc}", 500)
+        return Response.json(result)
 
     router.add("GET", "/metrics", _metrics_route)
     router.add("GET", "/traces.json", _traces_route)
+    router.add("POST", "/profile", _profile_route)
 
 
 class _ConnReader:
