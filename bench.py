@@ -484,6 +484,88 @@ def _concurrent_qps(host: str, port: int, path: str, queries: list[dict],
     }
 
 
+# closed-loop load client: each process owns `conns` keep-alive
+# connections, one thread per connection, one outstanding request per
+# connection (closed loop). Per-request latencies stream back as a JSON
+# list after the 'R' ready byte. Bodies rotate per request so mixed
+# query shapes hit the server within one run.
+_LOAD_CLIENT = (
+    "import sys,json,time,threading,http.client\n"
+    "host,port,path,per_conn,conns=(sys.argv[1],int(sys.argv[2]),"
+    "sys.argv[3],int(sys.argv[4]),int(sys.argv[5]))\n"
+    "bodies=json.loads(sys.argv[6])\n"
+    "hdrs={'Content-Type':'application/json'}\n"
+    "cs=[]\n"
+    "for _ in range(conns):\n"
+    "    c=http.client.HTTPConnection(host,port,timeout=120)\n"
+    "    c.connect(); cs.append(c)\n"
+    "lats=[[] for _ in range(conns)]\n"
+    "def run(i):\n"
+    "    c=cs[i]\n"
+    "    for j in range(per_conn):\n"
+    "        b=bodies[(i*per_conn+j)%len(bodies)]\n"
+    "        t0=time.perf_counter()\n"
+    "        c.request('POST',path,body=b,headers=hdrs)\n"
+    "        r=c.getresponse(); r.read()\n"
+    "        assert r.status==200, r.status\n"
+    "        lats[i].append((time.perf_counter()-t0)*1e3)\n"
+    "ts=[threading.Thread(target=run,args=(i,)) for i in range(conns)]\n"
+    "sys.stdout.write('R'); sys.stdout.flush()\n"
+    "sys.stdin.readline()\n"
+    "for t in ts: t.start()\n"
+    "for t in ts: t.join()\n"
+    "sys.stdout.write(json.dumps([x for l in lats for x in l]))\n"
+)
+
+
+def _load_gen(host: str, port: int, path: str, bodies: list[str],
+              conns: int, per_conn: int, n_procs: int = 8) -> dict:
+    """Closed-loop load at ``conns`` keep-alive connections spread over
+    ``n_procs`` gated client processes: p50/p99 per-request latency plus
+    qps over the gate-to-last-exit wall."""
+    import subprocess
+    import sys as _sys
+
+    n_procs = min(n_procs, conns)
+    alloc = [
+        conns // n_procs + (1 if i < conns % n_procs else 0)
+        for i in range(n_procs)
+    ]
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, "-S", "-c", _LOAD_CLIENT,
+             host, str(port), path, str(per_conn), str(alloc[i]),
+             json.dumps(bodies)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+        )
+        for i in range(n_procs)
+    ]
+    for p in procs:
+        if p.stdout.read(1) != b"R":
+            raise RuntimeError("load client failed before ready")
+    t0 = time.perf_counter()
+    for p in procs:
+        p.stdin.write(b"\n")
+        p.stdin.flush()
+    lat: list[float] = []
+    for p in procs:
+        out = p.stdout.read()  # EOF == process done
+        if p.wait() != 0:
+            raise RuntimeError("load client failed")
+        lat.extend(json.loads(out))
+    dt = time.perf_counter() - t0
+    lat.sort()
+    total = conns * per_conn
+    return {
+        "conns": conns,
+        "total_queries": total,
+        "qps": round(total / dt, 1),
+        "p50_ms": round(lat[len(lat) // 2], 3),
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+    }
+
+
 def _http_floor_us(recv_buffer: bool, n: int = 2000) -> float:
     """Per-request microseconds of the HTTP layer ALONE: keep-alive GETs
     against a route that returns pre-encoded bytes (zero handler work),
@@ -617,6 +699,53 @@ def bench_serving(extras: dict) -> None:
         }
     finally:
         server.stop()
+
+    # -- closed-loop connection ladder: batched vs unbatched at
+    # 8/64/512 keep-alive connections. The event-loop front end holds
+    # the idle 512 as selector entries; the micro-batcher coalesces
+    # whatever naturally queues at each concurrency. Equal total
+    # requests per rung so qps numbers compare across rungs.
+    bodies = [json.dumps(q) for q in queries]
+    ladder: dict = {}
+    from predictionio_tpu.obs import metrics as obs_metrics
+
+    for mode, kwargs in (
+        ("unbatched", {}),
+        ("batched", {"batch_window_ms": window_ms}),
+    ):
+        server = EngineServer(
+            recommendation.engine(), inst, storage=storage,
+            host="127.0.0.1", port=0, **kwargs,
+        )
+        port = server.start(background=True)
+        try:
+            # warm every pow2 batch-shape bucket before timing
+            _load_gen("127.0.0.1", port, "/queries.json", bodies, 64, 2)
+            ladder[mode] = {
+                f"c{c}": _load_gen(
+                    "127.0.0.1", port, "/queries.json", bodies, c,
+                    max(4, 2048 // c),
+                )
+                for c in (8, 64, 512)
+            }
+            if mode == "batched":
+                # shape-bucket discipline: ~10k more requests must not
+                # grow the compile count (pow2 batch sizes x pow2 k)
+                comp = obs_metrics.counter(
+                    "pio_jit_compiles_total", fn="topk.gather_top_k_batch"
+                )
+                before = comp.value()
+                ten_k = _load_gen(
+                    "127.0.0.1", port, "/queries.json", bodies, 64, 160
+                )
+                ladder["jit_compiles_during_10k"] = comp.value() - before
+                ladder["c64_10k_qps"] = ten_k["qps"]
+        finally:
+            server.stop()
+    ladder["batched_over_unbatched_c64"] = round(
+        ladder["batched"]["c64"]["qps"] / ladder["unbatched"]["c64"]["qps"], 2
+    )
+    extras["serving"]["closed_loop"] = ladder
 
     # -- query-result cache: the epoch-fenced serving fast path --------
     # miss qps: cache disabled, every request runs gather->score->top-k->
@@ -2394,6 +2523,29 @@ def _compact_summary(result: dict) -> dict:
         hf = sv.get("http_floor_us")
         if isinstance(hf, dict):
             sc_out["http_floor_us"] = hf
+        cl = sv.get("closed_loop")
+        if isinstance(cl, dict):
+            cl_out = {
+                mode: {
+                    rung: cl[mode][rung]["qps"]
+                    for rung in ("c8", "c64", "c512")
+                    if rung in cl.get(mode, {})
+                }
+                for mode in ("unbatched", "batched")
+                if isinstance(cl.get(mode), dict)
+            }
+            for k in ("batched_over_unbatched_c64",
+                      "jit_compiles_during_10k", "c64_10k_qps"):
+                if cl.get(k) is not None:
+                    cl_out[k] = cl[k]
+            sc_out["closed_loop"] = cl_out
+        cls = sv.get("closed_loop_smoke")
+        if isinstance(cls, dict):
+            sc_out["closed_loop"] = {
+                "unbatched_qps_c64": cls["unbatched"]["qps"],
+                "batched_qps_c64": cls["batched"]["qps"],
+                "batched_over_unbatched": cls.get("batched_over_unbatched"),
+            }
         if sc_out:
             s["serving"] = sc_out
     rt = result.get("realtime")
@@ -2465,6 +2617,120 @@ def _compact_summary(result: dict) -> dict:
     return s
 
 
+def bench_serving_smoke(result: dict) -> None:
+    """--smoke serving gate: closed-loop load at 64 keep-alive
+    connections through a real EngineServer, batched vs unbatched on
+    the same trained instance. The batched fast path must not lose —
+    one retry absorbs scheduler noise, then the comparison is a hard
+    assert (a regression fails the smoke contract)."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import set_storage, test_storage
+    from predictionio_tpu.models import recommendation
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    storage = test_storage()
+    set_storage(storage)
+    try:
+        apps = storage.get_metadata_apps()
+        events = storage.get_events()
+        from predictionio_tpu.data.storage import App
+
+        app_id = apps.insert(App(0, "SmokeServe"))
+        events.init(app_id)
+        rng = np.random.default_rng(SEED)
+        batch = [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties={"rating": float(r)},
+            )
+            for u, i, r in zip(
+                rng.integers(0, 200, 2000), rng.integers(0, 60, 2000),
+                rng.integers(1, 6, 2000),
+            )
+        ]
+        events.batch_insert(batch, app_id)
+        engine = recommendation.engine()
+        variant = {
+            "id": "smoke-serve",
+            "engineFactory": "predictionio_tpu.models.recommendation.engine",
+            "datasource": {"params": {"app_name": "SmokeServe"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 8, "num_iterations": 3}}],
+        }
+        run_train(
+            engine, engine.params_from_variant(variant),
+            engine_id="smoke-serve",
+            engine_factory="predictionio_tpu.models.recommendation.engine",
+            workflow_params=WorkflowParams(batch="bench"), storage=storage,
+        )
+        inst = storage.get_metadata_engine_instances().get_latest_completed(
+            "smoke-serve", "0", "default"
+        )
+        bodies = [
+            json.dumps({"user": f"u{u}", "num": int(n)})
+            for u, n in zip(rng.integers(0, 200, 32),
+                            rng.choice([3, 4], 32))
+        ]
+
+        # both servers stay up for the whole comparison; measurements
+        # alternate so machine-load drift hits both modes equally, and
+        # the per-mode capacity estimate is the MEDIAN of the rounds
+        # (clients share the CPU with the server on this box, so any
+        # single window carries scheduler noise either way)
+        servers = {
+            "unbatched": EngineServer(
+                engine, inst, storage=storage, host="127.0.0.1", port=0,
+            ),
+            "batched": EngineServer(
+                engine, inst, storage=storage, host="127.0.0.1", port=0,
+                batch_window_ms=5.0,
+            ),
+        }
+        ports = {m: s.start(background=True) for m, s in servers.items()}
+        samples: dict = {"unbatched": [], "batched": []}
+        try:
+            for port in ports.values():  # warm jit shape buckets
+                _load_gen("127.0.0.1", port, "/queries.json", bodies, 64, 2)
+
+            def round_trip():
+                for mode, port in ports.items():
+                    samples[mode].append(_load_gen(
+                        "127.0.0.1", port, "/queries.json", bodies, 64, 24
+                    ))
+
+            def median(mode):
+                runs = sorted(samples[mode], key=lambda r: r["qps"])
+                return runs[len(runs) // 2]
+
+            for _ in range(3):
+                round_trip()
+            if median("batched")["qps"] < median("unbatched")["qps"]:
+                round_trip()  # two extra rounds: median-of-5
+                round_trip()
+            unbatched, batched = median("unbatched"), median("batched")
+        finally:
+            for s in servers.values():
+                s.stop()
+        result["serving"] = {
+            "closed_loop_smoke": {
+                "unbatched": unbatched,
+                "batched": batched,
+                "batched_over_unbatched": round(
+                    batched["qps"] / unbatched["qps"], 2
+                ),
+            }
+        }
+        assert batched["qps"] >= unbatched["qps"], (
+            f"batched serving lost at 64 conns: "
+            f"{batched['qps']} < {unbatched['qps']} qps"
+        )
+    finally:
+        set_storage(None)
+
+
 def smoke_main() -> None:
     """--smoke: a seconds-scale CI probe. Forces CPU (no accelerator
     probe), runs the storage section at a tiny event count plus a tiny
@@ -2512,6 +2778,10 @@ def smoke_main() -> None:
         bench_obs(result, trials=3, per_trial=250)
     except Exception as e:
         result["obs"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        bench_serving_smoke(result)
+    except Exception as e:
+        result["serving"] = {"error": f"{type(e).__name__}: {e}"}
     # ISSUE 6 acceptance gates (fused-variant parity at atol 1e-6,
     # ring_vs_gather <= 1.5) + the reduced sharded_scaling shape, in a
     # child process that owns the virtual 8-device mesh; an assert

@@ -26,6 +26,7 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass, field
+from typing import Sequence
 
 import numpy as np
 
@@ -505,40 +506,93 @@ class ECommAlgorithm(Algorithm):
         return False
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        # batch of one through the batched scorer: byte-identical to the
+        # same query arriving inside a coalesced micro-batch
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(
+        self, model: ECommModel, queries: Sequence[tuple[int, Query]]
+    ) -> list[tuple[int, PredictedResult]]:
+        """Batched scoring with the live business rules intact: the
+        exclusion masks (seen/unavailable/black-list) are built host-side
+        per query BEFORE dispatch, then every category/whiteList-free
+        query in the micro-batch shares one ``top_k_items_batch`` call
+        with headroom k = pow2(num + |excluded|) and drops its exclusions
+        host-side. Category/whiteList queries can exclude most of the
+        catalog (headroom would balloon to the catalog size), so they
+        keep per-query masked calls through the same batched op."""
         import jax.numpy as jnp
 
-        from predictionio_tpu.ops.topk import top_k_items
+        from predictionio_tpu.ops.topk import top_k_items_batch
 
-        known = query.user in model.user_index
-        if known:
-            user_vec = jnp.asarray(
-                model.user_rows(model.user_index[query.user])
-            )
-        else:
-            recent = self._recent_item_vector(model, query.user)
-            if recent is None:
-                logger.info(
-                    "user %s has no factors and no recent views; empty result",
-                    query.user,
-                )
-                return PredictedResult(itemScores=[])
-            user_vec = jnp.asarray(recent)
-
-        mask = self._exclusions(model, query)
-        scores, ids = top_k_items(
-            user_vec,
-            self._weighted_item_factors(model),
-            k=int(query.num),
-            exclude_mask=jnp.asarray(mask),
-        )
         inv = model.item_index.inverse
-        return PredictedResult(
-            itemScores=[
-                ItemScore(item=inv[int(i)], score=float(s))
-                for s, i in zip(np.asarray(scores), np.asarray(ids))
-                if s > -1e29
-            ]
-        )
+        results: list[PredictedResult | None] = [None] * len(queries)
+        vecs: list[np.ndarray | None] = [None] * len(queries)
+        masks: list[np.ndarray | None] = [None] * len(queries)
+        simple: list[int] = []
+        complex_: list[int] = []
+        for qi, (_, q) in enumerate(queries):
+            if q.user in model.user_index:
+                vec = np.asarray(model.user_rows(model.user_index[q.user]))
+            else:
+                recent = self._recent_item_vector(model, q.user)
+                if recent is None:
+                    logger.info(
+                        "user %s has no factors and no recent views;"
+                        " empty result",
+                        q.user,
+                    )
+                    results[qi] = PredictedResult(itemScores=[])
+                    continue
+                vec = np.asarray(recent)
+            vecs[qi] = vec.astype(np.float32)
+            masks[qi] = self._exclusions(model, q)
+            if q.categories is None and q.whiteList is None:
+                simple.append(qi)
+            else:
+                complex_.append(qi)
+        V = self._weighted_item_factors(model)
+        if simple:
+            batch = np.stack([vecs[qi] for qi in simple])
+            k = _pow2(
+                max(
+                    int(queries[qi][1].num) + int(masks[qi].sum())
+                    for qi in simple
+                )
+            )
+            scores, ids = top_k_items_batch(batch, V, k=k)
+            scores, ids = np.asarray(scores), np.asarray(ids)
+            for row, qi in enumerate(simple):
+                mask, num = masks[qi], int(queries[qi][1].num)
+                item_scores: list[ItemScore] = []
+                for s, i in zip(scores[row], ids[row]):
+                    ii = int(i)
+                    if mask[ii]:
+                        continue
+                    item_scores.append(ItemScore(item=inv[ii], score=float(s)))
+                    if len(item_scores) == num:
+                        break
+                results[qi] = PredictedResult(itemScores=item_scores)
+        for qi in complex_:
+            num = int(queries[qi][1].num)
+            scores, ids = top_k_items_batch(
+                vecs[qi][None, :], V, k=_pow2(num),
+                exclude_mask=jnp.asarray(masks[qi]),
+            )
+            row_s = np.asarray(scores)[0][:num]
+            row_i = np.asarray(ids)[0][:num]
+            results[qi] = PredictedResult(
+                itemScores=[
+                    ItemScore(item=inv[int(i)], score=float(s))
+                    for s, i in zip(row_s, row_i)
+                    if s > -1e29
+                ]
+            )
+        return [(ix, r) for (ix, _), r in zip(queries, results)]
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
 
 
 def engine() -> Engine:

@@ -429,38 +429,23 @@ class ALSAlgorithm(Algorithm):
         return Query(user=model.user_index.inverse[0], num=4)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
-        from predictionio_tpu.ops.topk import top_k_items
-
-        if query.user not in model.user_index:
-            # unseen user: no personalization signal (reference behavior:
-            # empty result rather than failure)
-            return PredictedResult(itemScores=[])
-        uix = model.user_index[query.user]
-        if self.params.sharded_serving:
-            scores, ids = model.ring_catalog().top_k(
-                model.user_rows([uix]), int(query.num)
-            )
-            scores, ids = scores[0], ids[0]
-        else:
-            _, V = model.device_factors()
-            scores, ids = top_k_items(
-                model.user_rows(uix), V, k=int(query.num)
-            )
-        inv = model.item_index.inverse
-        return PredictedResult(
-            itemScores=[
-                ItemScore(item=inv[int(i)], score=float(s))
-                for s, i in zip(np.asarray(scores), np.asarray(ids))
-                if int(i) >= 0
-            ]
-        )
+        # delegate to the batch path with a batch of one: the batched
+        # matmul's rows are invariant to the batch size, so a query gets
+        # byte-identical scores whether it arrives alone or coalesced —
+        # the parity the micro-batcher's correctness rests on (a matvec
+        # here would differ from the batched matmat in the low bits)
+        return self.batch_predict(model, [(0, query)])[0][1]
 
     def batch_predict(
         self, model: ALSModel, queries: Sequence[tuple[int, Query]]
     ) -> list[tuple[int, PredictedResult]]:
-        """Vectorized eval-time scoring: one device call for all known
-        users (the P2L batchPredict analog, done as a single MXU matmul)."""
-        from predictionio_tpu.ops.topk import top_k_items_batch
+        """THE scoring path (serving single, serving micro-batched, and
+        eval): ONE fused gather+score+top-k device call for all known
+        users. The user table is device-resident (``device_factors``),
+        so a serving dispatch ships B int32 row indices up, not B
+        dequantized f32 vectors — `gather_top_k_batch` dequantizes
+        f32/bf16/int8 storage on device."""
+        from predictionio_tpu.ops.topk import gather_top_k_batch
 
         known = [(ix, q) for ix, q in queries if q.user in model.user_index]
         out: list[tuple[int, PredictedResult]] = [
@@ -474,7 +459,9 @@ class ALSAlgorithm(Algorithm):
             )
             # power-of-two k: the jitted batch top-k specializes on k,
             # and micro-batched serving would otherwise recompile per
-            # distinct max(num) in a batch (results slice to q.num)
+            # distinct max(num) in a batch (results slice to q.num;
+            # lax.top_k's prefix is k-invariant, so the slice equals
+            # the smaller-k result exactly)
             k = max(int(q.num) for _, q in known)
             k = 1 << max(0, k - 1).bit_length()
             if self.params.sharded_serving:
@@ -482,8 +469,8 @@ class ALSAlgorithm(Algorithm):
                     model.user_rows(uixs), k
                 )
             else:
-                _, V = model.device_factors()
-                scores, ids = top_k_items_batch(model.user_rows(uixs), V, k=k)
+                U, V = model.device_factors()
+                scores, ids = gather_top_k_batch(uixs, U, V, k=k)
             scores, ids = np.asarray(scores), np.asarray(ids)
             inv = model.item_index.inverse
             for row, (ix, q) in enumerate(known):

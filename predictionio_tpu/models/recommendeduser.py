@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
+from typing import Sequence
 
 import numpy as np
 
@@ -172,34 +173,99 @@ class ALSAlgorithm(Algorithm):
         )
 
     def predict(self, model: RecommendedUserModel, query: Query) -> PredictedResult:
-        import jax.numpy as jnp
+        # batch of one through the batched scorer: byte-identical to the
+        # same query arriving inside a coalesced micro-batch
+        return _score_users_batch(model, [query])[0]
 
-        from predictionio_tpu.ops.topk import top_k_items
+    def batch_predict(
+        self, model: RecommendedUserModel,
+        queries: Sequence[tuple[int, Query]],
+    ) -> list[tuple[int, PredictedResult]]:
+        results = _score_users_batch(model, [q for _, q in queries])
+        return [(ix, r) for (ix, _), r in zip(queries, results)]
 
-        index = model.followed_index
-        known = [index[u] for u in query.users if u in index]
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+def _score_users_batch(
+    model: RecommendedUserModel, queries: Sequence[Query]
+) -> list[PredictedResult]:
+    """Batched user-user scoring: one fused gather-sum + top-k device
+    call covers every no-whiteList query in the micro-batch (the
+    excluded set — the query's own users plus ``blackList`` hits — is
+    small, so the batch requests top-(num + |excluded|) unmasked and
+    drops exclusions host-side; a whiteList can exclude most of the
+    catalog, so those queries keep per-query masked scoring through the
+    same op). Single-query ``predict`` delegates here with a batch of
+    one — see models/similarproduct.py for the parity argument."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models.filters import entity_exclusion_mask
+    from predictionio_tpu.ops.topk import sum_rows_top_k_batch
+
+    index = model.followed_index
+    inv = index.inverse
+    results: list[PredictedResult | None] = [None] * len(queries)
+    simple: list[tuple[int, list[int], set[int], int]] = []
+    complex_: list[tuple[int, list[int], np.ndarray, int]] = []
+    for qi, q in enumerate(queries):
+        known = [index[u] for u in q.users if u in index]
         if not known:
             logger.info("no query users with factors; returning empty result")
-            return PredictedResult(userScores=[])
-        V = model.device_factors()
-        query_vec = V[jnp.asarray(np.asarray(known, dtype=np.int32))].sum(axis=0)
-
-        from predictionio_tpu.models.filters import entity_exclusion_mask
-
-        mask = entity_exclusion_mask(
-            index, query.users, query.whiteList, query.blackList
+            results[qi] = PredictedResult(userScores=[])
+            continue
+        if q.whiteList is not None:
+            mask = entity_exclusion_mask(
+                index, q.users, q.whiteList, q.blackList
+            )
+            complex_.append((qi, known, mask, int(q.num)))
+        else:
+            excluded = set(known)
+            if q.blackList is not None:
+                excluded.update(index[u] for u in q.blackList if u in index)
+            simple.append((qi, known, excluded, int(q.num)))
+    V = model.device_factors()
+    if simple:
+        L = _pow2(max(len(known) for _, known, _, _ in simple))
+        ixs = np.zeros((len(simple), L), dtype=np.int32)
+        weights = np.zeros((len(simple), L), dtype=np.float32)
+        for row, (_, known, _, _) in enumerate(simple):
+            ixs[row, : len(known)] = known
+            weights[row, : len(known)] = 1.0
+        k = _pow2(max(num + len(excl) for _, _, excl, num in simple))
+        scores, ids = sum_rows_top_k_batch(ixs, weights, V, k=k)
+        scores, ids = np.asarray(scores), np.asarray(ids)
+        for row, (qi, _, excluded, num) in enumerate(simple):
+            user_scores: list[UserScore] = []
+            for s, i in zip(scores[row], ids[row]):
+                ii = int(i)
+                if ii in excluded:
+                    continue
+                user_scores.append(UserScore(user=inv[ii], score=float(s)))
+                if len(user_scores) == num:
+                    break
+            results[qi] = PredictedResult(userScores=user_scores)
+    for qi, known, mask, num in complex_:
+        L = _pow2(len(known))
+        ixs = np.zeros((1, L), dtype=np.int32)
+        weights = np.zeros((1, L), dtype=np.float32)
+        ixs[0, : len(known)] = known
+        weights[0, : len(known)] = 1.0
+        scores, ids = sum_rows_top_k_batch(
+            ixs, weights, V, k=_pow2(num), exclude_mask=jnp.asarray(mask)
         )
-        scores, ids = top_k_items(
-            query_vec, V, k=int(query.num), exclude_mask=jnp.asarray(mask)
-        )
-        inv = index.inverse
-        return PredictedResult(
+        row_s = np.asarray(scores)[0][:num]
+        row_i = np.asarray(ids)[0][:num]
+        results[qi] = PredictedResult(
             userScores=[
                 UserScore(user=inv[int(i)], score=float(s))
-                for s, i in zip(np.asarray(scores), np.asarray(ids))
+                for s, i in zip(row_s, row_i)
                 if s > -1e29
             ]
         )
+    return results  # type: ignore[return-value]
 
 
 def engine() -> Engine:

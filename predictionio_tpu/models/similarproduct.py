@@ -189,29 +189,104 @@ def _exclude_mask(
     return mask
 
 
-def _score_similar(model: SimilarProductModel, query: Query) -> PredictedResult:
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+def _score_similar_batch(
+    model: SimilarProductModel, queries: Sequence[Query]
+) -> list[PredictedResult]:
+    """Score a whole micro-batch of similar-item queries with ONE fused
+    gather-sum + top-k device call for the common case.
+
+    Two filter regimes:
+
+    - SIMPLE (no ``categories``/``whiteList``): the excluded set is
+      small and enumerable host-side (the query's own items plus any
+      ``blackList`` hits), so instead of shipping an [I] mask per query
+      the batch requests top-(num + |excluded|) with NO mask and drops
+      excluded ids from the returned prefix — identical results
+      (masking sinks excluded entries without perturbing the others,
+      and ``lax.top_k`` prefixes are k-invariant), zero mask traffic,
+      one shared device call for every simple query in the batch.
+    - COMPLEX (``categories``/``whiteList`` present): the exclusion can
+      cover most of the catalog, so headroom-k is unbounded — these
+      queries keep masked scoring, one [1, I]-masked call each, through
+      the same fused op.
+
+    Single-query ``predict`` delegates here with a batch of one, so a
+    query's response bytes are identical whether or not it was
+    coalesced (gather-sum rows pad with exactly-zero vectors and matmul
+    rows are batch-size-invariant)."""
     import jax.numpy as jnp
 
-    from predictionio_tpu.ops.topk import top_k_items
+    from predictionio_tpu.ops.topk import sum_rows_top_k_batch
 
-    known = [model.item_index[i] for i in query.items if i in model.item_index]
-    if not known:
-        logger.info("no query items with factors; returning empty result")
-        return PredictedResult(itemScores=[])
+    index = model.item_index
+    inv = index.inverse
+    results: list[PredictedResult | None] = [None] * len(queries)
+    simple: list[tuple[int, list[int], set[int], int]] = []
+    complex_: list[tuple[int, list[int], np.ndarray, int]] = []
+    for qi, q in enumerate(queries):
+        known = [index[i] for i in q.items if i in index]
+        if not known:
+            logger.info("no query items with factors; returning empty result")
+            results[qi] = PredictedResult(itemScores=[])
+            continue
+        if q.categories is not None or q.whiteList is not None:
+            complex_.append(
+                (qi, known,
+                 _exclude_mask(index, model.categories, q), int(q.num))
+            )
+        else:
+            excluded = set(known)
+            if q.blackList is not None:
+                excluded.update(index[i] for i in q.blackList if i in index)
+            simple.append((qi, known, excluded, int(q.num)))
     V = model.device_factors()  # row-normalized: dot == cosine
-    query_vec = V[jnp.asarray(np.asarray(known, dtype=np.int32))].sum(axis=0)
-    mask = _exclude_mask(model.item_index, model.categories, query)
-    scores, ids = top_k_items(
-        query_vec, V, k=int(query.num), exclude_mask=jnp.asarray(mask)
-    )
-    inv = model.item_index.inverse
-    return PredictedResult(
-        itemScores=[
-            ItemScore(item=inv[int(i)], score=float(s))
-            for s, i in zip(np.asarray(scores), np.asarray(ids))
-            if s > -1e29  # drop fully-masked placeholders
-        ]
-    )
+    if simple:
+        # pad the per-query item lists to a shared pow2 width with
+        # weight-0 rows (index 0 gathered, then zeroed — exact), and
+        # size k for the worst headroom in the batch; both pow2 so the
+        # jitted program specializes on a bounded shape set
+        L = _pow2(max(len(known) for _, known, _, _ in simple))
+        ixs = np.zeros((len(simple), L), dtype=np.int32)
+        weights = np.zeros((len(simple), L), dtype=np.float32)
+        for row, (_, known, _, _) in enumerate(simple):
+            ixs[row, : len(known)] = known
+            weights[row, : len(known)] = 1.0
+        k = _pow2(max(num + len(excl) for _, _, excl, num in simple))
+        scores, ids = sum_rows_top_k_batch(ixs, weights, V, k=k)
+        scores, ids = np.asarray(scores), np.asarray(ids)
+        for row, (qi, _, excluded, num) in enumerate(simple):
+            item_scores: list[ItemScore] = []
+            for s, i in zip(scores[row], ids[row]):
+                ii = int(i)
+                if ii in excluded:
+                    continue
+                item_scores.append(ItemScore(item=inv[ii], score=float(s)))
+                if len(item_scores) == num:
+                    break
+            results[qi] = PredictedResult(itemScores=item_scores)
+    for qi, known, mask, num in complex_:
+        L = _pow2(len(known))
+        ixs = np.zeros((1, L), dtype=np.int32)
+        weights = np.zeros((1, L), dtype=np.float32)
+        ixs[0, : len(known)] = known
+        weights[0, : len(known)] = 1.0
+        scores, ids = sum_rows_top_k_batch(
+            ixs, weights, V, k=_pow2(num), exclude_mask=jnp.asarray(mask)
+        )
+        row_s = np.asarray(scores)[0][:num]
+        row_i = np.asarray(ids)[0][:num]
+        results[qi] = PredictedResult(
+            itemScores=[
+                ItemScore(item=inv[int(i)], score=float(s))
+                for s, i in zip(row_s, row_i)
+                if s > -1e29  # drop fully-masked placeholders
+            ]
+        )
+    return results  # type: ignore[return-value]
 
 
 def _view_counts(td: TrainingData) -> IndexedRatings:
@@ -260,7 +335,16 @@ class ALSAlgorithm(Algorithm):
         )
 
     def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
-        return _score_similar(model, query)
+        # batch of one through the batched scorer: byte-identical to the
+        # same query arriving inside a coalesced micro-batch
+        return _score_similar_batch(model, [query])[0]
+
+    def batch_predict(
+        self, model: SimilarProductModel,
+        queries: Sequence[tuple[int, Query]],
+    ) -> list[tuple[int, PredictedResult]]:
+        results = _score_similar_batch(model, [q for _, q in queries])
+        return [(ix, r) for (ix, _), r in zip(queries, results)]
 
 
 class LikeAlgorithm(ALSAlgorithm):

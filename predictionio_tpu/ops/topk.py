@@ -87,6 +87,78 @@ def top_k_items_batch(user_vectors, item_factors, k: int, exclude_mask=None):
     return jax.lax.top_k(scores, k)
 
 
+@obs_device.track_jit("topk.gather_top_k_batch")
+@functools.partial(jax.jit, static_argnames=("k",))
+def gather_top_k_batch(user_ixs, user_factors, item_factors, k: int,
+                       exclude_mask=None):
+    """Fused gather + batched top-k: the serving batch fast path.
+
+    ``user_ixs`` ([B] int32) select rows from the device-RESIDENT user
+    table ``user_factors`` (dense [U, D] array or int8 (values, scales)
+    pair); the gathered vectors are dequantized on device and scored
+    like ``top_k_items_batch``. Host-to-device traffic per dispatch is
+    B int32s instead of B*D floats — the user table went up once at
+    deploy.
+
+    Dequantization (``values.astype(f32) * scales[:, None]``) is
+    elementwise-exact, i.e. bitwise-identical to the host-side
+    ``ALSModel.user_rows`` dequant, and the matmul rows of a batched
+    score are invariant to the batch size — so a batch-of-1 through
+    this op byte-matches any batchmate's row in a larger batch (the
+    property the batched/unbatched response-parity tests pin down)."""
+    ixs = user_ixs.astype(jnp.int32)
+    if isinstance(user_factors, tuple):
+        uq, us = user_factors
+        user_vectors = uq[ixs].astype(jnp.float32) * us[ixs][:, None]
+    else:
+        user_vectors = user_factors[ixs].astype(jnp.float32)
+    if isinstance(item_factors, tuple):
+        q, s = item_factors
+        scores = (
+            jnp.matmul(
+                user_vectors, q.T.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            * s[None, :]
+        )  # [B, I]
+    else:
+        scores = jnp.matmul(
+            user_vectors, item_factors.astype(jnp.float32).T,
+            preferred_element_type=jnp.float32,
+        )  # [B, I]
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask.astype(bool)[None, :], NEG_INF, scores)
+    k = min(k, catalog_rows(item_factors))
+    return jax.lax.top_k(scores, k)
+
+
+@obs_device.track_jit("topk.sum_rows_top_k_batch")
+@functools.partial(jax.jit, static_argnames=("k",))
+def sum_rows_top_k_batch(row_ixs, row_weights, item_factors, k: int,
+                         exclude_mask=None):
+    """Fused multi-row gather-sum + batched top-k for the cosine-family
+    templates (similarproduct, recommendeduser), whose query vector is
+    the SUM of several catalog rows.
+
+    ``row_ixs``: [B, L] int32 rows of ``item_factors`` (dense [I, D],
+    row-normalized) to sum per query, right-padded to a shared static L;
+    ``row_weights``: [B, L] f32, 1.0 for real rows and 0.0 for padding
+    (adding an exactly-zero vector never perturbs the f32 sum, so rows
+    are bitwise-invariant across padded widths).
+    ``exclude_mask``: optional [I] mask shared by the batch — the
+    complex-filter path calls this with B == 1 and its query's own mask.
+    Returns ([B, k] scores, [B, k] ids)."""
+    V = item_factors
+    qvecs = jnp.sum(
+        V[row_ixs.astype(jnp.int32)] * row_weights[..., None], axis=1
+    )  # [B, D]
+    scores = jnp.matmul(qvecs, V.T, preferred_element_type=jnp.float32)
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask.astype(bool)[None, :], NEG_INF, scores)
+    k = min(k, V.shape[0])
+    return jax.lax.top_k(scores, k)
+
+
 @obs_device.track_jit("topk.ranking_metrics_batch")
 @functools.partial(jax.jit, static_argnames=("k",))
 def ranking_metrics_batch(pred_ids, actual_sorted, actual_counts, k: int):

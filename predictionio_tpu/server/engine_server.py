@@ -34,9 +34,9 @@ import threading
 import time
 import urllib.request
 import uuid
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import InvalidStateError
 from concurrent.futures import TimeoutError as FuturesTimeout
-from typing import Any
+from typing import Any, NamedTuple
 
 from predictionio_tpu import faults
 from predictionio_tpu.core.engine import Engine
@@ -113,32 +113,37 @@ class _MicroBatcher:
     concurrent requests cost ~1 dispatch instead of N; batch_predict's
     batched matmul also fills the MXU where single queries underuse it.
 
-    ADAPTIVE: at construction one timed no-op device call measures the
-    per-dispatch cost this attachment actually pays, picking one of
-    three regimes:
+    LOAD-AWARE: the batcher is ALWAYS engaged — the engage decision
+    moved from deploy time (the retired ``MIN_DISPATCH_S`` floor, which
+    disengaged every local attachment and was exactly why BENCH_r04
+    measured batching LOSING) to per-batch time, where queue depth is
+    known:
 
-    - ``dispatch < MIN_DISPATCH_S`` (fast local attachments): batching
-      cannot win — there is no dispatch worth amortizing, and funneling
-      requests through one worker thread only serializes work the
-      handler threads would overlap. The batcher DISENGAGES
-      (``engaged`` False) and the route serves per-request.
-    - ``MIN_DISPATCH_S <= dispatch <= window``: drain-only batching —
-      the worker serves whatever is queued and never idle-waits (a lone
-      query pays zero added latency; batches form naturally from
-      requests that queue behind an in-flight device call).
+    - queue depth 1 (idle server): the collected "batch" takes the
+      single-item FAST PATH — straight to ``predict``, no padding, no
+      coalescing — so a lone query pays only the queue hop (~0.1 ms),
+      never the window.
+    - queue depth > 1 (amortization wins by construction): ONE padded
+      ``batch_predict`` per algorithm scores the whole batch. Depth is
+      created by load itself: requests queue behind the in-flight
+      device call and coalesce into the next one.
     - ``dispatch > window`` (remote tunnels, ~130 ms/call): the worker
       additionally waits up to the window to grow the batch — added
       latency bounded by the window, itself below one dispatch.
 
+    Batches pad to power-of-two sizes (1,2,4,...,``max_batch``) so the
+    jitted scoring programs specialize on at most log2(max_batch)+1
+    shapes — ``pio_jit_compiles_total`` stays flat under load.
+
     Semantics are identical to per-request serving: every Algorithm has
     ``batch_predict`` (the default loops ``predict``), and
-    serving/plugins/feedback still run per query. A failing batch
-    retries its items individually so one bad query can't poison its
+    serving/plugins/feedback still run per query. Queries are parsed on
+    their REQUEST thread (a malformed body 400s without occupying a
+    batch slot), and the serving/feedback/plugin tail also runs on the
+    request thread — the worker only collects and dispatches, so the
+    JSON/serving work of batchmates overlaps. A failing batch retries
+    its items individually so one bad query can't poison its
     batchmates."""
-
-    # below this measured per-dispatch cost there is nothing worth
-    # amortizing and the worker-thread funnel only costs throughput
-    MIN_DISPATCH_S = 1e-3
 
     def __init__(self, server: "EngineServer", window_ms: float,
                  max_batch: int = 64, dispatch_cost_s: float | None = None):
@@ -154,17 +159,11 @@ class _MicroBatcher:
             self._measure_dispatch() if dispatch_cost_s is None
             else dispatch_cost_s
         )
-        self.engaged = self.dispatch_cost_s >= self.MIN_DISPATCH_S
+        # kept for dashboards/tests: the batcher no longer disengages —
+        # single-item batches bypass the machinery instead
+        self.engaged = True
         self._window_wait = self.dispatch_cost_s > self._window
-        if not self.engaged:
-            logger.info(
-                "micro-batch: measured dispatch %.3f ms on this "
-                "attachment — below the %.1f ms floor, serving "
-                "per-request (batching disengaged)",
-                self.dispatch_cost_s * 1e3,
-                self.MIN_DISPATCH_S * 1e3,
-            )
-        elif not self._window_wait:
+        if not self._window_wait:
             logger.info(
                 "micro-batch: measured dispatch %.2f ms <= window %.1f ms "
                 "on this attachment; window bypassed (batches form only "
@@ -197,15 +196,13 @@ class _MicroBatcher:
         obs_metrics.gauge(
             "pio_batch_engaged",
             "1 when the micro-batcher serves queries, 0 when disengaged",
-        ).set(1.0 if self.engaged else 0.0)
+        ).set(1.0)
         obs_metrics.gauge(
             "pio_batch_dispatch_cost_seconds",
             "Measured per-device-call dispatch cost at deploy",
         ).set(self.dispatch_cost_s)
-        self._thread = None
-        if self.engaged:  # disengaged: the route never submits
-            self._thread = threading.Thread(target=self._loop, daemon=True)
-            self._thread.start()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
 
     @staticmethod
     def _measure_dispatch() -> float:
@@ -231,22 +228,29 @@ class _MicroBatcher:
     def active(self) -> bool:
         return not self._stopped
 
-    def submit(self, body: dict):
+    def submit(self, body: dict) -> "_Submitted":
+        """Parse on the request thread, enqueue for the worker. Returns
+        the pending future (resolving to the per-algorithm predictions)
+        plus the parsed context the request thread needs to finish the
+        query itself. Parse errors raise here — a malformed body 400s
+        without ever occupying a batch slot."""
         from concurrent.futures import Future
 
+        server = self._server
+        with server._lock:
+            algorithms, serving = server.algorithms, server.serving
+        query, sup = server._parse_query(body, algorithms, serving)
         f: Future = Future()
+        t0 = time.perf_counter()
         # the stopped check and the put share stop()'s lock: stop() can
         # never drain between them and strand this future in a dead queue
         with self._lock:
             if self._stopped:
-                f.set_exception(RuntimeError("server stopping"))
-                return f
+                raise RuntimeError("server stopping")
             # the request thread's trace rides the queue item — the
             # worker thread can't see this thread's thread-local
-            self._q.put(
-                (body, f, time.perf_counter(), obs_trace.current_trace())
-            )
-        return f
+            self._q.put((f, t0, obs_trace.current_trace(), sup))
+        return _Submitted(f, query, serving, t0)
 
     def stop(self) -> None:
         import queue
@@ -263,7 +267,7 @@ class _MicroBatcher:
             self._thread.join(timeout=5)
         while True:
             try:
-                _, f, *_ = self._q.get_nowait()
+                f, *_ = self._q.get_nowait()
             except queue.Empty:
                 break
             if not f.done():
@@ -301,9 +305,21 @@ class _MicroBatcher:
                 self._server._handle_query_batch(batch)
             except Exception:  # pragma: no cover - worker must survive
                 logger.exception("micro-batch worker failed")
-                for _, f, *_ in batch:
+                for f, *_ in batch:
                     if not f.done():
                         f.set_exception(RuntimeError("batch worker failed"))
+
+
+class _Submitted(NamedTuple):
+    """What ``_MicroBatcher.submit`` hands back to the request thread:
+    the pending predictions future plus the context to finish the query
+    (serving/feedback/plugins run on the request thread, not the batch
+    worker)."""
+
+    fut: Any
+    query: Any
+    serving: Any
+    t0: float
 
 
 class EngineServer:
@@ -370,14 +386,14 @@ class EngineServer:
         self.query_deadline_s = (
             query_deadline_ms / 1e3 if query_deadline_ms > 0 else None
         )
-        # unbatched queries only need a watcher thread when a deadline is
-        # configured; sized for concurrency, not parallelism (scoring
-        # remains device-bound)
-        self._deadline_pool = (
-            ThreadPoolExecutor(max_workers=32, thread_name_prefix="query-ddl")
-            if self.query_deadline_s is not None
-            else None
-        )
+        # deadline expiry rides the HTTP front end's timer wheel
+        # (HTTPApp.call_later) — a heap entry per in-flight deadline
+        # query, not the 32-thread watcher pool this replaced. The
+        # unbatched path still needs the scoring off the request thread
+        # to answer 503 AT the deadline; a short-lived thread per query
+        # does that, capped so an overload degrades to inline scoring
+        # with post-hoc shedding instead of unbounded thread spawn.
+        self._ddl_slots = threading.BoundedSemaphore(32)
         self._load(instance)
 
         self.request_count = 0
@@ -510,24 +526,9 @@ class EngineServer:
                 with self._lock:
                     self.request_count += 1
                 return payload
-        if (
-            self.batcher is not None
-            and self.batcher.active
-            and self.batcher.engaged
-        ):
+        if self.batcher is not None and self.batcher.active:
             try:
-                response_obj = self.batcher.submit(body).result(
-                    timeout=self.query_deadline_s or 60
-                )
-            except FuturesTimeout:
-                obs_metrics.counter(
-                    "pio_query_deadline_exceeded_total",
-                    "Queries 503'd for overrunning PIO_QUERY_DEADLINE_MS",
-                    path="batched",
-                ).inc()
-                raise QueryDeadlineExceeded(
-                    "query exceeded the per-query deadline"
-                ) from None
+                response_obj = self._serve_batched(body)
             except RuntimeError as e:
                 # batcher INFRASTRUCTURE failure (dead worker / stopping
                 # server), not a query error: degrade to the unbatched
@@ -562,25 +563,128 @@ class EngineServer:
             return False
         return all(a.cacheable_query(supplemented) for a in algorithms)
 
-    def _query_with_deadline(self, body: dict[str, Any]) -> dict[str, Any]:
-        """Unbatched scoring under the per-query deadline (a plain
-        ``handle_query`` call when no deadline is configured — the
-        zero-cost default path)."""
+    def _serve_batched(self, body: dict[str, Any]) -> dict[str, Any]:
+        """Score through the micro-batcher. The worker resolves the
+        future with the per-algorithm predictions; serving/feedback/
+        plugins (``_finish_query``) run HERE on the request thread, so
+        batchmates' response tails overlap instead of serializing on
+        the worker. Deadline expiry is a timer-wheel entry that fails
+        the future — the client gets its 503 AT the deadline even while
+        the device call is still in flight."""
+        sub = self.batcher.submit(body)
+        fut = sub.fut
+        handle = None
+        if self.query_deadline_s is not None:
+            handle = self.app.call_later(
+                self.query_deadline_s,
+                lambda: self._expire_future(fut, "batched"),
+            )
+        # with a timer armed, result() only needs a generous backstop;
+        # without one (loop not running, or no deadline) the result
+        # timeout itself enforces the bound
         if self.query_deadline_s is None:
-            return self.handle_query(body)
-        fut = self._deadline_pool.submit(self.handle_query, body)
+            timeout = 60.0
+        elif handle is None:
+            timeout = self.query_deadline_s
+        else:
+            timeout = self.query_deadline_s + 60.0
         try:
-            return fut.result(timeout=self.query_deadline_s)
+            predictions = fut.result(timeout=timeout)
         except FuturesTimeout:
-            fut.cancel()  # best-effort; a started call finishes discarded
-            obs_metrics.counter(
-                "pio_query_deadline_exceeded_total",
-                "Queries 503'd for overrunning PIO_QUERY_DEADLINE_MS",
-                path="unbatched",
-            ).inc()
+            self._count_deadline("batched")
             raise QueryDeadlineExceeded(
                 "query exceeded the per-query deadline"
             ) from None
+        finally:
+            if handle is not None:
+                handle.cancel()
+        return self._finish_query(
+            body, sub.query, predictions, sub.serving, sub.t0
+        )
+
+    @staticmethod
+    def _count_deadline(path: str) -> None:
+        obs_metrics.counter(
+            "pio_query_deadline_exceeded_total",
+            "Queries 503'd for overrunning PIO_QUERY_DEADLINE_MS",
+            path=path,
+        ).inc()
+
+    def _expire_future(self, fut, path: str) -> None:
+        """Timer-wheel callback: fail a still-pending query future at
+        its deadline. Counts only when this call actually expired it
+        (the scoring path winning the race resolves the future first)."""
+        if fut.done():
+            return
+        try:
+            fut.set_exception(
+                QueryDeadlineExceeded("query exceeded the per-query deadline")
+            )
+        except InvalidStateError:
+            return
+        self._count_deadline(path)
+
+    def _query_with_deadline(self, body: dict[str, Any]) -> dict[str, Any]:
+        """Unbatched scoring under the per-query deadline (a plain
+        ``handle_query`` call when no deadline is configured — the
+        zero-cost default path).
+
+        With a deadline: scoring runs on a short-lived thread while a
+        timer-wheel entry arms the 503 — the client is answered AT the
+        deadline and an overrunning call finishes discarded (Python
+        can't preempt it). The thread count is capped; past the cap —
+        or before the HTTP loop starts — scoring runs inline and
+        overruns are shed after the fact (same 503 + Retry-After, the
+        response-freshness guarantee holds, only the early answer is
+        lost)."""
+        if self.query_deadline_s is None:
+            return self.handle_query(body)
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        handle = self.app.call_later(
+            self.query_deadline_s, lambda: self._expire_future(fut, "unbatched")
+        )
+        if handle is None or not self._ddl_slots.acquire(blocking=False):
+            if handle is not None:
+                handle.cancel()
+            t0 = time.monotonic()
+            result = self.handle_query(body)
+            if time.monotonic() - t0 > self.query_deadline_s:
+                self._count_deadline("unbatched")
+                raise QueryDeadlineExceeded(
+                    "query exceeded the per-query deadline"
+                )
+            return result
+
+        def run() -> None:
+            try:
+                r = self.handle_query(body)
+            except BaseException as e:
+                if not fut.done():
+                    try:
+                        fut.set_exception(e)
+                    except InvalidStateError:
+                        pass
+            else:
+                if not fut.done():
+                    try:
+                        fut.set_result(r)
+                    except InvalidStateError:
+                        pass
+            finally:
+                self._ddl_slots.release()
+
+        threading.Thread(target=run, daemon=True, name="query-ddl").start()
+        try:
+            return fut.result(timeout=self.query_deadline_s + 60.0)
+        except FuturesTimeout:
+            self._count_deadline("unbatched")
+            raise QueryDeadlineExceeded(
+                "query exceeded the per-query deadline"
+            ) from None
+        finally:
+            handle.cancel()
 
     def handle_query(self, body: dict[str, Any]) -> dict[str, Any]:
         faults.fault_point("serve.query")
@@ -639,32 +743,54 @@ class EngineServer:
             self.last_serving_sec = dt
         return response
 
+    @staticmethod
+    def _resolve(fut, predictions=None, exc=None) -> None:
+        # the deadline timer may have expired the future already —
+        # losing that race is normal, never an error
+        if fut.done():
+            return
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(predictions)
+        except InvalidStateError:
+            pass
+
     def _handle_query_batch(self, items) -> None:
         """Score one micro-batch: every algorithm runs ONE batch_predict
-        over the whole batch; serving/feedback/plugins stay per query.
-        A failing batch retries its queries individually so one bad
-        request can't fail its batchmates."""
+        over the whole batch; serving/feedback/plugins run per query on
+        the REQUEST threads (the futures resolve to predictions, not
+        responses). A single-item batch — an idle server's lone query —
+        skips the padding/coalesce machinery and goes straight to
+        ``predict``. A failing batch retries its queries individually so
+        one bad request can't fail its batchmates."""
         with self._lock:
-            algorithms, models, serving = self.algorithms, self.models, self.serving
+            algorithms, models = self.algorithms, self.models
         batcher = self.batcher
         t_collect = time.perf_counter()
-        parsed = []
-        for body, fut, t0, tr in items:
+        for fut, t0, tr, _ in items:
             if batcher is not None:
                 batcher._m_queue_wait.observe(t_collect - t0)
             if tr is not None:
                 tr.add_span("batch.queue_wait", t0, t_collect)
+        if len(items) == 1:
+            # FAST PATH: no padding, no index plumbing — lone-query
+            # latency matches per-request serving
+            fut, _, _, sup = items[0]
             try:
-                query, sup = self._parse_query(body, algorithms, serving)
-                parsed.append((body, fut, t0, tr, query, sup))
+                predictions = [
+                    a.predict(m, sup) for a, m in zip(algorithms, models)
+                ]
             except Exception as e:
-                fut.set_exception(e)
-        if not parsed:
+                self._resolve(fut, exc=e)
+                return
+            self._resolve(fut, predictions)
             return
         per_algo: list[dict] | None
         try:
             indexed = [
-                (i, sup) for i, (_, _, _, _, _, sup) in enumerate(parsed)
+                (i, sup) for i, (_, _, _, sup) in enumerate(items)
             ]
             # pad to a power-of-two batch size with copies of the first
             # query (padding results are discarded): jitted batch
@@ -685,27 +811,24 @@ class EngineServer:
             t_d1 = time.perf_counter()
             if batcher is not None:
                 batcher._m_dispatch.observe(t_d1 - t_d0)
-            for _, _, _, tr, _, _ in parsed:
+            for _, _, tr, _ in items:
                 if tr is not None:
                     tr.add_span(f"batch.dispatch[{n_real}]", t_d0, t_d1)
         except Exception:
             logger.exception("batched scoring failed; retrying per query")
             per_algo = None
-        for i, (body, fut, t0, tr, query, sup) in enumerate(parsed):
-            try:
-                if per_algo is None:
+        for i, (fut, t0, tr, sup) in enumerate(items):
+            if per_algo is None:
+                try:
                     predictions = [
                         a.predict(m, sup) for a, m in zip(algorithms, models)
                     ]
-                else:
-                    predictions = [d[i] for d in per_algo]
-                fut.set_result(
-                    self._finish_query(
-                        body, query, predictions, serving, t0, trace=tr
-                    )
-                )
-            except Exception as e:
-                fut.set_exception(e)
+                except Exception as e:
+                    self._resolve(fut, exc=e)
+                    continue
+            else:
+                predictions = [d[i] for d in per_algo]
+            self._resolve(fut, predictions)
 
     @staticmethod
     def _post_async(
@@ -941,6 +1064,12 @@ class EngineServer:
                     "Queries 503'd while unavailable",
                     reason="deadline",
                 ).inc()
+                # like the swap branch: a deadline 503 burst must be
+                # visible in /traces.json, not just as a counter
+                tr = obs_trace.current_trace()
+                if tr is not None:
+                    now = time.perf_counter()
+                    tr.add_span("serve.unavailable", now, now)
                 return Response(
                     status=503,
                     body={"message": str(e)},
@@ -1073,6 +1202,4 @@ class EngineServer:
             self.speed_layer.stop()
         if self.batcher is not None:
             self.batcher.stop()
-        if self._deadline_pool is not None:
-            self._deadline_pool.shutdown(wait=False)
         self.app.stop()

@@ -1,0 +1,274 @@
+"""Event-loop HTTP front end: keep-alive reuse, pipelining, slowloris
+bounds, TLS, fault points, and the fds-not-threads idle-connection
+economics that replaced the thread-per-connection server."""
+
+from __future__ import annotations
+
+import json
+import socket
+import ssl
+import subprocess
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu import faults
+from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+
+def _echo_app(**kw) -> HTTPApp:
+    router = Router()
+
+    @router.route("GET", "/ping")
+    def ping(request):
+        return Response.json({"ok": True})
+
+    @router.route("POST", "/echo")
+    def echo(request):
+        return Response.json({"got": request.body.decode()})
+
+    return HTTPApp(router, host="127.0.0.1", port=0, **kw)
+
+
+def _get(port: int, sock=None, path="/ping"):
+    """One GET over a (possibly reused) raw socket; returns
+    (status, body, sock) with the connection left open."""
+    if sock is None:
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    sock.sendall(
+        f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+    )
+    return (*_read_response(sock), sock)
+
+
+def _read_response(sock, buf: bytearray | None = None) -> tuple[int, bytes]:
+    """Parse one response; over-read bytes (a pipelined neighbor's
+    response) stay in ``buf`` for the next call."""
+    if buf is None:
+        buf = bytearray()
+    sock.settimeout(10)
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError(f"closed mid-headers: {bytes(buf)!r}")
+        buf += chunk
+    head, _, rest = bytes(buf).partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    clen = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            clen = int(line.split(b":")[1])
+    while len(rest) < clen:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("closed mid-body")
+        rest += chunk
+    buf[:] = rest[clen:]
+    return status, rest[:clen]
+
+
+class TestKeepAliveAndPipelining:
+    def test_keep_alive_reuse(self):
+        app = _echo_app()
+        port = app.start()
+        try:
+            status, body, sock = _get(port)
+            assert status == 200 and json.loads(body) == {"ok": True}
+            # same socket, three more requests — the server must not
+            # have closed it between requests
+            for _ in range(3):
+                status, body, sock = _get(port, sock=sock)
+                assert status == 200 and json.loads(body) == {"ok": True}
+            sock.close()
+        finally:
+            app.stop()
+
+    def test_pipelined_requests(self):
+        """Two requests written back-to-back in one segment both get
+        answered, in order, on the same connection (the worker drains
+        the parser's buffered bytes before yielding the socket)."""
+        app = _echo_app()
+        port = app.start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            one = b"POST /echo HTTP/1.1\r\nHost: x\r\nContent-Length: 1\r\n\r\na"
+            two = b"POST /echo HTTP/1.1\r\nHost: x\r\nContent-Length: 1\r\n\r\nb"
+            sock.sendall(one + two)
+            buf = bytearray()
+            s1, b1 = _read_response(sock, buf)
+            s2, b2 = _read_response(sock, buf)
+            assert s1 == 200 and json.loads(b1) == {"got": "a"}
+            assert s2 == 200 and json.loads(b2) == {"got": "b"}
+            sock.close()
+        finally:
+            app.stop()
+
+    def test_slowloris_partial_request_times_out(self):
+        """A client that trickles half a request line is cut off at
+        read_timeout instead of pinning a worker forever."""
+        app = _echo_app(read_timeout=0.5)
+        port = app.start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            sock.sendall(b"GET /pi")  # never finishes the request
+            sock.settimeout(5)
+            t0 = time.monotonic()
+            assert sock.recv(1024) == b"", "server should close the conn"
+            assert time.monotonic() - t0 < 4
+            sock.close()
+            # the server itself is fine
+            status, _, s2 = _get(port)
+            assert status == 200
+            s2.close()
+        finally:
+            app.stop()
+
+    def test_idle_keep_alive_times_out(self):
+        """An idle keep-alive connection (request completed, nothing
+        since) is an event-loop timer, and still gets reaped."""
+        app = _echo_app(read_timeout=0.5)
+        port = app.start()
+        try:
+            status, _, sock = _get(port)
+            assert status == 200
+            sock.settimeout(5)
+            assert sock.recv(1024) == b"", "idle conn should be reaped"
+            sock.close()
+        finally:
+            app.stop()
+
+
+class TestFdsNotThreads:
+    def test_idle_connections_do_not_hold_threads(self):
+        """N idle keep-alive connections park in the selector; the
+        process thread count stays bounded by the worker pool, not N."""
+        n = 128
+        app = _echo_app(handler_threads=8)
+        port = app.start()
+        socks = []
+        try:
+            for _ in range(n):
+                status, _, sock = _get(port)
+                assert status == 200
+                socks.append(sock)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if threading.active_count() < 8 + 24:
+                    break
+                time.sleep(0.05)
+            count = threading.active_count()
+            assert count < n // 2, (
+                f"{count} threads for {n} idle conns — still "
+                "thread-per-connection?"
+            )
+            # parked connections are still live: reuse a sample
+            for sock in socks[:: n // 8]:
+                status, body, _ = _get(port, sock=sock)
+                assert status == 200 and json.loads(body) == {"ok": True}
+        finally:
+            for sock in socks:
+                sock.close()
+            app.stop()
+
+
+class TestTimerWheel:
+    def test_call_later_fires_and_cancel_holds(self):
+        app = _echo_app()
+        app.start()
+        try:
+            fired = threading.Event()
+            handle = app.call_later(0.05, fired.set)
+            assert handle is not None
+            assert fired.wait(timeout=5)
+
+            never = threading.Event()
+            handle2 = app.call_later(0.05, never.set)
+            handle2.cancel()
+            time.sleep(0.3)
+            assert not never.is_set()
+        finally:
+            app.stop()
+
+    def test_call_later_before_start_returns_none(self):
+        app = _echo_app()
+        assert app.call_later(0.01, lambda: None) is None
+
+
+class TestFaultPoints:
+    def test_http_accept_fault_is_transient(self):
+        """An injected accept failure is swallowed like any transient
+        accept error: the listener keeps accepting afterwards."""
+        app = _echo_app()
+        port = app.start()
+        try:
+            with faults.injected("http.accept:times=1") as plan:
+                # kernel completes the handshake (backlog); the faulted
+                # accept drops out and the still-readable listener picks
+                # the connection up on the next loop pass
+                status, _, sock = _get(port)
+                assert status == 200
+                sock.close()
+            assert plan.fire_count("http.accept") == 1
+        finally:
+            app.stop()
+
+    def test_http_read_fault_drops_connection_not_server(self):
+        app = _echo_app()
+        port = app.start()
+        try:
+            with faults.injected("http.read:times=1") as plan:
+                sock = socket.create_connection(
+                    ("127.0.0.1", port), timeout=10
+                )
+                sock.sendall(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+                sock.settimeout(5)
+                try:
+                    assert sock.recv(1024) == b""
+                except OSError:
+                    pass  # reset is also an acceptable way to die
+                sock.close()
+            assert plan.fire_count("http.read") == 1
+            status, _, s2 = _get(port)
+            assert status == 200
+            s2.close()
+        finally:
+            app.stop()
+
+
+class TestTLSFrontend:
+    def test_tls_keep_alive_and_lazy_handshake(self, tmp_path):
+        """TLS conns handshake lazily in a worker (a silent TCP probe
+        can't stall the loop) and keep-alive works through the wrap."""
+        cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+        proc = subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+                "-subj", "/CN=localhost",
+            ],
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            pytest.skip("openssl unavailable")
+        srv_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        srv_ctx.load_cert_chain(cert, key)
+        app = _echo_app(ssl_context=srv_ctx)
+        port = app.start()
+        probe = None
+        try:
+            # a connection that never speaks TLS must not block others
+            probe = socket.create_connection(("127.0.0.1", port), timeout=10)
+            cli = ssl.create_default_context()
+            cli.check_hostname = False
+            cli.verify_mode = ssl.CERT_NONE
+            raw = socket.create_connection(("127.0.0.1", port), timeout=10)
+            tls = cli.wrap_socket(raw, server_hostname="localhost")
+            for _ in range(2):  # keep-alive across the TLS session
+                status, body, tls = _get(port, sock=tls)
+                assert status == 200 and json.loads(body) == {"ok": True}
+            tls.close()
+        finally:
+            if probe is not None:
+                probe.close()
+            app.stop()

@@ -501,6 +501,19 @@ class TestMicroBatchedServing:
         algo = batched.algorithms[0]
         calls = []
         real_bp = type(algo).batch_predict
+        # expected responses BEFORE patching the class: the base server
+        # shares the algorithm class, and single-query predict now
+        # delegates to batch_predict (for batched/unbatched parity), so
+        # patching first would count the base server's calls too
+        users = [f"u{i}" for i in range(8)]
+        expected = {
+            u: http(
+                "POST",
+                deployed_engine["base"] + "/queries.json",
+                {"user": u, "num": 3},
+            )[1]
+            for u in users
+        }
 
         def counting_bp(self_, model, queries):
             calls.append(len(queries))
@@ -508,15 +521,6 @@ class TestMicroBatchedServing:
 
         type(algo).batch_predict = counting_bp
         try:
-            users = [f"u{i}" for i in range(8)]
-            expected = {
-                u: http(
-                    "POST",
-                    deployed_engine["base"] + "/queries.json",
-                    {"user": u, "num": 3},
-                )[1]
-                for u in users
-            }
             results: dict = {}
 
             def one(u):
@@ -625,9 +629,11 @@ class TestMicroBatchedServing:
         assert batched < unbatched / 2, (unbatched, batched)
 
     def test_bypass_mode_lone_query_skips_window(self, storage, deployed_engine):
-        """Adaptive policy: when the measured dispatch cost is below the
-        window, the window is bypassed — a lone query must NOT pay the
-        configured wait (the round-4 foot-gun: enabling batching on a
+        """Load-aware policy: the batcher stays engaged on fast-dispatch
+        attachments (that's where BENCH_r04's regression came from — the
+        old dispatch-cost floor disengaged it), but a lone query takes
+        the single-item fast path and must NOT pay the configured
+        window (the round-4 foot-gun: enabling batching on a
         fast-dispatch attachment made serving worse)."""
         import time as _time
 
@@ -636,10 +642,11 @@ class TestMicroBatchedServing:
         server = EngineServer(
             deployed_engine["engine"], deployed_engine["server"].instance,
             storage=deployed_engine["storage"], host="127.0.0.1", port=0,
-            batch_window_ms=500.0, dispatch_cost_s=0.0,  # sub-floor
+            batch_window_ms=500.0, dispatch_cost_s=0.0,  # fast dispatch
         )
-        # below the dispatch floor the batcher disengages entirely
-        assert server.batcher is not None and not server.batcher.engaged
+        # always engaged now; lone-query latency is protected by the
+        # single-item fast path, not by disengaging
+        assert server.batcher is not None and server.batcher.engaged
         port = server.start()
         try:
             http("POST", f"http://127.0.0.1:{port}/queries.json",
