@@ -39,6 +39,7 @@ import json
 import os
 import resource
 import tempfile
+import threading
 import time
 import urllib.request
 
@@ -2608,6 +2609,19 @@ def _compact_summary(result: dict) -> dict:
             for k in ("scale", "events", "events_per_s", "s_per_iteration")
             if k in ss
         }
+    ps = result.get("production_stack")
+    if isinstance(ps, dict) and "error" not in ps:
+        s["production_stack"] = {
+            "qps": ps.get("serving", {}).get("qps"),
+            "worst_p99_ms": ps.get("serving", {}).get("worst_p99_ms"),
+            "acked": ps.get("ingest", {}).get("acked"),
+            "lost": ps.get("ingest", {}).get("lost"),
+            "freshness_p99_s": ps.get("freshness", {}).get("p99_s"),
+            "seconds_behind": ps.get("realtime", {}).get("seconds_behind"),
+            "chaos_fired": sum(ps.get("chaos", {}).get("fired", {}).values()),
+            "slo_states": ps.get("slo", {}).get("states"),
+            "ok": ps.get("ok"),
+        }
     errors = sorted(
         k for k, v in result.items()
         if isinstance(v, dict) and "error" in v
@@ -2731,6 +2745,387 @@ def bench_serving_smoke(result: dict) -> None:
         set_storage(None)
 
 
+def bench_production_stack(result: dict, smoke: bool = False) -> None:
+    """Everything on, under chaos: a trained engine serving closed-loop
+    load while an HTTP ingest burst lands in the event server, the speed
+    layer folds the new events into the live model under the epoch
+    fence, and a mid-run retrain + POST /reload swaps the whole model —
+    all with ``PIO_FAULTS`` armed on the serve, fsync, and fold paths.
+
+    Pass/fail IS the SLO evaluation: the default objective sets the
+    servers installed at construction (plus a bench-local zero-counter
+    objective on ingest 5xx) are driven by a background evaluator for
+    the whole run, and the gate asserts no objective ends VIOLATED, the
+    measured p99 is within the declared budget, the replay audit shows
+    zero acked-event loss, and ingest-to-servable freshness and
+    ``seconds_behind`` stayed bounded."""
+    from predictionio_tpu import faults
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import (
+        AccessKey,
+        App,
+        Storage,
+        set_storage,
+    )
+    from predictionio_tpu.models import recommendation
+    from predictionio_tpu.obs import freshness as obs_freshness
+    from predictionio_tpu.obs import metrics as obs_metrics
+    from predictionio_tpu.obs import slo as obs_slo
+    from predictionio_tpu.server.engine_server import EngineServer
+    from predictionio_tpu.server.event_server import EventServer
+
+    # declared budgets (env-overridable; production_stack_main seeds the
+    # smoke defaults) — the same numbers the SLO specs read
+    p99_budget_ms = float(os.environ.get("PIO_SLO_SERVING_MS", "250"))
+    freshness_budget_s = float(os.environ.get("PIO_SLO_FRESHNESS_S", "30"))
+    behind_budget_s = float(os.environ.get("PIO_SLO_SECONDS_BEHIND", "60"))
+
+    # jsonl event log so the storage.fsync fault point is real; memory
+    # metadata/models keep setup cheap
+    tmp = tempfile.mkdtemp(dir=os.environ["BENCH_TMPDIR"])
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_DB_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+        "PIO_STORAGE_SOURCES_LOG_PATH": tmp,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+    })
+    set_storage(storage)
+    obs_freshness.reset()
+
+    if smoke:
+        n_seed, conns, per_conn = 2000, 16, 25
+        ingest_procs, ingest_per_proc = 4, 40
+        fold_interval, eval_interval = 0.3, 0.5
+    else:
+        n_seed, conns, per_conn = 8000, 64, 50
+        ingest_procs, ingest_per_proc = 8, 150
+        fold_interval, eval_interval = 1.0, 1.0
+
+    plan = None
+    layer = None
+    servers: list = []
+    prior_faults = os.environ.get("PIO_FAULTS")
+    try:
+        apps = storage.get_metadata_apps()
+        events = storage.get_events()
+        app_id = apps.insert(App(0, "ProdStack"))
+        key = storage.get_metadata_access_keys().insert(
+            AccessKey("", app_id, [])
+        )
+        events.init(app_id)
+        rng = np.random.default_rng(SEED)
+        events.batch_insert(
+            [
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties={"rating": float(r)},
+                )
+                for u, i, r in zip(
+                    rng.integers(0, 200, n_seed),
+                    rng.integers(0, 60, n_seed),
+                    rng.integers(1, 6, n_seed),
+                )
+            ],
+            app_id,
+        )
+        engine = recommendation.engine()
+        variant = {
+            "id": "prod-stack",
+            "engineFactory": "predictionio_tpu.models.recommendation.engine",
+            "datasource": {"params": {"app_name": "ProdStack"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 8, "num_iterations": 3}}],
+        }
+
+        def _train():
+            run_train(
+                engine, engine.params_from_variant(variant),
+                engine_id="prod-stack",
+                engine_factory=variant["engineFactory"],
+                workflow_params=WorkflowParams(batch="bench"),
+                storage=storage,
+            )
+            return storage.get_metadata_engine_instances()\
+                .get_latest_completed("prod-stack", "0", "default")
+
+        inst = _train()
+        engine_server = EngineServer(
+            engine, inst, storage=storage, host="127.0.0.1", port=0,
+            batch_window_ms=5.0,
+        )
+        event_server = EventServer(
+            storage=storage, host="127.0.0.1", port=0
+        )
+        servers = [engine_server, event_server]
+        eport = engine_server.start(background=True)
+        iport = event_server.start(background=True)
+
+        from predictionio_tpu.realtime.speed_layer import SpeedLayer
+
+        layer = SpeedLayer(
+            engine_server, interval=fold_interval,
+            cursor_path=os.path.join(tmp, "cursor.json"),
+        )
+        layer.start()
+
+        # bench-local zero-tolerance objective: an ingest 5xx is an
+        # acked-loss risk, so the counter must never move
+        obs_slo.register(obs_slo.ZeroCounterSlo(
+            "stack.ingest_5xx",
+            obs_metrics.counter(
+                "pio_http_errors_total", "Requests answered with 5xx",
+                server="eventserver",
+            ),
+        ))
+
+        # arm chaos IN-PROCESS (the gated clients are stdlib-only and
+        # never import the framework, so the env copy is documentation)
+        chaos = (
+            "serve.batch_dispatch:p=0.02,seed=11:sleep=25;"
+            "storage.fsync:p=0.05,seed=7:sleep=10;"
+            "foldin.fold:nth=3:raise"
+        )
+        os.environ["PIO_FAULTS"] = chaos
+        plan = faults.install(faults.parse_plan(chaos))
+
+        bodies = [
+            json.dumps({"user": f"u{u}", "num": int(n)})
+            for u, n in zip(rng.integers(0, 200, 32), rng.choice([3, 4], 32))
+        ]
+        _load_gen("127.0.0.1", eport, "/queries.json", bodies, conns, 2,
+                  n_procs=4)  # warm jit shape buckets off the clock
+
+        # background SLO evaluator: the judge runs for the whole scenario
+
+        stop_eval = threading.Event()
+
+        def _eval_loop():
+            while not stop_eval.is_set():
+                try:
+                    obs_slo.REGISTRY.evaluate_all()
+                except Exception:
+                    pass
+                stop_eval.wait(eval_interval)
+
+        eval_t = threading.Thread(target=_eval_loop, daemon=True)
+        eval_t.start()
+
+        # serving ladder: closed-loop rounds back-to-back until the
+        # mixed-phase work (ingest burst, fold catch-up, retrain+reload)
+        # is done — load stays on through every transition
+        serving_rounds: list = []
+        serving_errors: list = []
+        stop_serving = threading.Event()
+
+        def _serve_loop():
+            while not stop_serving.is_set():
+                try:
+                    serving_rounds.append(_load_gen(
+                        "127.0.0.1", eport, "/queries.json", bodies,
+                        conns, per_conn, n_procs=4,
+                    ))
+                except Exception as e:  # surfaced in the gate below
+                    serving_errors.append(f"{type(e).__name__}: {e}")
+                    return
+
+        serve_t = threading.Thread(target=_serve_loop, daemon=True)
+        t_run0 = time.perf_counter()
+        serve_t.start()
+
+        # ingest burst (every client asserts 201 — the ack the audit
+        # replays against)
+        acked = ingest_procs * ingest_per_proc
+        ingest_s = _run_gated_clients(
+            _SINGLE_EVENT_CLIENT_BODY, "127.0.0.1", iport,
+            f"/events.json?accessKey={key}", ingest_procs, ingest_per_proc,
+        )
+
+        # fold catch-up under load: the speed layer must drain the burst
+        # into the live model before the retrain supersedes it
+        deadline = time.time() + (45 if smoke else 120)
+        while time.time() < deadline:
+            if (layer.tailer.events_behind() or 0) == 0 \
+                    and engine_server._foldin_epoch > 0:
+                break
+            time.sleep(0.2)
+        foldin_epoch_peak = engine_server._foldin_epoch
+
+        # mid-run retrain + epoch-fenced reload, still under load
+        _train()
+        reload_resp = _post_json(
+            f"http://127.0.0.1:{eport}/reload", {}, timeout=60
+        )
+
+        stop_serving.set()
+        serve_t.join(timeout=180)
+        run_s = time.perf_counter() - t_run0
+        stop_eval.set()
+        eval_t.join(timeout=10)
+
+        # post-reload settle: the superseded speed layer resets to the
+        # new train watermark and reports caught-up
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (layer.tailer.events_behind() or 0) == 0:
+                break
+            time.sleep(0.2)
+
+        fire_counts = {
+            point: plan.fire_count(point)
+            for point in (
+                "serve.batch_dispatch", "storage.fsync", "foldin.fold"
+            )
+        }
+        final_doc = obs_slo.REGISTRY.evaluate_all()
+        slo_states = {d["name"]: d["state"] for d in final_doc["slos"]}
+        alerts = final_doc["alerts"]
+
+        # replay audit: every event a client got a 201 for must be
+        # readable back from the store — zero acked loss
+        stored = sum(
+            1 for e in events.find(app_id) if e.entity_id.startswith("cu")
+        )
+        lost = acked - stored
+
+        f_counts, _f_sum, f_n = obs_freshness.HISTOGRAM.merged()
+        freshness_p99 = obs_freshness.HISTOGRAM.percentile(0.99)
+        gauges = layer.gauges()
+        worst_p99 = max((r["p99_ms"] for r in serving_rounds), default=None)
+        total_q = sum(r["total_queries"] for r in serving_rounds)
+
+        block = {
+            "smoke": smoke,
+            "run_s": round(run_s, 2),
+            "serving": {
+                "rounds": len(serving_rounds),
+                "conns": conns,
+                "total_queries": total_q,
+                "qps": round(total_q / run_s, 1) if run_s else None,
+                "worst_p99_ms": worst_p99,
+                "p99_budget_ms": p99_budget_ms,
+                "errors": serving_errors,
+            },
+            "ingest": {
+                "acked": acked,
+                "stored": stored,
+                "lost": lost,
+                "events_per_s": round(acked / ingest_s, 1),
+            },
+            "realtime": {
+                "foldin_epoch_peak": foldin_epoch_peak,
+                "events_behind": gauges["events_behind"],
+                "seconds_behind": gauges["seconds_behind"],
+                "seconds_behind_budget": behind_budget_s,
+                "events_folded": layer.events_folded,
+            },
+            "freshness": {
+                "observed": f_n,
+                "p99_s": round(freshness_p99, 3),
+                "budget_s": freshness_budget_s,
+                "last_commit": obs_freshness.block().get("last_commit"),
+            },
+            "reload": reload_resp,
+            "chaos": {"plan": chaos, "fired": fire_counts},
+            "slo": {"states": slo_states, "alerts": alerts},
+            "ok": False,
+        }
+        result["production_stack"] = block
+
+        # THE GATE — the SLO evaluation plus the declared budgets
+        assert not serving_errors, f"serving load failed: {serving_errors}"
+        violated = sorted(
+            name for name, st in slo_states.items() if st == "violated"
+        )
+        assert not violated, f"SLOs violated at end of run: {violated}"
+        assert lost == 0, f"acked-event loss: {lost} of {acked} missing"
+        assert worst_p99 is not None and worst_p99 <= p99_budget_ms, (
+            f"p99 {worst_p99}ms over budget {p99_budget_ms}ms"
+        )
+        assert f_n > 0, "no freshness observations recorded"
+        assert freshness_p99 <= freshness_budget_s, (
+            f"freshness p99 {freshness_p99}s over budget {freshness_budget_s}s"
+        )
+        assert (gauges["seconds_behind"] or 0) <= behind_budget_s, (
+            f"seconds_behind {gauges['seconds_behind']} over budget"
+        )
+        assert foldin_epoch_peak > 0, "speed layer never patched the model"
+        assert sum(fire_counts.values()) > 0, "chaos plan never fired"
+        block["ok"] = True
+    finally:
+        faults.clear()
+        if prior_faults is None:
+            os.environ.pop("PIO_FAULTS", None)
+        else:
+            os.environ["PIO_FAULTS"] = prior_faults
+        if layer is not None:
+            layer.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        set_storage(None)
+
+
+def production_stack_main(smoke: bool) -> None:
+    """``bench.py production_stack [--smoke]``: run the mixed-load chaos
+    scenario on its own, print the full-detail line plus the compact
+    summary line, and exit non-zero unless the SLO gate passed."""
+    import atexit
+    import shutil
+    import sys as _sys
+
+    # the SLO engine reads these at server construction — seed the
+    # scenario-scale defaults before anything imports the framework
+    # (operator env wins: setdefault only)
+    if smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("PIO_SLO_FAST_WINDOW_S", "4")
+        os.environ.setdefault("PIO_SLO_SLOW_WINDOW_S", "16")
+        os.environ.setdefault("PIO_SLO_SERVING_MS", "1500")
+        os.environ.setdefault("PIO_SLO_FRESHNESS_S", "60")
+        os.environ.setdefault("PIO_SLO_SECONDS_BEHIND", "45")
+    else:
+        os.environ.setdefault("PIO_SLO_FAST_WINDOW_S", "30")
+        os.environ.setdefault("PIO_SLO_SLOW_WINDOW_S", "120")
+        os.environ.setdefault("PIO_SLO_SERVING_MS", "500")
+    # the bench drives evaluation itself for a deterministic cadence
+    os.environ.setdefault("PIO_SLO_TICK", "0")
+    from predictionio_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+    tmpdir = tempfile.mkdtemp(prefix="pio_bench_prod_")
+    atexit.register(shutil.rmtree, tmpdir, ignore_errors=True)
+    os.environ["BENCH_TMPDIR"] = tmpdir
+    result: dict = {
+        "metric": "bench_production_stack",
+        "value": None,
+        "unit": "s",
+        "device": "cpu (smoke)" if smoke else "default",
+        "smoke": smoke,
+    }
+    t0 = time.perf_counter()
+    try:
+        bench_production_stack(result, smoke=smoke)
+    except Exception as e:
+        block = result.get("production_stack")
+        err = f"{type(e).__name__}: {e}"
+        if isinstance(block, dict):
+            block["error"] = err
+        else:
+            result["production_stack"] = {"error": err}
+    result["value"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(result))
+    print(json.dumps(_compact_summary(result)))
+    ok = result.get("production_stack", {}).get("ok") is True
+    _sys.exit(0 if ok else 1)
+
+
 def smoke_main() -> None:
     """--smoke: a seconds-scale CI probe. Forces CPU (no accelerator
     probe), runs the storage section at a tiny event count plus a tiny
@@ -2816,6 +3211,9 @@ def smoke_main() -> None:
 def main() -> None:
     import sys
 
+    if "production_stack" in sys.argv:
+        production_stack_main(smoke="--smoke" in sys.argv)
+        return
     if "--smoke" in sys.argv:
         smoke_main()
         return

@@ -1,0 +1,221 @@
+"""``pio bench --compare OLD.json [NEW.json]``: regression-gate two
+bench summary lines.
+
+The BENCH_r* trajectory (bench.py's compact summary, one JSON object
+per run) records every measured rate and latency the project gates on;
+this module turns any two of them into a pass/fail diff. Numeric leaves
+are matched by path, direction is inferred from the key name (a `_ms`
+is lower-better, a `_per_s` higher-better), and any leaf that moved
+more than ``tolerance`` in the bad direction is a regression — the CLI
+exits non-zero so CI can gate on it.
+
+Dependency-free on purpose: it must run on an operator laptop holding
+two JSON files and nothing else.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+__all__ = ["compare", "leaf_direction", "format_report", "main"]
+
+# direction heuristics, checked in order against the LAST path segment
+# (lowercased). First match wins; unmatched numeric leaves are compared
+# informationally but never flagged.
+_LOWER_BETTER = (
+    "_ms", "_s", "_us", "_ns", "_seconds", "p50", "p99", "p90",
+    "latency", "behind", "rss", "overhead", "cost", "lost", "rmse",
+    "compiles", "_pct",
+)
+_HIGHER_BETTER = (
+    "per_s", "qps", "speedup", "events", "throughput", "hit_rate",
+    "ratio_ok", "recall",
+)
+# keys that are config/identity, not measurements
+_SKIP = (
+    "value", "conns", "clients", "workers", "batch_size", "cores",
+    "acked", "n", "count", "rounds", "budget", "objective", "seed",
+    "port", "pid", "capacity", "scale",
+)
+
+
+def leaf_direction(key: str) -> str | None:
+    """'lower' / 'higher' / None (informational) for a leaf key."""
+    k = key.lower()
+    if any(k == s or k.endswith("_" + s) or k == s.rstrip("_") for s in _SKIP):
+        return None
+    for pat in _HIGHER_BETTER:
+        if pat in k:
+            return "higher"
+    for pat in _LOWER_BETTER:
+        if k.endswith(pat) or pat in k:
+            return "lower"
+    return None
+
+
+def _numeric_leaves(doc, path=""):
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            yield from _numeric_leaves(v, f"{path}.{k}" if path else str(k))
+    elif isinstance(doc, bool):
+        return  # booleans are gates, not measurements
+    elif isinstance(doc, (int, float)):
+        yield path, float(doc)
+
+
+def compare(old: dict, new: dict, tolerance: float = 0.10) -> dict:
+    """Diff two bench summary docs. Returns ``{regressions,
+    improvements, compared, missing}`` where each regression names the
+    leaf path, both values, and the signed change fraction."""
+    old_leaves = dict(_numeric_leaves(old))
+    new_leaves = dict(_numeric_leaves(new))
+    regressions, improvements, compared = [], [], 0
+    for path, old_v in sorted(old_leaves.items()):
+        if path not in new_leaves:
+            continue
+        new_v = new_leaves[path]
+        direction = leaf_direction(path.rsplit(".", 1)[-1])
+        if direction is None:
+            continue
+        compared += 1
+        if old_v == 0.0:
+            # can't express relative change from zero; a nonzero
+            # lower-better value appearing IS a regression signal
+            if direction == "lower" and new_v > 0.0:
+                regressions.append({
+                    "path": path, "old": old_v, "new": new_v,
+                    "change_pct": None,
+                })
+            continue
+        change = (new_v - old_v) / abs(old_v)
+        worse = change > tolerance if direction == "lower" \
+            else change < -tolerance
+        better = change < -tolerance if direction == "lower" \
+            else change > tolerance
+        row = {
+            "path": path, "old": old_v, "new": new_v,
+            "change_pct": round(change * 100.0, 1),
+        }
+        if worse:
+            regressions.append(row)
+        elif better:
+            improvements.append(row)
+    return {
+        "regressions": regressions,
+        "improvements": improvements,
+        "compared": compared,
+        "missing": sorted(set(old_leaves) - set(new_leaves)),
+        "tolerance_pct": round(tolerance * 100.0, 1),
+    }
+
+
+def format_report(report: dict, old_name: str, new_name: str) -> str:
+    lines = [
+        f"bench compare: {old_name} -> {new_name} "
+        f"({report['compared']} leaves, "
+        f"tolerance {report['tolerance_pct']}%)"
+    ]
+    for r in report["regressions"]:
+        pct = "n/a" if r["change_pct"] is None else f"{r['change_pct']:+}%"
+        lines.append(
+            f"  REGRESSION {r['path']}: {r['old']} -> {r['new']} ({pct})"
+        )
+    for r in report["improvements"]:
+        lines.append(
+            f"  improved   {r['path']}: {r['old']} -> {r['new']} "
+            f"({r['change_pct']:+}%)"
+        )
+    if not report["regressions"] and not report["improvements"]:
+        lines.append("  no change beyond tolerance")
+    lines.append(
+        f"{len(report['regressions'])} regression(s), "
+        f"{len(report['improvements'])} improvement(s)"
+    )
+    return "\n".join(lines)
+
+
+def _recover_truncated(text: str) -> dict:
+    """Salvage a JSON capture cut mid-object (the checked-in BENCH_r*
+    artifacts keep only the tail of stdout, so the detail line usually
+    lost its opening braces). Re-parse every ``"key": value`` pair whose
+    value still decodes and rebuild a doc from them; nested sections
+    come back under their own key so leaf paths line up with a fully
+    parsed run. Later duplicates win, matching JSON object semantics."""
+    dec = json.JSONDecoder()
+    doc: dict = {}
+    for m in re.finditer(r'"([A-Za-z0-9_.\-]+)"\s*:\s*', text):
+        try:
+            val, _ = dec.raw_decode(text, m.end())
+        except ValueError:
+            continue
+        if isinstance(val, bool):
+            continue
+        if isinstance(val, (dict, int, float)):
+            doc[m.group(1)] = val
+    return doc
+
+
+def _parse_text(text: str) -> dict | None:
+    """Whole text, else LAST parseable JSON line (the compact summary
+    by bench.py convention), else truncation salvage. None if nothing
+    numeric survives."""
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            return doc
+    except ValueError:
+        pass
+    for line in reversed(text.strip().splitlines()):
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    salvaged = _recover_truncated(text)
+    return salvaged or None
+
+
+def _load_summary(path: str) -> dict:
+    """A bench artifact may be the full-detail line, the compact line, a
+    file holding both, or a driver wrapper ``{"cmd", "rc", "tail"}``
+    whose ``tail`` string carries a (possibly truncated) copy of the
+    bench stdout — unwrap and parse whatever measurement data is
+    actually there."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    doc = _parse_text(text)
+    if doc is None:
+        raise ValueError(f"{path}: no parseable JSON found")
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        inner = _parse_text(tail)
+        if inner:
+            return inner
+    return doc
+
+
+def main(old_path: str, new_path: str | None = None,
+         tolerance: float = 0.10) -> int:
+    """CLI entry; returns the process exit code (non-zero on
+    regression). When NEW is omitted, picks the newest ``BENCH_r*.json``
+    in the current directory that is not OLD."""
+    import glob
+    import os
+
+    if new_path is None:
+        candidates = sorted(
+            (p for p in glob.glob("BENCH_r*.json")
+             if os.path.abspath(p) != os.path.abspath(old_path)),
+            key=os.path.getmtime,
+        )
+        if not candidates:
+            print("bench compare: no NEW given and no BENCH_r*.json found")
+            return 2
+        new_path = candidates[-1]
+    old = _load_summary(old_path)
+    new = _load_summary(new_path)
+    report = compare(old, new, tolerance=tolerance)
+    print(format_report(report, old_path, new_path))
+    return 1 if report["regressions"] else 0

@@ -124,6 +124,8 @@ def cmd_status(args) -> int:
     line = _training_line()
     if line:
         print(line)
+    for line in _slo_lines():
+        print(line)
     return 0
 
 
@@ -150,6 +152,75 @@ def _training_line() -> str | None:
     if doc.get("events_per_s"):
         parts.append(f"{doc['events_per_s']:,.0f} events/s")
     return "training: " + ", ".join(parts)
+
+
+def _fetch_slo_docs() -> dict[str, dict]:
+    """``/slo.json`` per live daemon (pid file + answering port); silent
+    on daemons that are down or predate the endpoint."""
+    import urllib.request
+
+    from predictionio_tpu.cli import daemon
+
+    docs: dict[str, dict] = {}
+    for name in daemon.known_services():
+        if daemon.read_pid(name) is None:
+            continue
+        port = daemon.DEFAULT_PORTS.get(name, 0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo.json", timeout=2.0
+            ) as r:
+                doc = json.loads(r.read())
+        except Exception:
+            continue
+        if isinstance(doc, dict):
+            docs[name] = doc
+    return docs
+
+
+def _slo_lines() -> list[str]:
+    """Human SLO lines for ``pio status``: one per objective, e.g.
+    ``slo[engine] engine.latency: OK (burn 0.2/0.1)``; violated and
+    burning objectives lead with their state upper-cased."""
+    lines: list[str] = []
+    for service, doc in _fetch_slo_docs().items():
+        for s in doc.get("slos", []):
+            state = str(s.get("state", "?"))
+            mark = state.upper() if state != "ok" else "OK"
+            burn = ""
+            if s.get("burn_fast") is not None:
+                burn = f" (burn {s['burn_fast']}/{s.get('burn_slow')})"
+            cur = ""
+            if s.get("current") is not None:
+                cur = f", current {s['current']}"
+            lines.append(
+                f"slo[{service}] {s.get('name')}: {mark}{burn}{cur}"
+            )
+    return lines
+
+
+def cmd_bench(args) -> int:
+    """``pio bench --compare OLD.json [NEW.json]``: regression-diff two
+    bench summary artifacts (>tolerance moves in the bad direction exit
+    non-zero). Running the benchmarks themselves stays with bench.py."""
+    if not getattr(args, "compare", None):
+        print("usage: pio bench --compare OLD.json [NEW.json]",
+              file=sys.stderr)
+        return 2
+    if len(args.compare) > 2:
+        print("bench --compare takes at most OLD and NEW", file=sys.stderr)
+        return 2
+    from predictionio_tpu.cli import bench_compare
+
+    try:
+        return bench_compare.main(
+            args.compare[0],
+            args.compare[1] if len(args.compare) > 1 else None,
+            tolerance=args.tolerance,
+        )
+    except (OSError, ValueError) as e:
+        print(f"bench compare failed: {e}", file=sys.stderr)
+        return 2
 
 
 def cmd_profile(args) -> int:
@@ -228,6 +299,12 @@ def _status_json() -> int:
         if raw is not None:
             try:
                 entry["stats"] = json.loads(raw)
+            except ValueError:
+                pass
+        raw = fetch(f"{base}/slo.json")
+        if raw is not None:
+            try:
+                entry["slo"] = json.loads(raw)
             except ValueError:
                 pass
         services[name] = entry
@@ -896,6 +973,19 @@ def build_parser() -> argparse.ArgumentParser:
         "from running daemons",
     )
     st.set_defaults(fn=cmd_status)
+
+    bc = sub.add_parser("bench")
+    bc.add_argument(
+        "--compare", nargs="+", metavar="SUMMARY.json",
+        help="diff two bench summary JSONs (OLD [NEW]; NEW defaults to "
+        "the newest BENCH_r*.json in the cwd) and exit non-zero on any "
+        ">tolerance regression",
+    )
+    bc.add_argument(
+        "--tolerance", type=float, default=0.10,
+        help="relative change treated as a regression (default 0.10)",
+    )
+    bc.set_defaults(fn=cmd_bench)
 
     pr = sub.add_parser("profile")
     pr.add_argument(

@@ -22,6 +22,13 @@ tracing-timeline argument of the TensorFlow system paper 1605.08695):
 - :mod:`predictionio_tpu.obs.progress` — live training progress via an
   atomic file written at checkpoint segment boundaries, read by
   ``pio status`` and the dashboard while a run is underway.
+- :mod:`predictionio_tpu.obs.slo` — declarative objectives over the
+  metrics registry, judged with multi-window burn-rate alerting
+  (``GET /slo.json``, ``pio_slo_*`` gauges, per-server default sets).
+- :mod:`predictionio_tpu.obs.freshness` — end-to-end ingest-to-servable
+  latency, observed at the epoch-fenced patch/reload commit
+  (``pio_serving_freshness_seconds``; ``freshness`` block on
+  ``/stats.json``).
 
 Instrumentation is ALWAYS-ON and cheap (<2% serving qps, gated by the
 bench ``obs`` section); ``PIO_OBS=0`` turns every instrument into a
@@ -34,5 +41,6 @@ instruments even where they can never fire. Import them explicitly.
 """
 
 from predictionio_tpu.obs import metrics, trace  # noqa: F401
+from predictionio_tpu.obs import freshness, slo  # noqa: F401
 
-__all__ = ["metrics", "trace", "device", "progress"]
+__all__ = ["metrics", "trace", "slo", "freshness", "device", "progress"]

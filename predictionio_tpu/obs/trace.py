@@ -21,6 +21,7 @@ worker) carries the Trace object through its queue items instead —
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 import uuid
@@ -196,11 +197,30 @@ class TraceRing:
                 self._prune_locked(now)
                 self._next_prune = now + 1.0
             if len(self._heap) < self.capacity:
-                heappush(self._heap, (d, self._next_seq(), trace.to_dict()))
+                heappush(
+                    self._heap, (d, self._next_seq(), self._admit(trace, d))
+                )
             elif self._heap and d > self._heap[0][0]:
                 heapreplace(
-                    self._heap, (d, self._next_seq(), trace.to_dict())
+                    self._heap, (d, self._next_seq(), self._admit(trace, d))
                 )
+
+    @staticmethod
+    def _admit(trace: Trace, duration_s: float) -> dict:
+        """Serialize an admitted trace, tagging it with the SLOs it is
+        evidence for (currently-violated objectives plus any latency
+        objective this single request blew) so ``/traces.json``'s
+        ``?slo=violated`` filter jumps straight to the bodies."""
+        entry = trace.to_dict()
+        try:
+            from predictionio_tpu.obs import slo as _slo
+
+            tags = _slo.trace_tags(duration_s)
+        except Exception:
+            tags = []
+        if tags:
+            entry["sloViolated"] = tags
+        return entry
 
     def _next_seq(self) -> int:
         self._seq += 1
@@ -227,7 +247,24 @@ class TraceRing:
             self._heap.clear()
 
 
+def _env_positive(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if raw:
+        try:
+            v = float(raw)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return default
+
+
 # process-global ring every server serves from (one process == one
 # server role in this framework; the multi-tenant supervisor will hang
-# per-tenant rings off this when it lands)
-TRACES = TraceRing()
+# per-tenant rings off this when it lands). Retention is env-tunable:
+# a debugging session can hold thousands of traces for a day, a tight
+# edge box can shrink to a handful of minutes.
+TRACES = TraceRing(
+    capacity=int(_env_positive("PIO_TRACE_RING_CAPACITY", 64)),
+    max_age_s=_env_positive("PIO_TRACE_RING_MAX_AGE_S", 3600.0),
+)

@@ -28,7 +28,9 @@ import time
 from predictionio_tpu import faults
 from predictionio_tpu.common.breaker import CircuitBreaker
 from predictionio_tpu.data import store
+from predictionio_tpu.obs import freshness as obs_freshness
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.realtime.foldin import ALSFoldIn, FoldInConfig
 from predictionio_tpu.realtime.tailer import EventTailer
@@ -118,6 +120,23 @@ class SpeedLayer:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         server.speed_layer = self
+        # staleness as real Prometheus gauges, not just /stats.json:
+        # scrape-time callbacks read this layer's live state (the newest
+        # layer wins the registration — one layer per server process)
+        obs_metrics.gauge(
+            "pio_realtime_events_behind",
+            "Events in the log the speed layer has not folded yet",
+        ).set_function(lambda: float(self.tailer.events_behind() or 0))
+        obs_metrics.gauge(
+            "pio_realtime_seconds_behind",
+            "Seconds since the speed layer was last caught up",
+        ).set_function(lambda: float(self.gauges()["seconds_behind"]))
+        obs_metrics.gauge(
+            "pio_realtime_foldin_epoch",
+            "Fold-in patches applied since the last full reload",
+        ).set_function(lambda: float(self.server._foldin_epoch))
+        # default objectives: bounded staleness + breaker open budget
+        obs_slo.install_speed_layer_slos(self)
 
     # -- one fold cycle -----------------------------------------------------
 
@@ -231,6 +250,22 @@ class SpeedLayer:
                     self.cache_invalidations += 1
                 self._last_fold_s = time.perf_counter() - t0
                 _m_fold.observe(self._last_fold_s)
+                # freshness lineage: these events are servable as of
+                # THIS fenced commit — ingest stamp to now is the true
+                # ingest-to-servable latency (an event that waited out a
+                # breaker or lost fences shows every second of it)
+                with self.server._lock:
+                    foldin_epoch = self.server._foldin_epoch
+                obs_freshness.observe_commit(
+                    [
+                        e.creation_time.timestamp()
+                        for e in events
+                        if e.creation_time is not None
+                    ],
+                    kind="patch",
+                    epoch=epoch + 1,
+                    foldin_epoch=foldin_epoch,
+                )
                 if stats is not None:
                     self.events_folded += stats.rating_events
                     self.users_touched += stats.users_touched

@@ -15,6 +15,7 @@ import logging
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import progress as obs_progress
+from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server.http import (
     HTTPApp,
@@ -98,6 +99,63 @@ def render_waterfall(traces: list[dict], source: str) -> str:
         "retains outliers, not a uniform sample. Fetch another server "
         "with <code>?src=http://host:port</code>.</p>"
         f"{body}</body></html>"
+    )
+
+
+_SLO_COLORS = {"ok": "#2a2", "burning": "#c80", "violated": "#c22"}
+
+
+def render_slo_panel(doc: dict, source: str) -> str:
+    """SLO state table (objective, state, burn fast/slow, SLI, current)
+    plus the alert ring, color-coded by state."""
+    rows = []
+    for s in doc.get("slos", []):
+        state = str(s.get("state", "?"))
+        color = _SLO_COLORS.get(state, "#888")
+        rows.append(
+            f"<tr><td>{html.escape(str(s.get('name')))}</td>"
+            f"<td>{html.escape(str(s.get('kind', '')))}</td>"
+            f"<td style='color:{color};font-weight:bold'>"
+            f"{html.escape(state.upper())}</td>"
+            f"<td>{s.get('objective', '')}</td>"
+            f"<td>{s.get('burn_fast', '')}</td>"
+            f"<td>{s.get('burn_slow', '')}</td>"
+            f"<td>{s.get('sli_slow', '')}</td>"
+            f"<td>{s.get('current', '')}</td></tr>"
+        )
+    alert_rows = "".join(
+        f"<tr><td>{a.get('t')}</td>"
+        f"<td>{html.escape(str(a.get('slo')))}</td>"
+        f"<td>{html.escape(str(a.get('from')))} &rarr; "
+        f"{html.escape(str(a.get('to')))}</td>"
+        f"<td>{a.get('burn_fast')}</td></tr>"
+        for a in reversed(doc.get("alerts", []))
+    )
+    body = (
+        f"<p>No SLOs registered on {html.escape(source)}.</p>"
+        if not rows
+        else (
+            "<table border='1' cellpadding='4'>"
+            "<tr><th>Objective</th><th>Kind</th><th>State</th>"
+            "<th>Target</th><th>Burn (fast)</th><th>Burn (slow)</th>"
+            "<th>SLI (slow)</th><th>Current</th></tr>"
+            + "".join(rows) + "</table>"
+        )
+    )
+    alerts = (
+        "<h2>Alerts</h2>"
+        + (
+            "<table border='1' cellpadding='4'>"
+            "<tr><th>t</th><th>Objective</th><th>Transition</th>"
+            f"<th>Burn (fast)</th></tr>{alert_rows}</table>"
+            if alert_rows
+            else "<p>No state transitions recorded.</p>"
+        )
+    )
+    return (
+        "<html><head><title>SLOs</title></head><body>"
+        f"<h1>SLOs</h1><p>source: {html.escape(source)}</p>"
+        f"{body}{alerts}</body></html>"
     )
 
 
@@ -343,6 +401,32 @@ class Dashboard:
             return Response.html(
                 render_device_panel(block, progress, source)
             )
+
+        @router.route("GET", "/slo")
+        def slo_page(request: Request) -> Response:
+            """SLO state panel: this process's objectives (the dashboard
+            usually has none), or — with ``?src=http://host:port`` — a
+            live server's ``/slo.json`` fetched server-side."""
+            if not server._authorized(request):
+                return Response.error("Not authenticated", 401)
+            src = request.query.get("src")
+            if src:
+                if not src.startswith(("http://", "https://")):
+                    return Response.error("src must be an http(s) URL", 400)
+                import urllib.request
+
+                try:
+                    with urllib.request.urlopen(
+                        f"{src.rstrip('/')}/slo.json", timeout=2
+                    ) as resp:
+                        doc = json.loads(resp.read())
+                except Exception as e:
+                    return Response.error(f"fetch from {src} failed: {e}", 502)
+                source = src
+            else:
+                doc = obs_slo.document()
+                source = "this dashboard process"
+            return Response.html(render_slo_panel(doc, source))
 
         add_obs_routes(router)
         return router

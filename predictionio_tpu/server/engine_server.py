@@ -43,7 +43,9 @@ from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.workflow import prepare_deploy
 from predictionio_tpu.data.storage import EngineInstance, Storage, get_storage
 from predictionio_tpu.obs import device as obs_device
+from predictionio_tpu.obs import freshness as obs_freshness
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import jsonx
 from predictionio_tpu.server import plugins as plugin_mod
@@ -408,6 +410,9 @@ class EngineServer:
             "pio_cache_lookup_seconds",
             "Query-cache canonicalize+lookup time (hits and misses)",
         )
+        # default objectives: p99 latency, 5xx availability, the
+        # warmup/deadline 503 budget, ingest-to-servable freshness
+        obs_slo.install_engine_slos(self)
 
         self.plugins = plugin_mod.load_plugins(plugin_mod.EngineServerPlugin)
         self.plugin_context: dict[str, Any] = {"storage": self.storage}
@@ -483,6 +488,18 @@ class EngineServer:
         # off the server lock — the cache has its own shard locks)
         if self.query_cache is not None:
             self.query_cache.sweep(epoch)
+        # freshness lineage, batch side: events ingested before this
+        # instance's training began are servable NOW — one sample of
+        # (commit - train_start) records the batch-layer staleness floor
+        try:
+            train_start = instance.start_time.timestamp()
+        except (AttributeError, OSError, ValueError):
+            train_start = None
+        obs_freshness.observe_commit(
+            [train_start] if train_start is not None else [],
+            kind="reload",
+            epoch=epoch,
+        )
         logger.info("engine instance %s loaded for serving", instance.id)
 
     # -- query path --------------------------------------------------------
@@ -1032,6 +1049,7 @@ class EngineServer:
             # additive: existing consumers keep their fields untouched
             body["obs"] = obs_metrics.stats_block()
             body["device"] = obs_device.device_block()
+            body["freshness"] = obs_freshness.block()
             return Response.json(body)
 
         @router.route("POST", "/queries.json")

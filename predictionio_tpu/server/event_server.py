@@ -41,6 +41,7 @@ from predictionio_tpu.data.event import (
 from predictionio_tpu.data.storage import AccessKey, Storage, get_storage
 from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import plugins as plugin_mod
 from predictionio_tpu.server.http import (
@@ -108,6 +109,8 @@ class EventServer:
         self._m_rejected = obs_metrics.counter(
             "pio_ingest_events_total", "Events ingested", result="rejected"
         )
+        # default objectives: ingest availability + group-commit latency
+        obs_slo.install_event_server_slos(self)
         self.app = HTTPApp(
             self._router(),
             host=host,

@@ -45,6 +45,7 @@ from urllib.parse import parse_qs, urlparse
 from predictionio_tpu import faults
 from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import trace as obs_trace
 from predictionio_tpu.server import jsonx
 
@@ -235,9 +236,11 @@ def add_obs_routes(router: Router) -> None:
     """Mount ``GET /metrics`` (Prometheus text format),
     ``GET /traces.json`` (slowest recent traces; ``?limit=N`` caps the
     list, ``?since_ms=`` drops traces that started before the given
-    epoch-milliseconds), and ``POST /profile`` (bounded on-demand
-    ``jax.profiler`` capture, ``?seconds=``/``?out=``). ``/metrics``
-    and ``/traces.json`` are unauthenticated on every server — standard
+    epoch-milliseconds, ``?slo=violated`` keeps only traces tagged as
+    SLO evidence), ``GET /slo.json`` (objective states, burn rates, and
+    the alert ring), and ``POST /profile`` (bounded on-demand
+    ``jax.profiler`` capture, ``?seconds=``/``?out=``). ``/metrics``,
+    ``/traces.json``, and ``/slo.json`` are unauthenticated on every server — standard
     scraper behavior; neither exposes event data."""
 
     def _metrics_route(_req: Request) -> Response:
@@ -248,6 +251,11 @@ def add_obs_routes(router: Router) -> None:
 
     def _traces_route(req: Request) -> Response:
         traces = obs_trace.TRACES.snapshot()
+        slo_filter = req.query.get("slo")
+        if slo_filter is not None:
+            if slo_filter != "violated":
+                return Response.error("slo filter must be 'violated'", 400)
+            traces = [t for t in traces if t.get("sloViolated")]
         since_ms = req.query.get("since_ms")
         if since_ms is not None:
             try:
@@ -281,8 +289,12 @@ def add_obs_routes(router: Router) -> None:
             return Response.error(f"profile capture failed: {exc}", 500)
         return Response.json(result)
 
+    def _slo_route(_req: Request) -> Response:
+        return Response.json(obs_slo.document())
+
     router.add("GET", "/metrics", _metrics_route)
     router.add("GET", "/traces.json", _traces_route)
+    router.add("GET", "/slo.json", _slo_route)
     router.add("POST", "/profile", _profile_route)
 
 

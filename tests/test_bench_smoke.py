@@ -1,12 +1,16 @@
 """bench.py --smoke: the CI contract is exit 0 and a machine-readable
 final stdout line (the driver keeps only a bounded tail of stdout, so
-the LAST line must parse with json.loads on its own)."""
+the LAST line must parse with json.loads on its own). Also gates the
+production_stack chaos scenario (pass/fail IS the SLO evaluation) and
+unit-tests the ``pio bench --compare`` regression comparator."""
 
 import json
 import os
 import subprocess
 import sys
 from pathlib import Path
+
+from predictionio_tpu.cli import bench_compare
 
 BENCH = Path(__file__).resolve().parent.parent / "bench.py"
 
@@ -33,3 +37,95 @@ def test_smoke_exit_zero_and_final_line_is_json():
     for bk in ("jsonl", "partitioned"):
         assert st[bk]["scan_speedup"] > 0
         assert st[bk]["import_pooled_events_per_s"] > 0
+
+
+def test_production_stack_smoke_gate():
+    """The chaos scenario under fault injection: exit 0 means every SLO
+    held, no acked event was lost, and the final line is the compact
+    machine-readable summary."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "production_stack", "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(BENCH.parent),
+    )
+    assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-2000:])
+    lines = proc.stdout.strip().splitlines()
+    summary = json.loads(lines[-1])  # the tail-capture contract
+    block = summary["production_stack"]
+    assert block["ok"] is True
+    assert block["lost"] == 0
+    assert block["chaos_fired"] > 0  # the faults really were armed
+    assert all(s == "ok" for s in block["slo_states"].values()), block
+
+
+class TestBenchCompare:
+    OLD = {
+        "serving": {"qps": 1000.0, "p99_ms": 12.0},
+        "ingest": {"events_per_s": 5000.0, "lost": 0},
+        "gone_next_run_s": 3.0,
+    }
+
+    def test_regression_flagged_and_exit_nonzero(self, capsys, tmp_path):
+        new = {
+            "serving": {"qps": 800.0, "p99_ms": 12.5},
+            "ingest": {"events_per_s": 5100.0, "lost": 0},
+        }
+        report = bench_compare.compare(self.OLD, new, tolerance=0.10)
+        paths = [r["path"] for r in report["regressions"]]
+        assert paths == ["serving.qps"]  # -20% qps; +4% p99 tolerated
+        assert report["regressions"][0]["change_pct"] == -20.0
+        assert report["missing"] == ["gone_next_run_s"]
+        # wired end to end: exit code 1, REGRESSION named on stdout
+        old_p, new_p = tmp_path / "old.json", tmp_path / "new.json"
+        old_p.write_text(json.dumps(self.OLD))
+        new_p.write_text(json.dumps(new))
+        assert bench_compare.main(str(old_p), str(new_p)) == 1
+        assert "REGRESSION serving.qps" in capsys.readouterr().out
+
+    def test_within_tolerance_passes(self, tmp_path):
+        new = {
+            "serving": {"qps": 950.0, "p99_ms": 12.9},
+            "ingest": {"events_per_s": 4800.0, "lost": 0},
+        }
+        report = bench_compare.compare(self.OLD, new, tolerance=0.10)
+        assert report["regressions"] == []
+        old_p, new_p = tmp_path / "old.json", tmp_path / "new.json"
+        old_p.write_text(json.dumps(self.OLD))
+        new_p.write_text(json.dumps(new))
+        assert bench_compare.main(str(old_p), str(new_p)) == 0
+
+    def test_zero_to_nonzero_lower_better_is_regression(self):
+        new = dict(self.OLD, ingest={"events_per_s": 5000.0, "lost": 3})
+        report = bench_compare.compare(self.OLD, new)
+        assert [r["path"] for r in report["regressions"]] == ["ingest.lost"]
+        assert report["regressions"][0]["change_pct"] is None
+
+    def test_direction_heuristics(self):
+        assert bench_compare.leaf_direction("qps") == "higher"
+        assert bench_compare.leaf_direction("events_per_s") == "higher"
+        assert bench_compare.leaf_direction("p99_ms") == "lower"
+        assert bench_compare.leaf_direction("seconds_behind") == "lower"
+        assert bench_compare.leaf_direction("conns") is None  # config
+        assert bench_compare.leaf_direction("seed") is None
+
+    def test_load_summary_unwraps_driver_tail_artifact(self, tmp_path):
+        """The checked-in BENCH_r*.json files wrap a TRUNCATED copy of
+        bench stdout in a ``tail`` string; the loader salvages every
+        still-parseable section so old trajectories stay comparable."""
+        detail = json.dumps({
+            "metric": "bench", "value": 1.0,
+            "serving": {"qps": 1000.0, "p99_ms": 12.0},
+        })
+        wrapper = {"n": 4, "cmd": "python bench.py", "rc": 0,
+                   "tail": detail[len('{"metric": "bench", '):]}
+        p = tmp_path / "BENCH_r99.json"
+        p.write_text(json.dumps(wrapper))
+        doc = bench_compare._load_summary(str(p))
+        assert doc["serving"] == {"qps": 1000.0, "p99_ms": 12.0}
+        # an untruncated tail parses whole, no salvage needed
+        p2 = tmp_path / "BENCH_r98.json"
+        p2.write_text(json.dumps({"rc": 0, "tail": detail + "\n"}))
+        assert bench_compare._load_summary(str(p2))["serving"]["qps"] \
+            == 1000.0
