@@ -513,6 +513,113 @@ def import_events(
             pool.shutdown(wait=True)
 
 
+def import_events_http(
+    input_path: str,
+    url: str,
+    access_key: str,
+    channel: str | None = None,
+    frame_events: int = 2000,
+) -> int:
+    """Bulk import over the wire-speed binary endpoint: stream the
+    jsonl file in line-aligned chunks, pack each chunk into PIF1 frames
+    (data/storage/frame.py) and POST them to ``/batch/events.bin`` on a
+    keep-alive connection. 429 ``IngestBackpressure`` answers are
+    retried after ``Retry-After``; connection drops reconnect and
+    resend (exported lines carry event ids, so a resend that overlaps a
+    partially committed request replays idempotently)."""
+    import http.client as _hc
+    import time as _time
+    from urllib.parse import quote, urlsplit
+
+    from predictionio_tpu.data.storage import frame
+
+    parts = urlsplit(url)
+    if parts.scheme not in ("", "http"):
+        raise CommandError(
+            f"import --http supports http:// URLs only, got {url!r}"
+        )
+    host = parts.hostname or "127.0.0.1"
+    port = parts.port or 7070
+    path = "/batch/events.bin?accessKey=" + quote(access_key)
+    if channel:
+        path += "&channel=" + quote(channel)
+    headers = {"Content-Type": "application/octet-stream"}
+
+    conn = _hc.HTTPConnection(host, port, timeout=60)
+    total = 0
+    skipped = 0
+
+    def _post(body: bytes) -> None:
+        nonlocal conn, total
+        for attempt in range(8):
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (OSError, _hc.HTTPException):
+                conn.close()
+                conn = _hc.HTTPConnection(host, port, timeout=60)
+                if attempt == 7:
+                    raise
+                continue
+            if resp.status == 429:
+                try:
+                    delay = float(resp.getheader("Retry-After") or 1.0)
+                except ValueError:
+                    delay = 1.0
+                _time.sleep(min(delay, 5.0))
+                continue
+            if resp.status != 200:
+                raise CommandError(
+                    f"import --http: server answered {resp.status}: "
+                    f"{payload[:200]!r}"
+                )
+            total += int(json.loads(payload).get("accepted", 0))
+            return
+        raise CommandError(
+            "import --http: gave up after repeated backpressure"
+        )
+
+    def _send_chunk(data: bytes) -> None:
+        nonlocal skipped
+        events = []
+        for line in data.split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith(b'{"$delete"'):
+                skipped += 1  # tombstones are storage-internal
+                continue
+            events.append(json.loads(line))
+        if events:
+            _post(frame.encode_body(events, frame_events=frame_events))
+
+    chunk_size = 8 << 20
+    carry = b""
+    try:
+        with open(input_path, "rb") as f:
+            while True:
+                chunk = f.read(chunk_size)
+                if not chunk:
+                    break
+                chunk = carry + chunk
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    carry = chunk
+                    continue
+                carry = chunk[cut + 1 :]
+                _send_chunk(chunk[: cut + 1])
+        if carry.strip():
+            _send_chunk(carry)
+    finally:
+        conn.close()
+    if skipped:
+        logger.warning(
+            "import --http: skipped %d $delete tombstone lines", skipped
+        )
+    return total
+
+
 # -- status (commands/Management.scala:56-160) ------------------------------
 
 

@@ -796,10 +796,19 @@ def cmd_import(args) -> int:
     from predictionio_tpu.data.store import EventStoreError
 
     try:
-        n = commands.import_events(
-            args.appid_or_name, args.input,
-            channel=args.channel, jobs=args.jobs,
-        )
+        if getattr(args, "http", None):
+            if not args.access_key:
+                print("--http requires --access-key", file=sys.stderr)
+                return 1
+            n = commands.import_events_http(
+                args.input, args.http, args.access_key,
+                channel=args.channel,
+            )
+        else:
+            n = commands.import_events(
+                args.appid_or_name, args.input,
+                channel=args.channel, jobs=args.jobs,
+            )
     except (commands.CommandError, EventStoreError) as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -1217,6 +1226,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--warm-cache", action="store_true",
         help="build the columnar segment cache right after the import "
         "so the first train reads mmap'ed column blocks",
+    )
+    im.add_argument(
+        "--http", metavar="URL", default=None,
+        help="import over the wire: POST the file as binary frames to "
+        "URL/batch/events.bin on a live event server instead of writing "
+        "storage directly (requires --access-key)",
+    )
+    im.add_argument(
+        "--access-key", default=None,
+        help="access key for --http mode (the target app's key)",
     )
     im.set_defaults(fn=cmd_import)
 

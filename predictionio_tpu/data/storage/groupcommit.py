@@ -83,6 +83,13 @@ class FsyncCoalescer:
         self._synced = 0  # highest seq known durable
         self._syncing = False
 
+    def backlog(self) -> int:
+        """Appends acked to the page cache but not yet covered by an
+        fsync — the group-commit queue depth this file contributes to
+        the event server's backpressure stats."""
+        with self._cond:
+            return self._seq - self._synced
+
     def note_write(self) -> int:
         """Take a sequence number for an append already flushed to the
         page cache. Call while still holding the file's append lock (the
@@ -184,6 +191,12 @@ class CoalescerMap:
 
     def stop(self) -> None:
         self._stop.set()
+
+    def backlog(self) -> int:
+        """Total undurable appends across every registered log."""
+        with self._lock:
+            committers = list(self._map.values())
+        return sum(c.backlog() for c in committers)
 
     def _interval_loop(self) -> None:
         while not self._stop.wait(self._interval):

@@ -545,6 +545,11 @@ class JSONLEvents(base.Events):
         )
         return ids
 
+    def commit_backlog(self) -> int:
+        """Group-commit queue depth: appends flushed but not yet covered
+        by an fsync (the event server's backpressure/stats probe)."""
+        return self._c.committers.backlog()
+
     def append_jsonl(
         self, blob: bytes, app_id: int, channel_id: int | None = None
     ) -> None:

@@ -728,6 +728,11 @@ class PartitionedEvents(base.Events):
                 committer.wait_durable(seq, active)
         return ids
 
+    def commit_backlog(self) -> int:
+        """Group-commit queue depth across partitions: appends flushed
+        but not yet covered by an fsync (backpressure/stats probe)."""
+        return self._c.committers.backlog()
+
     def append_jsonl(
         self, blob: bytes, app_id: int, channel_id: int | None = None
     ) -> None:

@@ -57,6 +57,22 @@ def _iso(dt: datetime) -> str:
     return dt.isoformat()
 
 
+def dumps(obj: Any) -> bytes:
+    """Typed-codec JSON bytes in one call — the binary frame codec's
+    extras column (data/storage/frame.py) and any other caller that
+    wants the envelope without hand-rolling json.dumps(encode(...))."""
+    import json
+
+    return json.dumps(encode(obj), separators=(",", ":")).encode()
+
+
+def loads(data: bytes | str) -> Any:
+    """Inverse of :func:`dumps`."""
+    import json
+
+    return decode(json.loads(data))
+
+
 def encode(obj: Any) -> Any:
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj

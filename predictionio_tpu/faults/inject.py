@@ -70,6 +70,8 @@ KNOWN_POINTS: dict[str, str] = {
     "colcache.store": "columnar-cache block write+fsync+rename publish",
     "http.accept": "server socket accept (all HTTP servers)",
     "http.read": "request read/parse on an accepted connection",
+    "http.frame": "binary ingest frame read off the request body "
+                  "(/batch/events.bin, data/storage/frame.py)",
     "serve.query": "engine-server per-query scoring entry",
     "serve.batch_dispatch": "micro-batcher batch_predict device dispatch",
     "device.dispatch": "fused ALS training-program dispatch "

@@ -399,41 +399,92 @@ def parse_events_jsonl(data: bytes) -> list:
     from predictionio_tpu.data.event import Event, parse_time
 
     scanned = scan_events(data)
+    buf = scanned.buf
+    # plain-list span indexing: numpy scalar getitem per field per line
+    # costs more than the slice+decode it addresses; tolist() once makes
+    # the hot loop pure-Python-fast (this loop is the speed layer's
+    # burst ceiling — see realtime/tailer._poll_files)
+    offs = scanned.offs.tolist()
+    lens = scanned.lens.tolist()
+    flags = scanned.flags.tolist()
+    # timestamps and property shapes repeat heavily (splice batches
+    # share one receive stamp; events of one kind share a schema):
+    # parse each distinct string once per buffer. Safe for properties
+    # because DataMap copies the top-level dict it is handed.
+    tmemo: dict = {}
+    pmemo: dict = {}
     events = []
     lines: list[bytes] | None = None  # lazily split, only if fallbacks occur
-    for i in range(len(scanned)):
-        flag = int(scanned.flags[i])
+    for i, flag in enumerate(flags):
         if flag & FLAG_EMPTY:
             continue
-        if flag & FLAG_FALLBACK or scanned.offs[i, F_EVENT] < 0 or (
-            scanned.offs[i, F_ENTITY_TYPE] < 0
-            or scanned.offs[i, F_ENTITY_ID] < 0
+        o = offs[i]
+        ln = lens[i]
+        if flag & FLAG_FALLBACK or o[F_EVENT] < 0 or (
+            o[F_ENTITY_TYPE] < 0 or o[F_ENTITY_ID] < 0
         ):
             if lines is None:
                 lines = data.split(b"\n")
             events.append(Event.from_json(lines[i].decode("utf-8")))
             continue
-        props_raw = scanned.field_bytes(i, F_PROPERTIES)
-        tags_raw = scanned.field_bytes(i, F_TAGS)
+        po = o[F_PROPERTIES]
+        props_raw = buf[po : po + ln[F_PROPERTIES]] if po >= 0 else None
+        if props_raw:
+            pobj = pmemo.get(props_raw)
+            if pobj is None:
+                pobj = pmemo[props_raw] = json.loads(
+                    props_raw.decode("utf-8")
+                )
+        else:
+            pobj = {}
+        tgo = o[F_TAGS]
+        tags_raw = buf[tgo : tgo + ln[F_TAGS]] if tgo >= 0 else None
+        teo = o[F_TARGET_ENTITY_TYPE]
+        tio = o[F_TARGET_ENTITY_ID]
+        pro = o[F_PR_ID]
+        ofs = o[F_EVENT]
         kwargs = dict(
-            event=scanned.field_str(i, F_EVENT),
-            entity_type=scanned.field_str(i, F_ENTITY_TYPE),
-            entity_id=scanned.field_str(i, F_ENTITY_ID),
-            target_entity_type=scanned.field_str(i, F_TARGET_ENTITY_TYPE),
-            target_entity_id=scanned.field_str(i, F_TARGET_ENTITY_ID),
-            properties=DataMap(json.loads(props_raw) if props_raw else {}),
-            pr_id=scanned.field_str(i, F_PR_ID),
+            event=buf[ofs : ofs + ln[F_EVENT]].decode("utf-8"),
+            entity_type=buf[
+                o[F_ENTITY_TYPE] : o[F_ENTITY_TYPE] + ln[F_ENTITY_TYPE]
+            ].decode("utf-8"),
+            entity_id=buf[
+                o[F_ENTITY_ID] : o[F_ENTITY_ID] + ln[F_ENTITY_ID]
+            ].decode("utf-8"),
+            target_entity_type=(
+                buf[teo : teo + ln[F_TARGET_ENTITY_TYPE]].decode("utf-8")
+                if teo >= 0 else None
+            ),
+            target_entity_id=(
+                buf[tio : tio + ln[F_TARGET_ENTITY_ID]].decode("utf-8")
+                if tio >= 0 else None
+            ),
+            properties=DataMap(pobj),
+            pr_id=(
+                buf[pro : pro + ln[F_PR_ID]].decode("utf-8")
+                if pro >= 0 else None
+            ),
             tags=tuple(json.loads(tags_raw)) if tags_raw else (),
         )
-        t = scanned.field_str(i, F_EVENT_TIME)
-        if t is not None:
-            kwargs["event_time"] = parse_time(t)
-        ct = scanned.field_str(i, F_CREATION_TIME)
-        if ct is not None:
-            kwargs["creation_time"] = parse_time(ct)
-        eid = scanned.field_str(i, F_EVENT_ID)
-        if eid is not None:
-            kwargs["event_id"] = eid
+        to = o[F_EVENT_TIME]
+        if to >= 0:
+            t = buf[to : to + ln[F_EVENT_TIME]].decode("utf-8")
+            dt = tmemo.get(t)
+            if dt is None:
+                dt = tmemo[t] = parse_time(t)
+            kwargs["event_time"] = dt
+        cto = o[F_CREATION_TIME]
+        if cto >= 0:
+            ct = buf[cto : cto + ln[F_CREATION_TIME]].decode("utf-8")
+            dt = tmemo.get(ct)
+            if dt is None:
+                dt = tmemo[ct] = parse_time(ct)
+            kwargs["creation_time"] = dt
+        eo = o[F_EVENT_ID]
+        if eo >= 0:
+            kwargs["event_id"] = buf[eo : eo + ln[F_EVENT_ID]].decode(
+                "utf-8"
+            )
         events.append(Event(**kwargs))
     return events
 

@@ -655,6 +655,17 @@ def install_event_server_slos(server) -> list[Slo]:
             objective=_env_float("PIO_SLO_GROUP_COMMIT_OBJECTIVE", 0.99),
             description="Batch group-commit windows under the budget",
         ),
+        BoundSlo(
+            "ingest.backpressure",
+            lambda: server._budget.utilization(),
+            bound=_env_float("PIO_SLO_INGEST_INFLIGHT_UTIL", 0.9),
+            objective=_env_float("PIO_SLO_INGEST_INFLIGHT_OBJECTIVE", 0.95),
+            description=(
+                "In-flight ingest byte budget utilization stays under "
+                "the shed threshold (sustained saturation means clients "
+                "are seeing 429s)"
+            ),
+        ),
     ]
     return [register(s) for s in slos]
 

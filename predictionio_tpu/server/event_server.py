@@ -28,17 +28,22 @@ ingestion.
 from __future__ import annotations
 
 import logging
+import os
+import threading
 import time
 from dataclasses import dataclass
+from datetime import datetime, timezone
 from typing import Any
 
 from predictionio_tpu.data.event import (
     Event,
     EventValidationError,
+    format_time,
     parse_time,
     validate,
 )
 from predictionio_tpu.data.storage import AccessKey, Storage, get_storage
+from predictionio_tpu.data.storage import frame as frame_mod
 from predictionio_tpu.obs import device as obs_device
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import slo as obs_slo
@@ -62,6 +67,48 @@ from predictionio_tpu.server.webhooks import (
 logger = logging.getLogger(__name__)
 
 MAX_BATCH_SIZE = 50  # reference EventServer.scala:70
+
+
+def _batch_max_events() -> int:
+    """``PIO_BATCH_MAX_EVENTS`` knob for ``POST /batch/events.json``
+    (default keeps the reference-compatible 50)."""
+    raw = os.environ.get("PIO_BATCH_MAX_EVENTS", "").strip()
+    try:
+        return max(1, int(raw)) if raw else MAX_BATCH_SIZE
+    except ValueError:
+        return MAX_BATCH_SIZE
+
+
+class _InflightBudget:
+    """Bounded in-flight ingest bytes (``PIO_INGEST_MAX_INFLIGHT_MB``).
+
+    Admission control for the batch endpoints: a request acquires its
+    Content-Length before its body is processed (for the binary stream
+    route, before the body is even READ off the socket) and releases it
+    when done. A request that doesn't fit is shed with 429+Retry-After —
+    explicit backpressure instead of an unbounded group-commit queue.
+    An oversized request is still admitted when the budget is idle, so
+    a single body larger than the whole budget stays servable."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max(1, int(max_bytes))
+        self.in_flight = 0
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: int) -> bool:
+        with self._lock:
+            if self.in_flight > 0 and self.in_flight + n > self.max_bytes:
+                return False
+            self.in_flight += n
+            return True
+
+    def release(self, n: int) -> None:
+        with self._lock:
+            self.in_flight = max(0, self.in_flight - n)
+
+    def utilization(self) -> float:
+        with self._lock:
+            return self.in_flight / self.max_bytes
 
 
 @dataclass
@@ -109,7 +156,35 @@ class EventServer:
         self._m_rejected = obs_metrics.counter(
             "pio_ingest_events_total", "Events ingested", result="rejected"
         )
+        # wire-speed binary ingest (/batch/events.bin): per-request
+        # in-flight-bytes budget + the pio_ingest_* backpressure family
+        self.batch_max_events = _batch_max_events()
+        try:
+            inflight_mb = float(
+                os.environ.get("PIO_INGEST_MAX_INFLIGHT_MB", "64") or 64
+            )
+        except ValueError:
+            inflight_mb = 64.0
+        self._budget = _InflightBudget(int(inflight_mb * (1 << 20)))
+        self._g_inflight = obs_metrics.gauge(
+            "pio_ingest_inflight_bytes",
+            "Request body bytes admitted and not yet committed",
+        )
+        self._g_inflight.set_function(lambda: float(self._budget.in_flight))
+        self._g_queue_depth = obs_metrics.gauge(
+            "pio_ingest_queue_depth",
+            "Group-commit appends flushed but not yet fsync-covered",
+        )
+        self._g_queue_depth.set_function(self._queue_depth)
+        self._m_shed = obs_metrics.counter(
+            "pio_ingest_shed_total",
+            "Batch requests shed with 429 by the in-flight-bytes budget",
+        )
+        self._m_frames = obs_metrics.counter(
+            "pio_ingest_frames_total", "Binary ingest frames committed"
+        )
         # default objectives: ingest availability + group-commit latency
+        # + backpressure-budget headroom (registered after _budget exists)
         obs_slo.install_event_server_slos(self)
         self.app = HTTPApp(
             self._router(),
@@ -235,6 +310,118 @@ class EventServer:
                     )
         return results
 
+    # -- wire-speed binary ingest -------------------------------------------
+    def _queue_depth(self) -> float:
+        """Group-commit backlog of the events backend (0.0 when the
+        backend has no coalescer, e.g. sqlite/memory)."""
+        try:
+            fn = getattr(self.storage.get_events(), "commit_backlog", None)
+            return float(fn()) if fn is not None else 0.0
+        except Exception:
+            return 0.0
+
+    def ingest_stats(self) -> dict[str, Any]:
+        """Backpressure block for ``/stats.json``."""
+        return {
+            "inflight_bytes": self._budget.in_flight,
+            "max_inflight_bytes": self._budget.max_bytes,
+            "utilization": round(self._budget.utilization(), 4),
+            "queue_depth": int(self._queue_depth()),
+            "shed_total": int(self._m_shed.value()),
+            "frames_total": int(self._m_frames.value()),
+            "batch_max_events": self.batch_max_events,
+        }
+
+    def _shed(self) -> Response:
+        self._m_shed.inc()
+        return Response(
+            status=429,
+            body={
+                "error": "IngestBackpressure",
+                "message": "in-flight ingest budget exhausted; retry",
+            },
+            headers={"Retry-After": "1"},
+        )
+
+    def _ingest_frames(self, auth: AuthData, stream) -> Response:
+        """Decode + validate + commit binary frames incrementally off the
+        request body. Each frame is all-or-nothing and durably committed
+        (one lock+append+fsync) before the next frame is read; a framing
+        or validation error rejects the REST of the request with 400 but
+        reports how many frames/events were already committed."""
+        allowed = frozenset(auth.events) if auth.events else None
+        events_dao = self.storage.get_events()
+        # the splice-through exit renders storage-format JSONL and skips
+        # the Event-object round trip; input-blocker plugins must see
+        # per-event dicts, so a plugin-loaded server takes the dict path
+        splice = getattr(events_dao, "append_jsonl", None)
+        stamp_iso = format_time(datetime.now(tz=timezone.utc), "us")
+        accepted = 0
+        frames = 0
+        try:
+            for payload in frame_mod.read_frames(stream):
+                t0 = time.perf_counter()
+                batch = frame_mod.decode_frame(payload)
+                if self.plugins:
+                    events, _ = batch.to_events(allowed, stamp_iso)
+                    prepared: list[Event] = []
+                    for e in events:
+                        p = self._prepare_one(auth, e.to_dict(for_api=False))
+                        if not isinstance(p, Event):
+                            _status, payload = p
+                            raise frame_mod.FrameError(
+                                "InvalidEvent",
+                                payload.get("message", "rejected"),
+                            )
+                        prepared.append(p)
+                    if prepared:
+                        events_dao.batch_insert(
+                            prepared, auth.app_id, auth.channel_id
+                        )
+                    accepted += len(prepared)
+                    frames += 1
+                    self._m_accepted.inc(len(prepared))
+                    self._m_frames.inc()
+                    continue
+                if splice is not None:
+                    blob, _ids, _ = batch.render_jsonl(allowed, stamp_iso)
+                    t1 = time.perf_counter()
+                    self._m_validate.observe(t1 - t0)
+                    if blob:
+                        splice(blob, auth.app_id, auth.channel_id)
+                        self._m_group_commit.observe(time.perf_counter() - t1)
+                else:
+                    events, _ids = batch.to_events(allowed, stamp_iso)
+                    t1 = time.perf_counter()
+                    self._m_validate.observe(t1 - t0)
+                    if events:
+                        events_dao.batch_insert(
+                            events, auth.app_id, auth.channel_id
+                        )
+                        self._m_group_commit.observe(time.perf_counter() - t1)
+                accepted += batch.n
+                frames += 1
+                self._m_accepted.inc(batch.n)
+                self._m_frames.inc()
+                if self.stats_enabled:
+                    for ev, et in zip(
+                        batch.column_str(frame_mod.COL_EVENT),
+                        batch.column_str(frame_mod.COL_ENTITY_TYPE),
+                    ):
+                        self.stats.update(auth.app_id, 201, ev, et)
+        except frame_mod.FrameError as e:
+            self._m_rejected.inc()
+            return Response.json(
+                {
+                    "error": e.code,
+                    "message": str(e),
+                    "accepted": accepted,
+                    "frames": frames,
+                },
+                status=400,
+            )
+        return Response.json({"accepted": accepted, "frames": frames})
+
     # -- routes ------------------------------------------------------------
     def _router(self) -> Router:
         router = Router()
@@ -314,13 +501,54 @@ class EventServer:
             body = request.json()
             if not isinstance(body, list):
                 return Response.error("request body must be a JSON array", 400)
-            if len(body) > MAX_BATCH_SIZE:
-                return Response.error(
-                    f"Batch request must have less than or equal to "
-                    f"{MAX_BATCH_SIZE} events",
-                    400,
+            if len(body) > server.batch_max_events:
+                return Response.json(
+                    {
+                        "error": "BatchTooLarge",
+                        "message": (
+                            f"Batch request must have less than or equal "
+                            f"to {server.batch_max_events} events "
+                            f"(PIO_BATCH_MAX_EVENTS)"
+                        ),
+                    },
+                    status=413,
                 )
-            return Response.json(server._ingest_batch(auth, body))
+            n_bytes = len(request.body)
+            if not server._budget.try_acquire(n_bytes):
+                return server._shed()
+            try:
+                return Response.json(server._ingest_batch(auth, body))
+            finally:
+                server._budget.release(n_bytes)
+
+        def batch_events_bin(request: Request) -> Response:
+            """Wire-speed framed binary batch ingest: frames decode
+            straight into the columnar group-commit path, streamed off
+            the socket (data/storage/frame.py). Backpressure: the
+            request's Content-Length must fit the in-flight budget or it
+            is shed with 429 BEFORE the body is read."""
+            auth = server._auth(request)
+            if isinstance(auth, Response):
+                return auth
+            stream = request.body_stream
+            total = stream.remaining if stream is not None else 0
+            if total <= 0:
+                return Response.json(
+                    {
+                        "error": "EmptyBody",
+                        "message": "framed binary body required "
+                                   "(Content-Length > 0)",
+                    },
+                    status=400,
+                )
+            if not server._budget.try_acquire(total):
+                return server._shed()
+            try:
+                return server._ingest_frames(auth, stream)
+            finally:
+                server._budget.release(total)
+
+        router.add_stream("POST", "/batch/events.bin", batch_events_bin)
 
         @router.route("GET", "/stats.json")
         def stats(request: Request) -> Response:
@@ -335,6 +563,7 @@ class EventServer:
             # additive: existing consumers keep their fields untouched
             payload["obs"] = obs_metrics.stats_block()
             payload["device"] = obs_device.device_block()
+            payload["ingest"] = server.ingest_stats()
             return Response.json(payload)
 
         @router.route("GET", "/plugins.json")

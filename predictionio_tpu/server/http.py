@@ -60,6 +60,10 @@ class Request:
     headers: dict[str, str]
     body: bytes
     path_params: dict[str, str] = field(default_factory=dict)
+    # set only for stream routes (Router.add_stream): an incremental
+    # body reader (_BodyStream) handed to the handler BEFORE the body is
+    # read off the socket; ``body`` stays b"" on those requests
+    body_stream: "Any | None" = None
 
     def json(self) -> Any:
         if not self.body:
@@ -174,6 +178,11 @@ class Router:
 
     def __init__(self, cors: bool = False) -> None:
         self._routes: list[tuple[str, re.Pattern, Handler]] = []
+        # stream routes dispatch BEFORE the body is read: the handler
+        # gets request.body_stream and consumes the body incrementally
+        # (the wire-speed binary ingest path commits frame by frame
+        # instead of materializing the whole body)
+        self._stream_routes: list[tuple[str, re.Pattern, Handler]] = []
         self.cors = cors
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
@@ -191,6 +200,28 @@ class Router:
             return fn
 
         return deco
+
+    def add_stream(self, method: str, pattern: str, handler: Handler) -> None:
+        """Register a streaming-body route (same pattern syntax as
+        :meth:`add`). Matched requests dispatch with the body still on
+        the socket: ``request.body_stream.read(n)`` pulls it
+        incrementally, ``request.body`` is empty."""
+        regex = re.sub(r"<([a-zA-Z_]+):path>", r"(?P<\1>.+)", pattern)
+        regex = re.sub(r"(?<!\(\?P)<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", regex)
+        self._stream_routes.append(
+            (method.upper(), re.compile(f"^{regex}$"), handler)
+        )
+
+    def match_stream(
+        self, method: str, path: str
+    ) -> tuple[Handler, dict[str, str]] | None:
+        for m, regex, handler in self._stream_routes:
+            if m != method:
+                continue
+            match = regex.match(path)
+            if match:
+                return handler, match.groupdict()
+        return None
 
     def dispatch(self, request: Request) -> Response:
         response = self._dispatch(request)
@@ -388,6 +419,35 @@ class _ConnReader:
         return bytes(out)
 
 
+class _BodyStream:
+    """Incremental request-body reader handed to stream routes
+    (``Router.add_stream``): bounded by Content-Length, so it can never
+    read into the next pipelined request. An ``Expect: 100-continue`` is
+    answered lazily on the FIRST read — a handler that sheds the request
+    (backpressure 429) before touching the body never invites the client
+    to send it."""
+
+    __slots__ = ("_reader", "_sock", "remaining", "_continue_pending")
+
+    def __init__(self, reader, sock, length: int, continue_pending: bool):
+        self._reader = reader
+        self._sock = sock
+        self.remaining = length
+        self._continue_pending = continue_pending
+
+    def read(self, n: int) -> bytes:
+        if n <= 0 or self.remaining <= 0:
+            return b""
+        if self._continue_pending:
+            self._continue_pending = False
+            self._sock.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+        data = self._reader.read(min(n, self.remaining))
+        self.remaining -= len(data)
+        if not data:
+            self.remaining = 0  # client EOF mid-body
+        return data
+
+
 class _TimerHandle:
     """One timer-wheel entry; ``cancel()`` is lazy (the loop skips
     cancelled entries when they surface at the top of the heap)."""
@@ -555,8 +615,9 @@ class _Connection:
             # (framing bytes parsed as the next request)
             self._send_simple(501, "Transfer-Encoding unsupported")
             return
-        if headers.get("expect", "").lower() == "100-continue":
-            self.sock.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+        expect_continue = (
+            headers.get("expect", "").lower() == "100-continue"
+        )
         try:
             length = int(headers.get("content-length") or 0)
         except ValueError:
@@ -565,14 +626,27 @@ class _Connection:
         if length < 0:
             self._send_simple(400, "Bad Request")
             return
-        try:
-            body = reader.read(length) if length > 0 else b""
-        except OSError:  # read timeout mid-body
-            return
-        if length > 0 and len(body) < length:
-            self.close_connection = True
-            return  # client died mid-body
         parsed = urlparse(target)
+        stream_match = self.app.router.match_stream(method, parsed.path)
+        body_stream: _BodyStream | None = None
+        if stream_match is not None:
+            # stream route: dispatch BEFORE the body read — the handler
+            # pulls bytes incrementally (100-continue deferred to its
+            # first read, see _BodyStream)
+            body = b""
+            body_stream = _BodyStream(
+                reader, self.sock, length, expect_continue
+            )
+        else:
+            if expect_continue:
+                self.sock.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+            try:
+                body = reader.read(length) if length > 0 else b""
+            except OSError:  # read timeout mid-body
+                return
+            if length > 0 and len(body) < length:
+                self.close_connection = True
+                return  # client died mid-body
         q = {
             k: v[0]
             for k, v in parse_qs(
@@ -585,6 +659,7 @@ class _Connection:
             query=q,
             headers=headers,
             body=body,
+            body_stream=body_stream,
         )
         tr = None
         t_parsed = 0.0
@@ -602,9 +677,23 @@ class _Connection:
             tr.add_span("http.read_parse", t_start, t_parsed)
             obs_trace.set_current_trace(tr)
         try:
-            response = app.router.dispatch(request)
+            if stream_match is not None:
+                handler, request.path_params = stream_match
+                response = handler(request)
+            else:
+                response = app.router.dispatch(request)
         except json.JSONDecodeError:
             response = Response.error("invalid JSON body", 400)
+        except OSError:
+            if stream_match is not None:
+                # read timeout / client reset while the handler was
+                # consuming the body stream: no usable response
+                self.close_connection = True
+                return
+            logger.exception(
+                "unhandled error on %s %s", method, parsed.path
+            )
+            response = Response.error("internal error", 500)
         except Exception:
             logger.exception(
                 "unhandled error on %s %s", method, parsed.path
@@ -613,6 +702,20 @@ class _Connection:
         finally:
             if tr is not None:
                 obs_trace.set_current_trace(None)
+        if body_stream is not None and body_stream.remaining > 0:
+            # the handler left body bytes on the socket (reject/shed):
+            # drain small remainders to preserve keep-alive, give up on
+            # large ones (the response still goes out; the close tells
+            # the client to stop sending)
+            if body_stream.remaining <= 262144 and not body_stream._continue_pending:
+                try:
+                    while body_stream.remaining > 0:
+                        if not body_stream.read(65536):
+                            break
+                except OSError:
+                    self.close_connection = True
+            else:
+                self.close_connection = True
         if tr is not None:
             # bookkeeping runs BEFORE the response bytes leave:
             # once the client unblocks it starts contending for

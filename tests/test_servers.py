@@ -126,12 +126,35 @@ class TestEventServer:
         base, key = event_server["base"], event_server["key"]
         batch = [EVENT] * 51
         status, body = http("POST", f"{base}/batch/events.json?accessKey={key}", batch)
-        assert status == 400
+        assert status == 413
+        assert body["error"] == "BatchTooLarge"
+        assert "PIO_BATCH_MAX_EVENTS" in body["message"]
         batch = [EVENT, dict(EVENT, event="")]  # second invalid
         status, body = http("POST", f"{base}/batch/events.json?accessKey={key}", batch)
         assert status == 200
         assert body[0]["status"] == 201
         assert body[1]["status"] == 400
+
+    def test_batch_limit_knob(self, storage, monkeypatch):
+        from predictionio_tpu.server.event_server import EventServer
+
+        monkeypatch.setenv("PIO_BATCH_MAX_EVENTS", "3")
+        info = commands.app_new("KnobApp", storage=storage)
+        server = EventServer(storage=storage, host="127.0.0.1", port=0)
+        port = server.start()
+        try:
+            base, key = f"http://127.0.0.1:{port}", info["access_key"]
+            status, _ = http(
+                "POST", f"{base}/batch/events.json?accessKey={key}", [EVENT] * 3
+            )
+            assert status == 200
+            status, body = http(
+                "POST", f"{base}/batch/events.json?accessKey={key}", [EVENT] * 4
+            )
+            assert status == 413
+            assert body["error"] == "BatchTooLarge"
+        finally:
+            server.stop()
 
     def test_channel_auth(self, event_server):
         base, key = event_server["base"], event_server["key"]
