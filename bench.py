@@ -2116,11 +2116,12 @@ def bench_obs(
 ) -> None:
     """The observability tax, measured: instrumented-vs-disabled serving
     qps over the same warm keep-alive connection (gate: <2% median
-    delta), histogram-update ns/op, and the server-side request
-    histogram's p50/p99 cross-checked against the client's own
-    wall-clock percentiles for the SAME requests. Runs a tiny trained
-    engine in-process on a throwaway memory store so the section works
-    on any attachment."""
+    delta), histogram-update ns/op, the server-side request histogram's
+    p50/p99 cross-checked against the client's own wall-clock
+    percentiles for the SAME requests, and the history sampler's
+    serving-sequence overhead under a 500x-production tick rate (gate:
+    <1%). Runs a tiny trained engine in-process on a throwaway memory
+    store so the section works on any attachment."""
     import http.client
     import statistics
 
@@ -2386,6 +2387,46 @@ def bench_obs(
             publish_us = (time.perf_counter() - t0) / pub_n * 1e6
         segment_nominal_s = 1.0
         publish_pct = publish_us / (segment_nominal_s * 1e6) * 100.0
+
+        # history subsection: the flight-recorder sampler walks the
+        # whole registry on a tick, never a request path — so the gate
+        # is the serving sequence A/B'd against a sampler ticking 500x
+        # faster than production (10 ms vs 5 s), judged per request
+        # against the disabled-arm median. Production amortizes one
+        # sample over ~5 s of requests; even the torture tick must stay
+        # under 1%.
+        from predictionio_tpu.obs import history as obs_history
+
+        obs_metrics.set_enabled(True)
+        hist_sampler = obs_history.HistorySampler(step_s=0.01, slots=120)
+        hist_sampler.sample()  # first walk allocates every series ring
+        samp_n = 200
+        t0 = time.perf_counter()
+        for _ in range(samp_n):
+            hist_sampler.sample()
+        sample_us = (time.perf_counter() - t0) / samp_n * 1e6
+        n_series = len(hist_sampler._series)
+        t0 = time.perf_counter()
+        for _ in range(50):
+            hist_sampler.snapshot()
+        snapshot_us = (time.perf_counter() - t0) / 50 * 1e6
+
+        seq_base = min(obs_sequence_us(seq_n) for _ in range(3))
+        h_stop = threading.Event()
+
+        def _torture_tick() -> None:
+            while not h_stop.wait(0.01):
+                hist_sampler.sample()
+
+        h_thread = threading.Thread(target=_torture_tick, daemon=True)
+        h_thread.start()
+        try:
+            seq_hist = min(obs_sequence_us(seq_n) for _ in range(3))
+        finally:
+            h_stop.set()
+            h_thread.join(timeout=5)
+        hist_overhead_us = max(seq_hist - seq_base, 0.0)
+        hist_overhead_pct = hist_overhead_us / (off_med * 1e6) * 100.0
     finally:
         obs_metrics.set_enabled(prior)
         if server is not None:
@@ -2426,6 +2467,16 @@ def bench_obs(
             "progress_publish_us": round(publish_us, 1),
             "progress_publish_pct_of_segment": round(publish_pct, 3),
             "progress_ok": publish_pct < 1.0,
+        },
+        "history": {
+            "series_sampled": n_series,
+            "sample_us": round(sample_us, 1),
+            "snapshot_us": round(snapshot_us, 1),
+            "seq_us_no_sampler": round(seq_base, 2),
+            "seq_us_torture_tick": round(seq_hist, 2),
+            "overhead_us_per_request": round(hist_overhead_us, 2),
+            "overhead_pct": round(hist_overhead_pct, 3),
+            "history_ok": hist_overhead_pct < 1.0,
         },
     }
 
@@ -2703,6 +2754,13 @@ def _compact_summary(result: dict) -> dict:
                           "progress_ok")
                 if k in dv
             }
+        hs = ob.get("history")
+        if isinstance(hs, dict):
+            s["obs"]["history"] = {
+                k: hs[k]
+                for k in ("sample_us", "overhead_pct", "history_ok")
+                if k in hs
+            }
     rb = result.get("robustness")
     if isinstance(rb, dict) and "error" not in rb:
         rb_out: dict = {}
@@ -2742,6 +2800,7 @@ def _compact_summary(result: dict) -> dict:
             "seconds_behind": ps.get("realtime", {}).get("seconds_behind"),
             "chaos_fired": sum(ps.get("chaos", {}).get("fired", {}).values()),
             "slo_states": ps.get("slo", {}).get("states"),
+            "incidents": ps.get("incidents", {}).get("count"),
             "ok": ps.get("ok"),
         }
     errors = sorted(
@@ -2880,7 +2939,14 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
     the whole run, and the gate asserts no objective ends VIOLATED, the
     measured p99 is within the declared budget, the replay audit shows
     zero acked-event loss, and ingest-to-servable freshness and
-    ``seconds_behind`` stayed bounded."""
+    ``seconds_behind`` stayed bounded.
+
+    The run is also a flight-recorder drill: a zero-tolerance chaos
+    probe over the injected-fault counts trips to violated the moment
+    the armed plan first fires, the SLO->incident hook dumps a bundle
+    under the bench tmp run-dir, and the gate additionally asserts the
+    bundle exists and holds metrics history, the probe's alert record,
+    and at least one ``sloViolated`` trace."""
     from predictionio_tpu import faults
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.core.workflow import run_train
@@ -2907,6 +2973,13 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
     # jsonl event log so the storage.fsync fault point is real; memory
     # metadata/models keep setup cheap
     tmp = tempfile.mkdtemp(dir=os.environ["BENCH_TMPDIR"])
+    # flight recorder lands under the bench tmp tree; the SLO->incident
+    # delay is stretched so requests tagged sloViolated accumulate in
+    # the trace ring before the bundle freezes it
+    prior_run_dir = os.environ.get("PIO_RUN_DIR")
+    os.environ["PIO_RUN_DIR"] = os.path.join(tmp, "run")
+    os.environ.setdefault("PIO_INCIDENT_SLO_DELAY_S", "2.0")
+    os.environ.setdefault("PIO_HISTORY_STEP_S", "1" if smoke else "5")
     storage = Storage(env={
         "PIO_STORAGE_SOURCES_DB_TYPE": "memory",
         "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
@@ -3012,8 +3085,26 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             "storage.fsync:p=0.05,seed=7:sleep=10;"
             "foldin.fold:nth=3:raise"
         )
+        chaos_points = (
+            "serve.batch_dispatch", "storage.fsync", "foldin.fold"
+        )
         os.environ["PIO_FAULTS"] = chaos
         plan = faults.install(faults.parse_plan(chaos))
+
+        # chaos probe: a zero-tolerance objective over the injected-fault
+        # counts. The first fault the armed plan fires trips it to
+        # violated on the next evaluator tick, which drives the
+        # SLO->incident hook — the scenario's flight-recorder drill. It
+        # is a tripwire, not a budget, so it is unregistered before the
+        # end-of-run recovery gate below.
+        from predictionio_tpu.obs import history as obs_history
+        from predictionio_tpu.obs import incident as obs_incident
+
+        obs_slo.register(obs_slo.ZeroCounterSlo(
+            "stack.chaos_probe",
+            lambda: float(sum(plan.fire_count(p) for p in chaos_points)),
+        ))
+        obs_incident.install_crash_hooks()  # idempotent re-wire
 
         bodies = [
             json.dumps({"user": f"u{u}", "num": int(n)})
@@ -3030,6 +3121,7 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             while not stop_eval.is_set():
                 try:
                     obs_slo.REGISTRY.evaluate_all()
+                    obs_history.maybe_sample()  # rings for the bundle
                 except Exception:
                     pass
                 stop_eval.wait(eval_interval)
@@ -3124,11 +3216,54 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             time.sleep(0.2)
 
         fire_counts = {
-            point: plan.fire_count(point)
-            for point in (
-                "serve.batch_dispatch", "storage.fsync", "foldin.fold"
-            )
+            point: plan.fire_count(point) for point in chaos_points
         }
+
+        # flight-recorder drill: the first chaos fire tripped the probe,
+        # so a bundle must have been dumped. Wait out the deferred
+        # capture, then open it and check it holds the three things an
+        # on-call would reach for: the metrics history rings, the
+        # probe's violated-alert record, and sloViolated trace bodies.
+        bundles: list = []
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            bundles = [
+                b for b in obs_incident.list_incidents()
+                if str(b.get("reason", "")).startswith(
+                    "slo-stack.chaos_probe"
+                )
+            ]
+            if bundles:
+                break
+            time.sleep(0.25)
+        incident_block: dict = {
+            "count": len(obs_incident.list_incidents()),
+            "dir": str(obs_incident.incidents_dir()),
+            "validated": False,
+        }
+        if bundles:
+            bundle = obs_incident.load_incident(bundles[0]["name"])
+            probe_alerts = [
+                a for a in bundle.get("slo.json", {}).get("alerts", [])
+                if a.get("slo") == "stack.chaos_probe"
+                and a.get("to") == "violated"
+            ]
+            hist_series = bundle.get("history.json", {}).get("series", {})
+            slo_traces = bundle.get("traces.json", {}).get("sloViolated", [])
+            incident_block.update(
+                bundle=bundles[0]["name"],
+                files=bundles[0]["files"],
+                history_series=len(hist_series),
+                probe_alerts=len(probe_alerts),
+                slo_violated_traces=len(slo_traces),
+                validated=bool(hist_series)
+                and bool(probe_alerts)
+                and bool(slo_traces),
+            )
+
+        # the tripwire served its purpose; the recovery gate judges the
+        # real objectives only
+        obs_slo.REGISTRY.unregister("stack.chaos_probe")
         final_doc = obs_slo.REGISTRY.evaluate_all()
         slo_states = {d["name"]: d["state"] for d in final_doc["slos"]}
         alerts = final_doc["alerts"]
@@ -3191,6 +3326,7 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             "reload": reload_resp,
             "chaos": {"plan": chaos, "fired": fire_counts},
             "slo": {"states": slo_states, "alerts": alerts},
+            "incidents": incident_block,
             "ok": False,
         }
         result["production_stack"] = block
@@ -3217,6 +3353,12 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
         )
         assert foldin_epoch_peak > 0, "speed layer never patched the model"
         assert sum(fire_counts.values()) > 0, "chaos plan never fired"
+        assert incident_block.get("bundle"), (
+            "armed chaos tripped no incident bundle"
+        )
+        assert incident_block["validated"], (
+            f"incident bundle incomplete: {incident_block}"
+        )
         block["ok"] = True
     finally:
         faults.clear()
@@ -3224,6 +3366,10 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             os.environ.pop("PIO_FAULTS", None)
         else:
             os.environ["PIO_FAULTS"] = prior_faults
+        if prior_run_dir is None:
+            os.environ.pop("PIO_RUN_DIR", None)
+        else:
+            os.environ["PIO_RUN_DIR"] = prior_run_dir
         if layer is not None:
             layer.stop()
         for s in servers:
@@ -3535,6 +3681,46 @@ def production_stack_main(smoke: bool) -> None:
     _sys.exit(0 if ok else 1)
 
 
+def obs_main() -> None:
+    """``bench.py obs``: the observability-tax section on its own — the
+    serving A/B, the instrumented-sequence gate, the device tracker
+    gates, and the history-sampler torture-tick gate. Prints the
+    full-detail line plus the compact summary line; exits non-zero
+    unless every ``*_ok`` gate passed."""
+    import atexit
+    import shutil
+    import sys as _sys
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from predictionio_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+    tmpdir = tempfile.mkdtemp(prefix="pio_bench_obs_")
+    atexit.register(shutil.rmtree, tmpdir, ignore_errors=True)
+    os.environ["BENCH_TMPDIR"] = tmpdir
+    result: dict = {
+        "metric": "bench_obs", "value": None, "unit": "s", "device": "cpu",
+    }
+    t0 = time.perf_counter()
+    try:
+        bench_obs(result, trials=3, per_trial=250)
+    except Exception as e:
+        result["obs"] = {"error": f"{type(e).__name__}: {e}"}
+    result["value"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(result))
+    print(json.dumps(_compact_summary(result)))
+    ob = result.get("obs", {})
+    ok = (
+        "error" not in ob
+        and ob.get("overhead_ok") is True
+        and ob.get("percentiles_ok") is True
+        and ob.get("device", {}).get("tracker_ok") is True
+        and ob.get("device", {}).get("progress_ok") is True
+        and ob.get("history", {}).get("history_ok") is True
+    )
+    _sys.exit(0 if ok else 1)
+
+
 def smoke_main() -> None:
     """--smoke: a seconds-scale CI probe. Forces CPU (no accelerator
     probe), runs the storage section at a tiny event count plus a tiny
@@ -3625,6 +3811,9 @@ def main() -> None:
         return
     if "ingest" in sys.argv:
         ingest_main(smoke="--smoke" in sys.argv)
+        return
+    if "obs" in sys.argv:
+        obs_main()
         return
     if "--smoke" in sys.argv:
         smoke_main()

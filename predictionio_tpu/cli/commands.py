@@ -526,7 +526,10 @@ def import_events_http(
     keep-alive connection. 429 ``IngestBackpressure`` answers are
     retried after ``Retry-After``; connection drops reconnect and
     resend (exported lines carry event ids, so a resend that overlaps a
-    partially committed request replays idempotently)."""
+    partially committed request replays idempotently). Every request
+    carries one ``X-PIO-Trace`` id minted for the import run, so the
+    server-side trace ring stitches the whole bulk ingest into one
+    client-correlatable trace family (``GET /traces.json``)."""
     import http.client as _hc
     import time as _time
     from urllib.parse import quote, urlsplit
@@ -543,7 +546,12 @@ def import_events_http(
     path = "/batch/events.bin?accessKey=" + quote(access_key)
     if channel:
         path += "&channel=" + quote(channel)
-    headers = {"Content-Type": "application/octet-stream"}
+    from predictionio_tpu.obs import trace as obs_trace
+
+    headers = {
+        "Content-Type": "application/octet-stream",
+        obs_trace.TRACE_HEADER: obs_trace.new_trace_id(),
+    }
 
     conn = _hc.HTTPConnection(host, port, timeout=60)
     total = 0

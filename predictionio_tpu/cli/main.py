@@ -18,6 +18,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from predictionio_tpu import __version__
 
@@ -181,8 +182,10 @@ def _fetch_slo_docs() -> dict[str, dict]:
 def _slo_lines() -> list[str]:
     """Human SLO lines for ``pio status``: one per objective, e.g.
     ``slo[engine] engine.latency: OK (burn 0.2/0.1)``; violated and
-    burning objectives lead with their state upper-cased."""
+    burning objectives lead with their state upper-cased. Follows with
+    the newest state transitions off each daemon's alert ring."""
     lines: list[str] = []
+    alerts: list[tuple[float, str]] = []
     for service, doc in _fetch_slo_docs().items():
         for s in doc.get("slos", []):
             state = str(s.get("state", "?"))
@@ -196,6 +199,18 @@ def _slo_lines() -> list[str]:
             lines.append(
                 f"slo[{service}] {s.get('name')}: {mark}{burn}{cur}"
             )
+        for a in doc.get("alerts", []):
+            t = float(a.get("t") or 0.0)
+            alerts.append(
+                (
+                    t,
+                    f"alert[{service}] {a.get('slo')}: "
+                    f"{a.get('from')} -> {a.get('to')} "
+                    f"(burn {a.get('burn_fast')}/{a.get('burn_slow')}, "
+                    f"t={a.get('t')})",
+                )
+            )
+    lines.extend(line for _, line in sorted(alerts)[-5:])
     return lines
 
 
@@ -267,6 +282,194 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_incidents(args) -> int:
+    """``pio incidents list|show|prune``: inspect the flight recorder's
+    bundle directory (``$PIO_RUN_DIR/incidents``). ``show NAME`` prints
+    a bundle summary (or one file verbatim with ``--file``); ``prune``
+    keeps the newest ``--keep`` bundles."""
+    from predictionio_tpu.obs import incident as obs_incident
+
+    action = getattr(args, "incidents_command", None) or "list"
+    if action == "list":
+        bundles = obs_incident.list_incidents()
+        if getattr(args, "json", False):
+            print(json.dumps(bundles, separators=(",", ":")))
+            return 0
+        if not bundles:
+            print(f"no incident bundles under {obs_incident.incidents_dir()}")
+            return 0
+        for b in bundles:
+            print(
+                f"{b['name']}  reason={b.get('reason')}  "
+                f"files={len(b.get('files', []))}  "
+                f"{b.get('bytes', 0):,} bytes"
+            )
+        return 0
+    if action == "show":
+        try:
+            bundle = obs_incident.load_incident(args.name)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        if getattr(args, "file", None):
+            doc = bundle.get(args.file)
+            if doc is None:
+                print(
+                    f"no file {args.file!r} in bundle "
+                    f"(have: {', '.join(sorted(bundle))})",
+                    file=sys.stderr,
+                )
+                return 1
+            print(doc if isinstance(doc, str) else json.dumps(doc, indent=2))
+            return 0
+        meta = bundle.get("meta.json", {})
+        slo_doc = bundle.get("slo.json", {})
+        traces = bundle.get("traces.json", {})
+        hist = bundle.get("history.json", {})
+        summary = {
+            "name": args.name,
+            "reason": meta.get("reason"),
+            "iso": meta.get("iso"),
+            "context": meta.get("context"),
+            "slo_states": {
+                s.get("name"): s.get("state")
+                for s in slo_doc.get("slos", [])
+            },
+            "alerts": len(slo_doc.get("alerts", [])),
+            "traces": len(traces.get("slowest", [])),
+            "traces_slo_violated": len(traces.get("sloViolated", [])),
+            "history_series": len(hist.get("series", {})),
+            "files": sorted(bundle),
+        }
+        print(json.dumps(summary, indent=2))
+        return 0
+    if action == "prune":
+        removed = obs_incident.prune(keep=args.keep)
+        print(f"pruned {len(removed)} bundle(s)"
+              + (f": {', '.join(removed)}" if removed else ""))
+        return 0
+    print(f"unknown incidents action {action!r}", file=sys.stderr)
+    return 2
+
+
+def _top_targets(urls: list[str] | None) -> list[tuple[str, str]]:
+    """(name, base_url) pairs ``pio top`` polls: explicit ``--url``
+    values, else every live daemon (pid file + default port)."""
+    if urls:
+        return [(u.split("//")[-1].rstrip("/"), u.rstrip("/")) for u in urls]
+    from predictionio_tpu.cli import daemon
+
+    out = []
+    for name in daemon.known_services():
+        if daemon.read_pid(name) is None:
+            continue
+        port = daemon.DEFAULT_PORTS.get(name, 0)
+        out.append((name, f"http://127.0.0.1:{port}"))
+    return out
+
+
+def _top_row(name: str, base: str) -> dict:
+    """One daemon's live numbers, derived from its history rings: qps
+    from the newest ``pio_http_requests_total`` delta, p99 from the
+    newest request-latency quantile sample, ``seconds_behind`` and the
+    worst fast-window burn rate from their gauge series."""
+    import urllib.request
+
+    def fetch(path: str) -> dict:
+        with urllib.request.urlopen(base + path, timeout=2.0) as r:
+            return json.loads(r.read())
+
+    row: dict = {"service": name, "url": base}
+    try:
+        hist = fetch("/history.json")
+    except Exception as e:
+        row["error"] = f"{type(e).__name__}"
+        return row
+    step = float(hist.get("step_s") or 5.0)
+    series = hist.get("series", {})
+
+    def latest(key_prefix: str, suffix: str = "") -> float | None:
+        vals = [
+            doc["points"][-1][1]
+            for key, doc in series.items()
+            if key.startswith(key_prefix) and key.endswith(suffix)
+            and doc.get("points")
+        ]
+        return max(vals) if vals else None
+
+    req_delta = sum(
+        doc["points"][-1][1]
+        for key, doc in series.items()
+        if key.startswith("pio_http_requests_total") and doc.get("points")
+    )
+    row["qps"] = round(req_delta / step, 2)
+    p99 = latest("pio_http_request_seconds", ":p99")
+    if p99 is not None:
+        row["p99_ms"] = round(p99 * 1e3, 3)
+    behind = latest("pio_realtime_seconds_behind")
+    if behind is not None:
+        row["seconds_behind"] = round(behind, 3)
+    burn = latest("pio_slo_burn_rate")
+    if burn is not None:
+        row["burn"] = round(burn, 2)
+    try:
+        slo_doc = fetch("/slo.json")
+        states = [str(s.get("state")) for s in slo_doc.get("slos", [])]
+        row["slo"] = {
+            st: states.count(st)
+            for st in ("ok", "burning", "violated")
+            if states.count(st)
+        }
+        row["alerts"] = len(slo_doc.get("alerts", []))
+    except Exception:
+        pass
+    return row
+
+
+def cmd_top(args) -> int:
+    """``pio top [--once] [--interval S] [--url BASE ...]``: live
+    terminal view across daemons — qps, p99, seconds_behind, burn rates,
+    all read from each server's ``/history.json`` rings (no server-side
+    aggregation; the CLI only diffs what the rings already hold)."""
+    interval = max(float(getattr(args, "interval", 2.0)), 0.2)
+    once = bool(getattr(args, "once", False))
+    while True:
+        targets = _top_targets(getattr(args, "url", None))
+        rows = [_top_row(name, base) for name, base in targets]
+        if not once:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        stamp = time.strftime("%H:%M:%S")
+        print(f"pio top — {stamp} — {len(rows)} service(s)")
+        header = (
+            f"{'SERVICE':<14} {'QPS':>9} {'P99_MS':>9} {'BEHIND_S':>9} "
+            f"{'BURN':>7} {'SLO':<22} {'ALERTS':>6}"
+        )
+        print(header)
+        for row in rows:
+            if "error" in row:
+                print(f"{row['service']:<14} unreachable ({row['error']})")
+                continue
+            slo_str = (
+                ",".join(f"{k}:{v}" for k, v in row.get("slo", {}).items())
+                or "-"
+            )
+            print(
+                f"{row['service']:<14} {row.get('qps', 0):>9} "
+                f"{row.get('p99_ms', '-'):>9} "
+                f"{row.get('seconds_behind', '-'):>9} "
+                f"{row.get('burn', '-'):>7} {slo_str:<22} "
+                f"{row.get('alerts', 0):>6}"
+            )
+        if not rows:
+            print("no live daemons (and no --url given)")
+        if once:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def _status_json() -> int:
     """``pio status --json``: one compact JSON line merging ``/metrics``
     + ``/stats.json`` from every running daemon (live pid files), in
@@ -309,6 +512,25 @@ def _status_json() -> int:
                 pass
         services[name] = entry
     summary: dict = {"services": services}
+    # the SLO alert ring across services, oldest->newest, each record
+    # tagged with the daemon it came from (satellite: alerts were
+    # counted but not inspectable without scraping /slo.json)
+    alerts = [
+        {"service": name, **a}
+        for name, entry in services.items()
+        for a in (entry.get("slo") or {}).get("alerts", [])
+    ]
+    alerts.sort(key=lambda a: float(a.get("t") or 0.0))
+    summary["alerts"] = alerts[-10:]
+    # incident bundles on this host (flight-recorder output)
+    from predictionio_tpu.obs import incident as obs_incident
+
+    bundles = obs_incident.list_incidents()
+    summary["incidents"] = {
+        "count": len(bundles),
+        "latest": bundles[0]["name"] if bundles else None,
+        "dir": str(obs_incident.incidents_dir()),
+    }
     # live checkpointed training on this host, if any (the per-service
     # device blocks already ride in services.*.stats.device)
     progress = _training_progress()
@@ -1011,6 +1233,42 @@ def build_parser() -> argparse.ArgumentParser:
         "dir under $PIO_RUN_DIR/profiles)",
     )
     pr.set_defaults(fn=cmd_profile)
+
+    inc = sub.add_parser("incidents")
+    incsub = inc.add_subparsers(dest="incidents_command")
+    incl = incsub.add_parser("list")
+    incl.add_argument(
+        "--json", action="store_true",
+        help="machine-readable bundle listing",
+    )
+    incs = incsub.add_parser("show")
+    incs.add_argument("name", help="bundle directory name (see list)")
+    incs.add_argument(
+        "--file",
+        help="print one bundle file verbatim (e.g. slo.json, traces.json)",
+    )
+    incp = incsub.add_parser("prune")
+    incp.add_argument(
+        "--keep", type=int, default=None,
+        help="bundles to retain (default $PIO_INCIDENT_KEEP or 20)",
+    )
+    inc.set_defaults(fn=cmd_incidents)
+
+    tp = sub.add_parser("top")
+    tp.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (scripting/tests)",
+    )
+    tp.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh interval in seconds (default 2)",
+    )
+    tp.add_argument(
+        "--url", action="append",
+        help="poll this base URL instead of discovering live daemons "
+        "(repeatable, e.g. http://127.0.0.1:8000)",
+    )
+    tp.set_defaults(fn=cmd_top)
 
     b = sub.add_parser("build")
     b.add_argument("--engine-factory")

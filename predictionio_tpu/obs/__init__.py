@@ -29,6 +29,13 @@ tracing-timeline argument of the TensorFlow system paper 1605.08695):
   latency, observed at the epoch-fenced patch/reload commit
   (``pio_serving_freshness_seconds``; ``freshness`` block on
   ``/stats.json``).
+- :mod:`predictionio_tpu.obs.history` — bounded ring-buffer time series
+  over the metrics registry (counters as per-step deltas, gauges and
+  histogram quantiles as samples), sampled on the SLO ticker's cadence
+  (``GET /history.json``; dashboard sparklines; ``pio top``).
+- :mod:`predictionio_tpu.obs.incident` — the flight recorder: atomic
+  incident bundles under ``$PIO_RUN_DIR/incidents/`` on SLO violation,
+  unhandled exception, or ``POST /incident`` (``pio incidents``).
 
 Instrumentation is ALWAYS-ON and cheap (<2% serving qps, gated by the
 bench ``obs`` section); ``PIO_OBS=0`` turns every instrument into a
@@ -41,6 +48,15 @@ instruments even where they can never fire. Import them explicitly.
 """
 
 from predictionio_tpu.obs import metrics, trace  # noqa: F401
-from predictionio_tpu.obs import freshness, slo  # noqa: F401
+from predictionio_tpu.obs import freshness, history, incident, slo  # noqa: F401
 
-__all__ = ["metrics", "trace", "slo", "freshness", "device", "progress"]
+__all__ = [
+    "metrics",
+    "trace",
+    "slo",
+    "freshness",
+    "history",
+    "incident",
+    "device",
+    "progress",
+]

@@ -371,6 +371,10 @@ class SloRegistry:
         self._violations: tuple[str, ...] = ()
         self._latency_slos: tuple[LatencySlo, ...] = ()
         self._ticker: threading.Thread | None = None
+        # flight-recorder tap: called with each transition dict that
+        # lands in VIOLATED, after the active-violation set is updated
+        # (obs.incident installs itself here; tests leave it None)
+        self.on_violation = None
 
     # -- membership ----------------------------------------------------------
     def register(self, slo: Slo) -> Slo:
@@ -413,6 +417,7 @@ class SloRegistry:
             slos = list(self._slos.values())
         docs: list[dict] = []
         violated: list[str] = []
+        fired: list[dict] = []
         for s in slos:
             was = s.state
             try:
@@ -439,6 +444,7 @@ class SloRegistry:
                 with self._lock:
                     self._alerts.append(transition)
                 if s.state == VIOLATED:
+                    fired.append(transition)
                     _metrics.counter(
                         "pio_slo_alerts_total",
                         "Transitions into the violated (alerting) state",
@@ -471,6 +477,15 @@ class SloRegistry:
             self._last_eval = now
             self._last_docs = docs
             alerts = list(self._alerts)
+        hook = self.on_violation
+        if hook is not None:
+            # fire AFTER the violation set is published so the flight
+            # recorder sees traces tagged against the new violation
+            for transition in fired:
+                try:
+                    hook(transition)
+                except Exception:
+                    pass
         return {
             "enabled": True,
             "now": round(now, 3),
@@ -542,6 +557,12 @@ class SloRegistry:
             try:
                 if _metrics.enabled() and self._slos:
                     self.evaluate_all()
+                if _metrics.enabled():
+                    # the metrics history sampler rides this ticker
+                    # (same default cadence; its own step guard decides)
+                    from predictionio_tpu.obs import history as _history
+
+                    _history.maybe_sample()
             except Exception:
                 pass  # the ticker must survive any reader
 

@@ -14,6 +14,7 @@ import logging
 
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.obs import device as obs_device
+from predictionio_tpu.obs import history as obs_history
 from predictionio_tpu.obs import progress as obs_progress
 from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import trace as obs_trace
@@ -102,12 +103,87 @@ def render_waterfall(traces: list[dict], source: str) -> str:
     )
 
 
+def render_sparkline(
+    points: list, width: int = 160, height: int = 28, color: str = "#4a90d9"
+) -> str:
+    """One bounded metrics-history series as an inline SVG polyline
+    (no assets, like everything else here). ``points`` is the
+    ``/history.json`` shape: ``[[t_ms, value], ...]``."""
+    vals = [float(p[1]) for p in points]
+    if not vals:
+        return "<span style='color:#999'>no samples</span>"
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    n = len(vals)
+    step = width / max(n - 1, 1)
+    pts = " ".join(
+        f"{i * step:.1f},{height - 2 - (v - lo) / span * (height - 4):.1f}"
+        for i, v in enumerate(vals)
+    )
+    return (
+        f"<svg width='{width}' height='{height}' "
+        f"style='vertical-align:middle'>"
+        f"<polyline points='{pts}' fill='none' stroke='{color}' "
+        f"stroke-width='1.5'/></svg>"
+    )
+
+
+def render_history_rows(hist: dict, contains: str = "", limit: int = 12) -> str:
+    """Sparkline table rows for every history series whose key contains
+    ``contains``: key | sparkline | latest value. Empty string when the
+    history layer has nothing matching (obs off, or no samples yet)."""
+    rows = []
+    for key, doc in hist.get("series", {}).items():
+        if contains and contains not in key:
+            continue
+        points = doc.get("points", [])
+        if not points:
+            continue
+        latest = points[-1][1]
+        unit = " Δ/step" if doc.get("kind") == "delta" else ""
+        rows.append(
+            f"<tr><td style='font-family:monospace;padding:1px 8px'>"
+            f"{html.escape(key)}</td>"
+            f"<td>{render_sparkline(points)}</td>"
+            f"<td style='font-family:monospace;text-align:right'>"
+            f"{latest:g}{unit}</td></tr>"
+        )
+        if len(rows) >= limit:
+            break
+    if not rows:
+        return ""
+    return (
+        "<table style='border-collapse:collapse'>" + "".join(rows) + "</table>"
+    )
+
+
+def render_alerts_table(alerts: list[dict], limit: int = 10) -> str:
+    """The SLO alert ring (newest first): time, objective, transition,
+    burn — the same record ``pio status --json`` surfaces."""
+    rows = "".join(
+        f"<tr><td>{a.get('t')}</td>"
+        f"<td>{html.escape(str(a.get('slo')))}</td>"
+        f"<td>{html.escape(str(a.get('from')))} &rarr; "
+        f"{html.escape(str(a.get('to')))}</td>"
+        f"<td>{a.get('burn_fast')}</td><td>{a.get('burn_slow')}</td></tr>"
+        for a in list(reversed(alerts))[:limit]
+    )
+    if not rows:
+        return "<p>No SLO state transitions recorded.</p>"
+    return (
+        "<table border='1' cellpadding='4'>"
+        "<tr><th>t</th><th>Objective</th><th>Transition</th>"
+        f"<th>Burn (fast)</th><th>Burn (slow)</th></tr>{rows}</table>"
+    )
+
+
 _SLO_COLORS = {"ok": "#2a2", "burning": "#c80", "violated": "#c22"}
 
 
-def render_slo_panel(doc: dict, source: str) -> str:
+def render_slo_panel(doc: dict, source: str, hist: dict | None = None) -> str:
     """SLO state table (objective, state, burn fast/slow, SLI, current)
-    plus the alert ring, color-coded by state."""
+    plus the alert ring, color-coded by state, and — when a history
+    snapshot is supplied — burn-rate sparklines per objective."""
     rows = []
     for s in doc.get("slos", []):
         state = str(s.get("state", "?"))
@@ -123,14 +199,6 @@ def render_slo_panel(doc: dict, source: str) -> str:
             f"<td>{s.get('sli_slow', '')}</td>"
             f"<td>{s.get('current', '')}</td></tr>"
         )
-    alert_rows = "".join(
-        f"<tr><td>{a.get('t')}</td>"
-        f"<td>{html.escape(str(a.get('slo')))}</td>"
-        f"<td>{html.escape(str(a.get('from')))} &rarr; "
-        f"{html.escape(str(a.get('to')))}</td>"
-        f"<td>{a.get('burn_fast')}</td></tr>"
-        for a in reversed(doc.get("alerts", []))
-    )
     body = (
         f"<p>No SLOs registered on {html.escape(source)}.</p>"
         if not rows
@@ -142,20 +210,16 @@ def render_slo_panel(doc: dict, source: str) -> str:
             + "".join(rows) + "</table>"
         )
     )
-    alerts = (
-        "<h2>Alerts</h2>"
-        + (
-            "<table border='1' cellpadding='4'>"
-            "<tr><th>t</th><th>Objective</th><th>Transition</th>"
-            f"<th>Burn (fast)</th></tr>{alert_rows}</table>"
-            if alert_rows
-            else "<p>No state transitions recorded.</p>"
-        )
-    )
+    alerts = "<h2>Alerts</h2>" + render_alerts_table(doc.get("alerts", []))
+    burn = ""
+    if hist:
+        spark = render_history_rows(hist, "pio_slo_burn_rate")
+        if spark:
+            burn = "<h2>Burn-rate history</h2>" + spark
     return (
         "<html><head><title>SLOs</title></head><body>"
         f"<h1>SLOs</h1><p>source: {html.escape(source)}</p>"
-        f"{body}{alerts}</body></html>"
+        f"{body}{burn}{alerts}</body></html>"
     )
 
 
@@ -173,10 +237,16 @@ def _kv_table(rows: list[tuple[str, str]]) -> str:
     )
 
 
-def render_device_panel(block: dict, progress: dict | None, source: str) -> str:
+def render_device_panel(
+    block: dict,
+    progress: dict | None,
+    source: str,
+    hist: dict | None = None,
+) -> str:
     """Device telemetry panel: per-device memory, transfer byte totals,
-    the compile tracker table, and — while a checkpointed ``pio train``
-    is live on this host — its progress."""
+    the compile tracker table, history sparklines for the device-side
+    series, and — while a checkpointed ``pio train`` is live on this
+    host — its progress."""
     sections = []
     devices = block.get("devices") or []
     if devices:
@@ -232,6 +302,12 @@ def render_device_panel(block: dict, progress: dict | None, source: str) -> str:
         if progress.get("mesh"):
             rows.append(("mesh", str(progress["mesh"])))
         sections.append("<h2>Training in progress</h2>" + _kv_table(rows))
+    if hist:
+        spark = render_history_rows(hist, "pio_device") or render_history_rows(
+            hist, "pio_jit"
+        )
+        if spark:
+            sections.append("<h2>Device history</h2>" + spark)
     return (
         "<html><head><title>Device telemetry</title></head><body>"
         "<h1>Device telemetry</h1>"
@@ -240,6 +316,20 @@ def render_device_panel(block: dict, progress: dict | None, source: str) -> str:
         "/stats.json device block).</p>"
         f"{''.join(sections)}</body></html>"
     )
+
+
+def _fetch_src_json(src: str, path: str) -> dict | None:
+    """Best-effort server-side fetch of another server's obs endpoint
+    (the serving processes don't speak CORS, so the browser can't)."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"{src.rstrip('/')}{path}", timeout=2
+        ) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
 
 
 class Dashboard:
@@ -299,13 +389,40 @@ class Dashboard:
                     f"</td></tr>"
                 )
             rows = "".join(cells)
+            # operations strip: recent SLO alerts + request-rate
+            # sparklines — ?src= aims them at a live serving process
+            src = request.query.get("src")
+            if src and src.startswith(("http://", "https://")):
+                slo_doc = _fetch_src_json(src, "/slo.json") or {}
+                hist = _fetch_src_json(src, "/history.json") or {}
+                ops_source = src
+            else:
+                slo_doc = obs_slo.document()
+                hist = obs_history.snapshot()
+                ops_source = "this dashboard process"
+            spark = render_history_rows(hist, "pio_http_requests_total")
+            ops = (
+                f"<h2>Operations <small>({html.escape(ops_source)})</small>"
+                "</h2>"
+                "<p><a href='/slo'>SLOs</a> · <a href='/traces'>traces</a> "
+                "· <a href='/device'>device</a> · "
+                "<a href='/history.json'>history.json</a> — append "
+                "<code>?src=http://host:port</code> for a live server.</p>"
+                "<h3>Recent SLO alerts</h3>"
+                + render_alerts_table(slo_doc.get("alerts", []))
+                + (
+                    "<h3>Request rate (per history step)</h3>" + spark
+                    if spark
+                    else ""
+                )
+            )
             page = (
                 "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
                 "<body><h1>Completed evaluations</h1>"
                 "<table border='1'><tr><th>ID</th><th>Evaluation</th>"
                 "<th>Started</th><th>Finished</th><th>One-liner</th>"
                 "<th>Metric scores</th><th>Best params</th>"
-                f"<th>Results</th></tr>{rows}</table></body></html>"
+                f"<th>Results</th></tr>{rows}</table>{ops}</body></html>"
             )
             return Response.html(page)
 
@@ -392,14 +509,16 @@ class Dashboard:
                         block = json.loads(resp.read()).get("device", {})
                 except Exception as e:
                     return Response.error(f"fetch from {src} failed: {e}", 502)
+                hist = _fetch_src_json(src, "/history.json")
                 source = src
             else:
                 block = obs_device.device_block()
+                hist = obs_history.snapshot()
                 source = "this dashboard process"
             doc = obs_progress.read_progress()
             progress = doc if obs_progress.is_live(doc) else None
             return Response.html(
-                render_device_panel(block, progress, source)
+                render_device_panel(block, progress, source, hist=hist)
             )
 
         @router.route("GET", "/slo")
@@ -422,11 +541,13 @@ class Dashboard:
                         doc = json.loads(resp.read())
                 except Exception as e:
                     return Response.error(f"fetch from {src} failed: {e}", 502)
+                hist = _fetch_src_json(src, "/history.json")
                 source = src
             else:
                 doc = obs_slo.document()
+                hist = obs_history.snapshot()
                 source = "this dashboard process"
-            return Response.html(render_slo_panel(doc, source))
+            return Response.html(render_slo_panel(doc, source, hist=hist))
 
         add_obs_routes(router)
         return router

@@ -45,6 +45,7 @@ from predictionio_tpu.data.event import (
 from predictionio_tpu.data.storage import AccessKey, Storage, get_storage
 from predictionio_tpu.data.storage import frame as frame_mod
 from predictionio_tpu.obs import device as obs_device
+from predictionio_tpu.obs import history as obs_history
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import trace as obs_trace
@@ -186,6 +187,8 @@ class EventServer:
         # default objectives: ingest availability + group-commit latency
         # + backpressure-budget headroom (registered after _budget exists)
         obs_slo.install_event_server_slos(self)
+        # minute-bucket ingest counts join /history.json's read shape
+        obs_history.register_provider("ingest_stats", self.stats.history_series)
         self.app = HTTPApp(
             self._router(),
             host=host,
@@ -358,6 +361,7 @@ class EventServer:
         stamp_iso = format_time(datetime.now(tz=timezone.utc), "us")
         accepted = 0
         frames = 0
+        t_start = time.perf_counter()
         try:
             for payload in frame_mod.read_frames(stream):
                 t0 = time.perf_counter()
@@ -419,6 +423,17 @@ class EventServer:
                     "frames": frames,
                 },
                 status=400,
+            )
+        # one span covering the whole framed body: with the client
+        # minting X-PIO-Trace (pio import --http / batch_insert HTTP
+        # paths), the stitched server-side trace carries the ingest
+        # stage alongside the request envelope
+        tr = obs_trace.current_trace()
+        if tr is not None:
+            tr.add_span(
+                f"ingest.frames[{frames}x{accepted}]",
+                t_start,
+                time.perf_counter(),
             )
         return Response.json({"accepted": accepted, "frames": frames})
 

@@ -44,6 +44,8 @@ from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu import faults
 from predictionio_tpu.obs import device as obs_device
+from predictionio_tpu.obs import history as obs_history
+from predictionio_tpu.obs import incident as obs_incident
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import trace as obs_trace
@@ -269,10 +271,19 @@ def add_obs_routes(router: Router) -> None:
     list, ``?since_ms=`` drops traces that started before the given
     epoch-milliseconds, ``?slo=violated`` keeps only traces tagged as
     SLO evidence), ``GET /slo.json`` (objective states, burn rates, and
-    the alert ring), and ``POST /profile`` (bounded on-demand
-    ``jax.profiler`` capture, ``?seconds=``/``?out=``). ``/metrics``,
-    ``/traces.json``, and ``/slo.json`` are unauthenticated on every server — standard
-    scraper behavior; neither exposes event data."""
+    the alert ring), ``GET /history.json`` (bounded metrics history
+    rings; ``?metric=`` substring filter, ``?since_ms=`` cutoff,
+    ``?step=`` re-grids onto a coarser step), ``POST /incident``
+    (on-demand flight-recorder bundle, ``?reason=``/``?note=``), and
+    ``POST /profile`` (bounded on-demand ``jax.profiler`` capture,
+    ``?seconds=``/``?out=``). The GET endpoints are unauthenticated on
+    every server — standard scraper behavior; none exposes event data.
+
+    Mounting also arms the passive obs machinery the routes read from:
+    the history sampler's ticker and the flight recorder's crash/SLO
+    hooks — both no-ops under ``PIO_OBS=0``."""
+    obs_history.ensure_ticker()
+    obs_incident.install_crash_hooks()
 
     def _metrics_route(_req: Request) -> Response:
         # Registers the per-device memory gauges on first scrape after
@@ -323,9 +334,55 @@ def add_obs_routes(router: Router) -> None:
     def _slo_route(_req: Request) -> Response:
         return Response.json(obs_slo.document())
 
+    def _history_route(req: Request) -> Response:
+        since_ms = req.query.get("since_ms")
+        cutoff = None
+        if since_ms is not None:
+            try:
+                cutoff = float(since_ms)
+            except ValueError:
+                return Response.error("since_ms must be a number", 400)
+        step = req.query.get("step")
+        step_s = None
+        if step is not None:
+            try:
+                step_s = float(step)
+            except ValueError:
+                return Response.error("step must be a number", 400)
+            if step_s <= 0:
+                return Response.error("step must be > 0", 400)
+        return Response.json(
+            obs_history.snapshot(
+                metric=req.query.get("metric") or None,
+                since_ms=cutoff,
+                step_s=step_s,
+            )
+        )
+
+    def _incident_route(req: Request) -> Response:
+        if not obs_metrics.enabled():
+            return Response.error("observability disabled (PIO_OBS=0)", 503)
+        try:
+            path = obs_incident.record(
+                req.query.get("reason") or "manual",
+                note=req.query.get("note") or None,
+                force=True,
+            )
+        except Exception as exc:
+            return Response.error(f"incident dump failed: {exc}", 500)
+        return Response.json(
+            {
+                "ok": path is not None,
+                "incident": str(path) if path else None,
+                "files": list(obs_incident.BUNDLE_FILES),
+            }
+        )
+
     router.add("GET", "/metrics", _metrics_route)
     router.add("GET", "/traces.json", _traces_route)
     router.add("GET", "/slo.json", _slo_route)
+    router.add("GET", "/history.json", _history_route)
+    router.add("POST", "/incident", _incident_route)
     router.add("POST", "/profile", _profile_route)
 
 

@@ -77,6 +77,30 @@ class Stats:
         with self._lock:
             return len(self._buckets)
 
+    def history_series(self) -> dict:
+        """The live minute buckets in the :mod:`obs.history` read shape:
+        ``{series_key: {"kind": "delta", "points": [[t_ms, count], ...]}}``.
+        Each point is one minute's accepted/rejected count per (app,
+        status) — the event server registers this as a history provider,
+        so ``/history.json`` (and the dashboard sparklines, ``pio top``)
+        show ingest alongside the sampled process metrics. A point's
+        timestamp is the minute's END (the bucket is complete then),
+        matching the delta convention of sampled counters."""
+        per_series: dict[str, list] = defaultdict(list)
+        with self._lock:
+            for minute in sorted(self._buckets):
+                agg: dict[tuple[int, int], int] = defaultdict(int)
+                for key, count in self._buckets[minute].items():
+                    agg[(key.app_id, key.status)] += count
+                for (app_id, status), count in sorted(agg.items()):
+                    series = (
+                        f'pio_stats_events{{app="{app_id}",status="{status}"}}'
+                    )
+                    per_series[series].append([(minute + 1) * 60_000, count])
+        return {
+            k: {"kind": "delta", "points": pts} for k, pts in per_series.items()
+        }
+
     def get(self, app_id: int) -> dict:
         """Aggregate counts for one app: cumulative folded totals plus
         every live bucket (the reference reports previous-minute and
