@@ -2789,6 +2789,21 @@ def _compact_summary(result: dict) -> dict:
             for k in ("scale", "events", "events_per_s", "s_per_iteration")
             if k in ss
         }
+    rv = result.get("retrieval")
+    if isinstance(rv, dict) and "error" not in rv:
+        s["retrieval"] = {
+            rung: {
+                k: row[k]
+                for k in ("exact_qps", "two_stage_qps", "speedup",
+                          "two_stage_p99_ms", "recall_at_num",
+                          "shortlist_bytes_per_query")
+                if k in row
+            }
+            for rung, row in rv.get("rungs", {}).items()
+            if isinstance(row, dict) and "error" not in row
+        }
+        if "ok" in rv:
+            s["retrieval"]["ok"] = rv["ok"]
     ps = result.get("production_stack")
     if isinstance(ps, dict) and "error" not in ps:
         s["production_stack"] = {
@@ -3589,6 +3604,155 @@ def bench_binary_ingest(result: dict, smoke: bool = False) -> None:
         server.stop()
 
 
+def _fmt_items(n: int) -> str:
+    return f"{n // 1_000_000}M" if n >= 1_000_000 else str(n)
+
+
+def bench_retrieval(
+    extras: dict,
+    rungs=(1_000_000, 10_000_000),
+    d: int = 32,
+    batch: int = 8,
+    num: int = 10,
+) -> None:
+    """``retrieval`` section: exact full-catalog scoring vs two-stage
+    retrieval (coarse int8 shortlist + exact f32 rescore,
+    ops/retrieval.py) on int8-stored catalogs at 1M/10M/100M items.
+    Per rung: exact and two-stage qps + p99, shortlist bytes shipped
+    per query, device-resident coarse bytes, and MEASURED recall@num
+    against the exact ids. Gates: at 1M two-stage must not lose to
+    exact and recall >= 0.999; at 10M two-stage must clear 2x."""
+    from predictionio_tpu.ops import retrieval as retrieval_ops
+    from predictionio_tpu.ops.retrieval import CoarseCatalog
+    from predictionio_tpu.ops.topk import top_k_items_batch
+
+    import jax.numpy as jnp
+
+    k = 1 << max(0, num - 1).bit_length()
+    out: dict = {"d": d, "batch": batch, "num": num, "rungs": {}}
+    extras["retrieval"] = out
+    rng = np.random.default_rng(7)
+    q = rng.normal(size=(batch, d)).astype(np.float32)
+
+    def pctl(lat, p):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+    for items in rungs:
+        name = _fmt_items(items)
+        rung: dict = {"items": items}
+        out["rungs"][name] = rung
+        try:
+            vq = rng.integers(-127, 128, size=(items, d), dtype=np.int8)
+            vs = (rng.uniform(0.5, 1.5, size=items) / 127.0).astype(
+                np.float32
+            )
+            table = (jnp.asarray(vq), jnp.asarray(vs))
+            kp = retrieval_ops.shortlist_k(k, items)
+            cat = CoarseCatalog((vq, vs))
+            reps_e = 10 if items <= 1_000_000 else (
+                3 if items <= 10_000_000 else 1
+            )
+            reps_t = 10 if items <= 1_000_000 else (
+                5 if items <= 10_000_000 else 3
+            )
+
+            def exact_call():
+                _, ids = top_k_items_batch(q, table, k=k)
+                return np.asarray(ids)
+
+            def two_stage_call():
+                _, cand = cat.shortlist(q, kp)
+                _, ids = retrieval_ops.rescore_top_k_batch(
+                    q, table, cand, k=k
+                )
+                return ids
+
+            exact_ids = exact_call()  # warmup doubles as ground truth
+            two_ids = two_stage_call()
+            lat_e, lat_t = [], []
+            for _ in range(reps_e):
+                t0 = time.perf_counter()
+                exact_call()
+                lat_e.append(time.perf_counter() - t0)
+            for _ in range(reps_t):
+                t0 = time.perf_counter()
+                two_stage_call()
+                lat_t.append(time.perf_counter() - t0)
+            hits = sum(
+                len(set(two_ids[b, :num].tolist())
+                    & set(exact_ids[b, :num].tolist()))
+                for b in range(batch)
+            )
+            rung.update({
+                "exact_qps": round(batch / (sum(lat_e) / len(lat_e)), 1),
+                "exact_p99_ms": round(pctl(lat_e, 0.99) * 1e3, 2),
+                "two_stage_qps": round(batch / (sum(lat_t) / len(lat_t)), 1),
+                "two_stage_p99_ms": round(pctl(lat_t, 0.99) * 1e3, 2),
+                "shortlist_kp": kp,
+                # per query the device returns kp int32 ids + kp f32
+                # scores instead of touching all I rows
+                "shortlist_bytes_per_query": kp * 8,
+                "coarse_mb": round(cat.nbytes() / 2**20, 1),
+                "recall_at_num": round(hits / (batch * num), 4),
+            })
+            rung["speedup"] = round(
+                rung["two_stage_qps"] / max(rung["exact_qps"], 1e-9), 2
+            )
+            del table, cat, vq, vs
+        except Exception as e:
+            rung["error"] = f"{type(e).__name__}: {e}"
+    r1 = out["rungs"].get("1M", {})
+    ok = (
+        "error" not in r1
+        and r1.get("two_stage_qps", 0) >= r1.get("exact_qps", float("inf"))
+        and r1.get("recall_at_num", 0) >= 0.999
+    )
+    r10 = out["rungs"].get("10M")
+    if isinstance(r10, dict):
+        ok = ok and "error" not in r10 and r10.get("speedup", 0) >= 2.0 \
+            and r10.get("recall_at_num", 0) >= 0.999
+    out["ok"] = bool(ok)
+    if not ok:
+        out["error"] = (
+            "retrieval gate failed (1M: two-stage >= exact qps and "
+            "recall >= 0.999; 10M: speedup >= 2x)"
+        )
+
+
+def retrieval_main(smoke: bool) -> None:
+    """``bench.py retrieval [--smoke] [--scale]``: the two-stage
+    retrieval ladder on its own. 1M and 10M always (both gated); the
+    100M rung — ~3.2 GB of int8 catalog plus transients — only under
+    ``--scale``. Exit nonzero unless every gate passed."""
+    import sys as _sys
+
+    from predictionio_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+    import jax
+
+    rungs = [1_000_000, 10_000_000]
+    if "--scale" in _sys.argv:
+        rungs.append(100_000_000)
+    result: dict = {
+        "metric": "bench_retrieval",
+        "value": None,
+        "unit": "s",
+        "device": jax.default_backend(),
+        "smoke": smoke,
+    }
+    t0 = time.perf_counter()
+    try:
+        bench_retrieval(result, rungs=rungs)
+    except Exception as e:
+        result["retrieval"] = {"error": f"{type(e).__name__}: {e}"}
+    result["value"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(result))
+    print(json.dumps(_compact_summary(result)))
+    _sys.exit(0 if result.get("retrieval", {}).get("ok") is True else 1)
+
+
 def ingest_main(smoke: bool) -> None:
     """``bench.py ingest [--smoke]``: run the wire-speed ingest ladder
     on its own, print the full-detail line, and exit non-zero unless
@@ -3772,6 +3936,13 @@ def smoke_main() -> None:
         bench_serving_smoke(result)
     except Exception as e:
         result["serving"] = {"error": f"{type(e).__name__}: {e}"}
+    # two-stage retrieval gate at the 1M rung only (the 10M/100M rungs
+    # live in `bench.py retrieval`): two-stage must not lose to exact
+    # and measured recall@num must clear 0.999, else error_sections
+    try:
+        bench_retrieval(result, rungs=(1_000_000,))
+    except Exception as e:
+        result["retrieval"] = {"error": f"{type(e).__name__}: {e}"}
     # ISSUE 6 acceptance gates (fused-variant parity at atol 1e-6,
     # ring_vs_gather <= 1.5) + the reduced sharded_scaling shape, in a
     # child process that owns the virtual 8-device mesh; an assert
@@ -3811,6 +3982,9 @@ def main() -> None:
         return
     if "ingest" in sys.argv:
         ingest_main(smoke="--smoke" in sys.argv)
+        return
+    if "retrieval" in sys.argv:
+        retrieval_main(smoke="--smoke" in sys.argv)
         return
     if "obs" in sys.argv:
         obs_main()
@@ -4164,6 +4338,18 @@ def main() -> None:
     except Exception as e:
         extras["sharded_scaling"] = {"error": f"{type(e).__name__}: {e}"}
     _mark("sharded_scaling")
+
+    # two-stage catalog retrieval ladder: 1M + 10M by default, the 100M
+    # rung (3.2 GB int8 catalog) behind --scale
+    if os.environ.get("BENCH_RETRIEVAL", "1") == "1":
+        try:
+            rungs = [1_000_000, 10_000_000]
+            if "--scale" in sys.argv:
+                rungs.append(100_000_000)
+            bench_retrieval(extras, rungs=rungs)
+        except Exception as e:
+            extras["retrieval"] = {"error": f"{type(e).__name__}: {e}"}
+        _mark("retrieval")
 
     result.update(extras)
     print(json.dumps(result))

@@ -193,6 +193,7 @@ class ECommModel:
         # derived serving caches (device arrays / index maps) rebuild
         # lazily after unpickle
         state.pop("_weighted_V", None)
+        state.pop("_coarse_V", None)
         state.pop("_cat_members", None)
         return state
 
@@ -496,6 +497,29 @@ class ECommAlgorithm(Algorithm):
             cache[key] = weighted
             return weighted
 
+    def _coarse_catalog(self, model: ECommModel):
+        """Tiled coarse copy of the WEIGHTED item table for the
+        two-stage shortlist pass (ops/retrieval.py) — the business-rule
+        weights bake into the coarse scores exactly like the exact
+        path's, so the shortlist ranks what serving ranks. Cached by
+        weight content, like ``_weighted_item_factors``."""
+        import json as json_mod
+
+        from predictionio_tpu.ops.retrieval import CoarseCatalog
+
+        key = json_mod.dumps(self.params.weights, sort_keys=True)
+        cache = getattr(model, "_coarse_V", None)
+        if cache is not None and key in cache:
+            return cache[key]
+        with self._serve_lock:
+            cache = getattr(model, "_coarse_V", None)  # double-check
+            if cache is None:
+                cache = {}
+                model._coarse_V = cache
+            if key not in cache:
+                cache[key] = CoarseCatalog(self._weighted_item_factors(model))
+            return cache[key]
+
     def cacheable_query(self, query: Query) -> bool:
         """Never cacheable: predictions depend on LIVE event-store state
         the epoch fence can't see — the user's seen events, the latest
@@ -523,6 +547,7 @@ class ECommAlgorithm(Algorithm):
         keep per-query masked calls through the same batched op."""
         import jax.numpy as jnp
 
+        from predictionio_tpu.ops import retrieval
         from predictionio_tpu.ops.topk import top_k_items_batch
 
         inv = model.item_index.inverse
@@ -552,6 +577,7 @@ class ECommAlgorithm(Algorithm):
             else:
                 complex_.append(qi)
         V = self._weighted_item_factors(model)
+        n_items = len(model.item_index)
         if simple:
             batch = np.stack([vecs[qi] for qi in simple])
             k = _pow2(
@@ -560,19 +586,41 @@ class ECommAlgorithm(Algorithm):
                     for qi in simple
                 )
             )
-            scores, ids = top_k_items_batch(batch, V, k=k)
+            kp = (
+                retrieval.shortlist_k(k, n_items)
+                if retrieval.engaged(n_items)
+                else 0
+            )
+            if kp and k <= kp < n_items:
+                # two-stage: coarse shortlist over the weighted catalog,
+                # exact rescore of the [B, S] candidates (ops/retrieval.py)
+                _, cand = self._coarse_catalog(model).shortlist(batch, kp)
+                scores, ids = retrieval.rescore_top_k_batch(
+                    batch, V, cand, k=k
+                )
+                if retrieval.probe_due():
+                    _, exact_ids = top_k_items_batch(batch[:1], V, k=k)
+                    retrieval.probe_recall(
+                        ids[0], np.asarray(exact_ids)[0]
+                    )
+            else:
+                scores, ids = top_k_items_batch(batch, V, k=k)
             scores, ids = np.asarray(scores), np.asarray(ids)
             for row, qi in enumerate(simple):
                 mask, num = masks[qi], int(queries[qi][1].num)
                 item_scores: list[ItemScore] = []
                 for s, i in zip(scores[row], ids[row]):
                     ii = int(i)
-                    if mask[ii]:
+                    if ii < 0 or mask[ii]:
                         continue
                     item_scores.append(ItemScore(item=inv[ii], score=float(s)))
                     if len(item_scores) == num:
                         break
                 results[qi] = PredictedResult(itemScores=item_scores)
+        if complex_ and retrieval.engaged(n_items):
+            # category/whiteList masks can cover most of the catalog:
+            # exact masked path
+            retrieval.note_exact(len(complex_))
         for qi in complex_:
             num = int(queries[qi][1].num)
             scores, ids = top_k_items_batch(

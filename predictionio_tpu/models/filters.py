@@ -17,13 +17,49 @@ import numpy as np
 from predictionio_tpu.data.bimap import BiMap
 
 
-def normalized_device_factors(factors: np.ndarray):
-    """Row-normalize factors and place on device (dot == cosine after
-    this). The cosine-scoring models cache the result per process."""
+def normalized_device_factors(factors: np.ndarray, scales=None):
+    """Row-normalize factors, place on device, and return
+    ``(table, norms)`` (dot == cosine against ``table`` after this). The
+    cosine-scoring models cache both per process.
+
+    Dense storage: ``table`` is the dense f32 [I, D] row-normalized
+    array, exactly as before. int8 storage (``scales`` is the per-row
+    f32 scale vector): cosine is invariant to the positive per-row
+    scale, so normalization folds INTO the scale — ``table`` stays the
+    (int8 values, f32 1/||values||) pair, which dequantizes to unit
+    rows while keeping the device catalog 4x smaller than dense
+    (ops/topk.py scores the pair without densifying).
+
+    ``norms`` is the device-resident [I] f32 vector of stored-row norms
+    (what ``ops.topk.top_k_similar`` recomputes per call without its
+    ``norms`` argument)."""
     import jax.numpy as jnp
 
+    if scales is not None:
+        vals = np.asarray(factors)
+        n = np.linalg.norm(vals.astype(np.float32), axis=1)
+        inv = (1.0 / np.maximum(n, 1e-12)).astype(np.float32)
+        return (jnp.asarray(vals), jnp.asarray(inv)), jnp.asarray(
+            n.astype(np.float32)
+        )
     norms = np.linalg.norm(factors, axis=1, keepdims=True)
-    return jnp.asarray(factors / np.maximum(norms, 1e-12))
+    table = jnp.asarray(factors / np.maximum(norms, 1e-12))
+    return table, jnp.asarray(norms[:, 0].astype(np.float32))
+
+
+def normalized_query_vectors(
+    factors: np.ndarray, scales, row_ixs: np.ndarray, row_weights: np.ndarray
+) -> np.ndarray:
+    """Host-side [B, D] weighted sums of row-normalized catalog rows —
+    the cosine templates' query vectors for the coarse shortlist pass
+    (the gathers are [B, L], so host math is cheaper than a device
+    round-trip; the exact rescore rebuilds them on device regardless,
+    so this copy never touches final scores)."""
+    rows = np.asarray(factors)[row_ixs].astype(np.float32)  # [B, L, D]
+    del scales  # cosine drops the positive per-row scale
+    n = np.linalg.norm(rows, axis=2, keepdims=True)
+    rows = rows / np.maximum(n, 1e-12)
+    return (rows * np.asarray(row_weights, np.float32)[..., None]).sum(axis=1)
 
 
 def entity_exclusion_mask(

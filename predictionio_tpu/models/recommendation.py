@@ -247,6 +247,7 @@ class ALSModel:
     def __post_init__(self):
         self._device = None
         self._ring = None
+        self._coarse = None
 
     def user_rows(self, ixs):
         """Dense f32 user vectors for the given indices (dequantizes
@@ -290,10 +291,21 @@ class ALSModel:
             self._ring = RingCatalog(self.item_table(), make_mesh())
         return self._ring
 
+    def coarse_catalog(self):
+        """Tiled coarse copy of the item table for the two-stage
+        shortlist pass (ops/retrieval.py), cached — only built once a
+        catalog crosses ``PIO_RETRIEVAL_THRESHOLD``."""
+        if self._coarse is None:
+            from predictionio_tpu.ops.retrieval import CoarseCatalog
+
+            self._coarse = CoarseCatalog(self.item_table())
+        return self._coarse
+
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_device"] = None
         state["_ring"] = None
+        state["_coarse"] = None
         return state
 
 
@@ -444,7 +456,15 @@ class ALSAlgorithm(Algorithm):
         users. The user table is device-resident (``device_factors``),
         so a serving dispatch ships B int32 row indices up, not B
         dequantized f32 vectors — `gather_top_k_batch` dequantizes
-        f32/bf16/int8 storage on device."""
+        f32/bf16/int8 storage on device.
+
+        Catalogs with at least ``PIO_RETRIEVAL_THRESHOLD`` rows route
+        through two-stage retrieval (ops/retrieval.py): a coarse
+        shortlist over the storage-precision catalog (tiled scan off the
+        mesh, coarse ring pass on it), then exact f32 rescoring of the
+        [B, S] shortlist — O(I) work leaves the exact precision path.
+        Below the threshold nothing changes, bit for bit."""
+        from predictionio_tpu.ops import retrieval
         from predictionio_tpu.ops.topk import gather_top_k_batch
 
         known = [(ix, q) for ix, q in queries if q.user in model.user_index]
@@ -464,14 +484,52 @@ class ALSAlgorithm(Algorithm):
             # the smaller-k result exactly)
             k = max(int(q.num) for _, q in known)
             k = 1 << max(0, k - 1).bit_length()
+            num_items = len(model.item_index)
+            kp = (
+                retrieval.shortlist_k(k, num_items)
+                if retrieval.engaged(num_items)
+                else 0
+            )
+            two_stage = bool(kp) and k <= kp < num_items
             if self.params.sharded_serving:
-                scores, ids = model.ring_catalog().top_k(
-                    model.user_rows(uixs), k
+                if two_stage:
+                    _, cand = model.ring_catalog().top_k(
+                        model.user_rows(uixs), kp, coarse=True
+                    )
+                    scores, ids = retrieval.rescore_host(
+                        model.user_rows(uixs), model.item_factors,
+                        model.item_scales, cand, k,
+                    )
+                else:
+                    scores, ids = model.ring_catalog().top_k(
+                        model.user_rows(uixs), k
+                    )
+            elif two_stage:
+                U, V = model.device_factors()
+                _, cand = model.coarse_catalog().shortlist(
+                    model.user_rows(uixs), kp
+                )
+                scores, ids = retrieval.rescore_gather_top_k_batch(
+                    uixs, U, V, cand, k=k
                 )
             else:
                 U, V = model.device_factors()
                 scores, ids = gather_top_k_batch(uixs, U, V, k=k)
             scores, ids = np.asarray(scores), np.asarray(ids)
+            if two_stage and retrieval.probe_due():
+                # live recall probe: exact-score the dispatch's first
+                # query and publish overlap with the two-stage row
+                if self.params.sharded_serving:
+                    _, exact_ids = model.ring_catalog().top_k(
+                        model.user_rows(uixs[:1]), k
+                    )
+                else:
+                    U, V = model.device_factors()
+                    _, exact_ids = gather_top_k_batch(uixs[:1], U, V, k=k)
+                n0 = int(known[0][1].num)
+                retrieval.probe_recall(
+                    ids[0, :n0], np.asarray(exact_ids)[0, :n0]
+                )
             inv = model.item_index.inverse
             for row, (ix, q) in enumerate(known):
                 out.append(

@@ -114,23 +114,42 @@ class RecommendedUserModel:
 
     def __post_init__(self):
         self._device = None
+        self._norms = None
+        self._coarse = None
 
     def device_factors(self):
+        """Row-normalized catalog on device (dot == cosine); int8
+        storage stays the quantized pair — see
+        models/similarproduct.py's device_factors."""
         if self._device is None:
             from predictionio_tpu.models.filters import normalized_device_factors
 
-            factors = self.followed_factors
-            if self.followed_scales is not None:
-                factors = (
-                    factors.astype(np.float32)
-                    * self.followed_scales[:, None]
-                )
-            self._device = normalized_device_factors(factors)
+            self._device, self._norms = normalized_device_factors(
+                self.followed_factors, self.followed_scales
+            )
         return self._device
+
+    def device_norms(self):
+        """Device-resident [F] stored-row norms, computed once at load
+        (``ops.topk.top_k_similar``'s ``norms`` argument)."""
+        if self._norms is None:
+            self.device_factors()
+        return self._norms
+
+    def coarse_catalog(self):
+        """Tiled coarse copy of the normalized catalog for the
+        two-stage shortlist pass (ops/retrieval.py), cached."""
+        if self._coarse is None:
+            from predictionio_tpu.ops.retrieval import CoarseCatalog
+
+            self._coarse = CoarseCatalog(self.device_factors())
+        return self._coarse
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_device"] = None
+        state["_norms"] = None
+        state["_coarse"] = None
         return state
 
 
@@ -203,6 +222,7 @@ def _score_users_batch(
     import jax.numpy as jnp
 
     from predictionio_tpu.models.filters import entity_exclusion_mask
+    from predictionio_tpu.ops import retrieval
     from predictionio_tpu.ops.topk import sum_rows_top_k_batch
 
     index = model.followed_index
@@ -227,6 +247,7 @@ def _score_users_batch(
                 excluded.update(index[u] for u in q.blackList if u in index)
             simple.append((qi, known, excluded, int(q.num)))
     V = model.device_factors()
+    num_rows = len(index)
     if simple:
         L = _pow2(max(len(known) for _, known, _, _ in simple))
         ixs = np.zeros((len(simple), L), dtype=np.int32)
@@ -235,18 +256,46 @@ def _score_users_batch(
             ixs[row, : len(known)] = known
             weights[row, : len(known)] = 1.0
         k = _pow2(max(num + len(excl) for _, _, excl, num in simple))
-        scores, ids = sum_rows_top_k_batch(ixs, weights, V, k=k)
+        kp = (
+            retrieval.shortlist_k(k, num_rows)
+            if retrieval.engaged(num_rows)
+            else 0
+        )
+        if kp and k <= kp < num_rows:
+            # two-stage: coarse shortlist, exact rescore of [B, S]
+            # candidates (see models/similarproduct.py)
+            from predictionio_tpu.models.filters import (
+                normalized_query_vectors,
+            )
+
+            qv = normalized_query_vectors(
+                model.followed_factors, model.followed_scales, ixs, weights
+            )
+            _, cand = model.coarse_catalog().shortlist(qv, kp)
+            scores, ids = retrieval.rescore_sum_rows_top_k_batch(
+                ixs, weights, V, cand, k=k
+            )
+            if retrieval.probe_due():
+                _, exact_ids = sum_rows_top_k_batch(
+                    ixs[:1], weights[:1], V, k=k
+                )
+                retrieval.probe_recall(ids[0], np.asarray(exact_ids)[0])
+        else:
+            scores, ids = sum_rows_top_k_batch(ixs, weights, V, k=k)
         scores, ids = np.asarray(scores), np.asarray(ids)
         for row, (qi, _, excluded, num) in enumerate(simple):
             user_scores: list[UserScore] = []
             for s, i in zip(scores[row], ids[row]):
                 ii = int(i)
-                if ii in excluded:
+                if ii < 0 or ii in excluded:
                     continue
                 user_scores.append(UserScore(user=inv[ii], score=float(s)))
                 if len(user_scores) == num:
                     break
             results[qi] = PredictedResult(userScores=user_scores)
+    if complex_ and retrieval.engaged(num_rows):
+        # whiteList filters can mask most of the catalog: exact path
+        retrieval.note_exact(len(complex_))
     for qi, known, mask, num in complex_:
         L = _pow2(len(known))
         ixs = np.zeros((1, L), dtype=np.int32)

@@ -149,25 +149,43 @@ class SimilarProductModel:
 
     def __post_init__(self):
         self._device = None
+        self._norms = None
+        self._coarse = None
 
     def device_factors(self):
+        """Row-normalized catalog on device (dot == cosine). int8
+        storage stays the quantized (values, 1/||values||) pair — cosine
+        drops the positive per-row scale, so normalization folds into
+        the scale and the device table keeps the 4x size win."""
         if self._device is None:
             from predictionio_tpu.models.filters import normalized_device_factors
 
-            factors = self.item_factors
-            if self.item_scales is not None:
-                # dequantize before row-normalizing (the persisted blob
-                # stays int8; only this device cache is dense)
-                factors = (
-                    factors.astype(np.float32)
-                    * self.item_scales[:, None]
-                )
-            self._device = normalized_device_factors(factors)
+            self._device, self._norms = normalized_device_factors(
+                self.item_factors, self.item_scales
+            )
         return self._device
+
+    def device_norms(self):
+        """Device-resident [I] stored-row norms, computed once at load
+        (``ops.topk.top_k_similar``'s ``norms`` argument)."""
+        if self._norms is None:
+            self.device_factors()
+        return self._norms
+
+    def coarse_catalog(self):
+        """Tiled coarse copy of the normalized catalog for the
+        two-stage shortlist pass (ops/retrieval.py), cached."""
+        if self._coarse is None:
+            from predictionio_tpu.ops.retrieval import CoarseCatalog
+
+            self._coarse = CoarseCatalog(self.device_factors())
+        return self._coarse
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_device"] = None
+        state["_norms"] = None
+        state["_coarse"] = None
         return state
 
 
@@ -220,6 +238,7 @@ def _score_similar_batch(
     rows are batch-size-invariant)."""
     import jax.numpy as jnp
 
+    from predictionio_tpu.ops import retrieval
     from predictionio_tpu.ops.topk import sum_rows_top_k_batch
 
     index = model.item_index
@@ -244,6 +263,7 @@ def _score_similar_batch(
                 excluded.update(index[i] for i in q.blackList if i in index)
             simple.append((qi, known, excluded, int(q.num)))
     V = model.device_factors()  # row-normalized: dot == cosine
+    num_rows = len(index)
     if simple:
         # pad the per-query item lists to a shared pow2 width with
         # weight-0 rows (index 0 gathered, then zeroed — exact), and
@@ -256,18 +276,48 @@ def _score_similar_batch(
             ixs[row, : len(known)] = known
             weights[row, : len(known)] = 1.0
         k = _pow2(max(num + len(excl) for _, _, excl, num in simple))
-        scores, ids = sum_rows_top_k_batch(ixs, weights, V, k=k)
+        kp = (
+            retrieval.shortlist_k(k, num_rows)
+            if retrieval.engaged(num_rows)
+            else 0
+        )
+        if kp and k <= kp < num_rows:
+            # two-stage: coarse shortlist over the tiled catalog, exact
+            # rescore of the [B, S] candidates (query vectors rebuilt on
+            # device exactly like the exact op)
+            from predictionio_tpu.models.filters import (
+                normalized_query_vectors,
+            )
+
+            qv = normalized_query_vectors(
+                model.item_factors, model.item_scales, ixs, weights
+            )
+            _, cand = model.coarse_catalog().shortlist(qv, kp)
+            scores, ids = retrieval.rescore_sum_rows_top_k_batch(
+                ixs, weights, V, cand, k=k
+            )
+            if retrieval.probe_due():
+                _, exact_ids = sum_rows_top_k_batch(
+                    ixs[:1], weights[:1], V, k=k
+                )
+                retrieval.probe_recall(ids[0], np.asarray(exact_ids)[0])
+        else:
+            scores, ids = sum_rows_top_k_batch(ixs, weights, V, k=k)
         scores, ids = np.asarray(scores), np.asarray(ids)
         for row, (qi, _, excluded, num) in enumerate(simple):
             item_scores: list[ItemScore] = []
             for s, i in zip(scores[row], ids[row]):
                 ii = int(i)
-                if ii in excluded:
+                if ii < 0 or ii in excluded:
                     continue
                 item_scores.append(ItemScore(item=inv[ii], score=float(s)))
                 if len(item_scores) == num:
                     break
             results[qi] = PredictedResult(itemScores=item_scores)
+    if complex_ and retrieval.engaged(num_rows):
+        # category/whiteList filters can mask most of the catalog, so
+        # these stay on the exact masked path even at retrieval scale
+        retrieval.note_exact(len(complex_))
     for qi, known, mask, num in complex_:
         L = _pow2(len(known))
         ixs = np.zeros((1, L), dtype=np.int32)

@@ -1,0 +1,497 @@
+"""Two-stage catalog retrieval: coarse shortlist + exact f32 rescore.
+
+Every exact serving op in ops/topk.py scores the FULL catalog per batch
+— a dense ``[B, I]`` matmul plus a full-catalog ``lax.top_k``. Exact and
+fast at MovieLens scale, O(I) per query at the catalog sizes the
+ROADMAP north star implies (the [B, I] score matrix alone is 320 MB at
+B=8, I=10M). This module is the retrieval-tier / scoring-tier split the
+ads serving stack runs at scale (PAPERS.md, arxiv 2501.10546):
+
+1. **Coarse shortlist** — score the catalog in its low-precision
+   storage form *without materializing a dequantized f32 copy*, tiled
+   so neither the [B, I] score matrix nor a full-catalog top-k ever
+   exists: a ``lax.scan`` over ``[NT, T, D]`` tiles keeps a running
+   per-query top-k' merge (working set [B, k' + T]). int8 catalogs
+   score as ``(q @ values^T) * scale`` (the per-row scale factors out
+   of the within-row dot and multiplies back scalar-per-column);
+   ``int8_dot`` additionally quantizes the queries and accumulates in
+   int32 (the MXU-native form — auto-selected on TPU); dense catalogs
+   carry a bf16 coarse copy. On the mesh, the coarse pass is
+   parallel/ring_topk.py's ``coarse=True`` variant (per-shard
+   oversampled top-k', int8 slabs scored without dequantization).
+2. **Exact rescore** — gather the [B, S] shortlisted rows and rescore
+   them in f32 through shortlist-gather variants of the fused ops
+   (``rescore_*_top_k_batch`` below). The rescore builds its query
+   vectors exactly like the exact path (same gathers, same dequant), so
+   the two-stage ranking equals the exact ranking restricted to the
+   shortlist — recall is purely a question of shortlist coverage, which
+   the oversampling factor buys (k' = oversample * pow2(num+|excluded|),
+   pow2-bucketed like every serving shape so jit compile count stays
+   flat).
+
+Engagement is catalog-size gated: templates route ``batch_predict``
+through this module only when the catalog has at least
+``PIO_RETRIEVAL_THRESHOLD`` rows (default 100_000), so small catalogs
+— including every byte-parity test fixture — stay on the exact path
+bit-for-bit. Knobs (read per call, so tests and operators can flip them
+live):
+
+- ``PIO_RETRIEVAL_THRESHOLD``: catalog rows below which serving stays
+  exact (default 100000; <= 0 disables two-stage entirely).
+- ``PIO_RETRIEVAL_OVERSAMPLE``: shortlist oversampling factor (default
+  8; recall@num >= 0.999 gate holds with margin at the default).
+- ``PIO_RETRIEVAL_TILE``: coarse tile width (default 2^18 rows).
+- ``PIO_RETRIEVAL_COARSE``: coarse representation — ``auto`` (int8
+  catalogs stay int8, ``int8_dot`` on TPU; dense catalogs get a bf16
+  copy), or force ``int8`` / ``int8_dot`` / ``bf16``.
+- ``PIO_RETRIEVAL_PROBE_EVERY``: every Nth two-stage dispatch re-scores
+  one query exactly and publishes recall (default 256; 0 disables).
+
+Observability: ``pio_retrieval_*`` metrics (docs/observability.md) and
+a thread-local per-dispatch stage split the engine server turns into
+``dispatch.shortlist`` / ``dispatch.rescore`` trace spans.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.obs import device as obs_device
+from predictionio_tpu.obs import metrics as obs_metrics
+
+NEG_INF = -1e30
+
+# -- knobs (env-read per call: operators flip them on a live server) --------
+
+_DEFAULT_THRESHOLD = 100_000
+_DEFAULT_OVERSAMPLE = 8.0
+_DEFAULT_TILE = 1 << 18
+_DEFAULT_PROBE_EVERY = 256
+
+
+def retrieval_threshold() -> int:
+    return int(os.environ.get("PIO_RETRIEVAL_THRESHOLD", _DEFAULT_THRESHOLD))
+
+
+def oversample() -> float:
+    return float(os.environ.get("PIO_RETRIEVAL_OVERSAMPLE", _DEFAULT_OVERSAMPLE))
+
+
+def tile_size() -> int:
+    return int(os.environ.get("PIO_RETRIEVAL_TILE", _DEFAULT_TILE))
+
+
+def probe_every() -> int:
+    return int(os.environ.get("PIO_RETRIEVAL_PROBE_EVERY", _DEFAULT_PROBE_EVERY))
+
+
+def engaged(num_rows: int) -> bool:
+    """Should serving route this catalog through two-stage retrieval?"""
+    t = retrieval_threshold()
+    return t > 0 and num_rows >= t
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+def shortlist_k(k: int, num_rows: int) -> int:
+    """Shortlist size k' for a headroom-k request against ``num_rows``
+    catalog rows: oversample * k, pow2-bucketed (compile-count flat),
+    capped at the tile width and the catalog's pow2 envelope."""
+    kp = _pow2(int(np.ceil(oversample() * _pow2(max(1, k)))))
+    return max(1, min(kp, tile_size(), _pow2(num_rows)))
+
+
+# -- metrics -----------------------------------------------------------------
+
+_SIZE_BOUNDS = tuple(float(1 << p) for p in range(4, 20, 2))  # 16 .. 262144
+
+_m_two_stage = obs_metrics.counter(
+    "pio_retrieval_queries_total",
+    "serving queries at retrieval scale, by path", path="two_stage",
+)
+_m_exact = obs_metrics.counter(
+    "pio_retrieval_queries_total",
+    "serving queries at retrieval scale, by path", path="exact",
+)
+_m_shortlist_size = obs_metrics.histogram(
+    "pio_retrieval_shortlist_size",
+    "shortlist candidates per query (k')", bounds=_SIZE_BOUNDS,
+)
+_m_shortlist_secs = obs_metrics.histogram(
+    "pio_retrieval_shortlist_seconds", "coarse shortlist pass wall time",
+)
+_m_rescore_secs = obs_metrics.histogram(
+    "pio_retrieval_rescore_seconds", "exact rescore pass wall time",
+)
+_m_probe_recall = obs_metrics.gauge(
+    "pio_retrieval_probe_recall",
+    "recall@num of the most recent exact-rescored probe query",
+)
+_m_probes = obs_metrics.counter(
+    "pio_retrieval_probes_total", "live recall probes run",
+)
+
+_tls = threading.local()
+_probe_clock = itertools.count(1)
+
+
+def note_exact(n: int = 1) -> None:
+    """Count queries that stayed on the exact path at retrieval scale
+    (complex-filtered queries, shortlist-size fallbacks)."""
+    _m_exact.inc(n)
+
+
+def _note_stage(stage: str, seconds: float) -> None:
+    split = getattr(_tls, "split", None)
+    if split is None:
+        split = _tls.split = {}
+    split[stage] = split.get(stage, 0.0) + seconds
+
+
+def take_stage_split() -> dict | None:
+    """Pop this thread's accumulated {shortlist, rescore} seconds since
+    the last call — the engine server's batch worker turns it into
+    ``dispatch.shortlist``/``dispatch.rescore`` spans on the request
+    traces it just dispatched."""
+    split = getattr(_tls, "split", None)
+    _tls.split = None
+    return split or None
+
+
+def probe_due() -> bool:
+    """True every ``PIO_RETRIEVAL_PROBE_EVERY``-th two-stage dispatch:
+    the caller should exact-score one query and ``record_probe`` the
+    measured recall."""
+    n = probe_every()
+    return n > 0 and next(_probe_clock) % n == 0
+
+
+def record_probe(recall: float) -> None:
+    _m_probes.inc()
+    _m_probe_recall.set(recall)
+
+
+def probe_recall(two_stage_ids, exact_ids) -> float:
+    """Measure + publish id-set recall of a two-stage result row
+    against its exact-path counterpart (the live recall probe)."""
+    want = {int(i) for i in np.asarray(exact_ids).ravel() if int(i) >= 0}
+    got = {int(i) for i in np.asarray(two_stage_ids).ravel() if int(i) >= 0}
+    recall = len(got & want) / len(want) if want else 1.0
+    record_probe(recall)
+    return recall
+
+
+def stats_block() -> dict:
+    """Compact ``retrieval`` object for the servers' ``/stats.json``."""
+    return {
+        "threshold": retrieval_threshold(),
+        "oversample": oversample(),
+        "two_stage_queries": _m_two_stage.value(),
+        "exact_queries": _m_exact.value(),
+        "shortlist_size": _m_shortlist_size.summary(),
+        "shortlist_seconds": _m_shortlist_secs.summary(),
+        "rescore_seconds": _m_rescore_secs.summary(),
+        "probes": _m_probes.value(),
+        "probe_recall": _m_probe_recall.value(),
+    }
+
+
+# -- coarse shortlist kernel -------------------------------------------------
+
+
+@obs_device.track_jit("retrieval.coarse_topk")
+@functools.partial(jax.jit, static_argnames=("k", "mode"))
+def _coarse_topk(q, tiles, scales, ids, k: int, mode: str):
+    """Tiled coarse top-k' over a [NT, T, D] catalog: one scan step per
+    tile scores [B, T] in the catalog's storage precision, takes the
+    tile's top-k', and merges into the running best — the [B, I] score
+    matrix and the full-catalog top-k never materialize, which is where
+    the win over the exact path comes from once I outgrows cache.
+
+    ``mode``: "int8" (values*scale columns, f32 GEMM on cast values),
+    "int8_dot" (int8 x int8 -> int32 accumulation, quantized queries —
+    the per-query quantization scale is positive so it drops out of the
+    within-row ranking), or "bf16" (scales is None)."""
+    B = q.shape[0]
+    if mode == "int8_dot":
+        qs = jnp.max(jnp.abs(q), axis=1, keepdims=True) / 127.0
+        qi = jnp.clip(
+            jnp.round(q / jnp.maximum(qs, 1e-12)), -127, 127
+        ).astype(jnp.int8)
+
+    def step(carry, xs):
+        best_s, best_i = carry
+        if scales is None:
+            v, tid = xs
+        else:
+            v, s, tid = xs
+        if mode == "int8_dot":
+            sc = jax.lax.dot_general(
+                qi, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.float32) * s[None, :]
+        else:
+            sc = jnp.matmul(
+                q, v.T.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            if scales is not None:
+                sc = sc * s[None, :]
+        sc = jnp.where(tid[None, :] >= 0, sc, NEG_INF)
+        ts, tix = jax.lax.top_k(sc, k)
+        ti = jnp.take_along_axis(
+            jnp.broadcast_to(tid[None, :], sc.shape), tix, axis=1
+        )
+        cs = jnp.concatenate([best_s, ts], axis=1)
+        ci = jnp.concatenate([best_i, ti], axis=1)
+        best_s, ix = jax.lax.top_k(cs, k)
+        best_i = jnp.take_along_axis(ci, ix, axis=1)
+        return (best_s, best_i), None
+
+    init = (
+        jnp.full((B, k), NEG_INF, jnp.float32),
+        jnp.full((B, k), -1, jnp.int32),
+    )
+    xs = (tiles, ids) if scales is None else (tiles, scales, ids)
+    (best_s, best_i), _ = jax.lax.scan(step, init, xs)
+    return best_s, best_i
+
+
+class CoarseCatalog:
+    """A catalog staged in tiled coarse form for the shortlist pass.
+
+    Built once per (model, weights) from the serving factor table —
+    dense [I, D] f32/bf16 or the int8 (values, scales) pair — and cached
+    by the templates next to their device tables. int8 catalogs keep
+    their existing quantized values (no re-quantization error on top of
+    storage); dense catalogs get an int8 or bf16 coarse COPY whose
+    quantization error only ever costs shortlist coverage, never final
+    score accuracy (the rescore reads the original table).
+
+    Tiles are [NT, T, D] with row ids [NT, T] (-1 marks padding past the
+    catalog), so one scan step's working set is a T-row slab regardless
+    of I.
+    """
+
+    def __init__(self, item_table, tile: int | None = None,
+                 mode: str | None = None):
+        quantized = isinstance(item_table, tuple)
+        vals = item_table[0] if quantized else item_table
+        self.num_rows = int(vals.shape[0])
+        self.dim = int(vals.shape[1])
+        if mode is None:
+            mode = os.environ.get("PIO_RETRIEVAL_COARSE", "auto")
+        if mode == "auto":
+            if quantized:
+                mode = (
+                    "int8_dot" if jax.default_backend() == "tpu" else "int8"
+                )
+            else:
+                mode = "bf16"
+        if mode not in ("int8", "int8_dot", "bf16"):
+            raise ValueError(f"unknown coarse mode {mode!r}")
+        self.mode = mode
+        T = min(int(tile or tile_size()), _pow2(max(1, self.num_rows)))
+        nt = -(-self.num_rows // T)
+        pad = nt * T - self.num_rows
+        self.tile = T
+
+        if mode == "bf16":
+            f = np.asarray(
+                item_table[0], dtype=np.float32
+            ) * np.asarray(item_table[1], np.float32)[:, None] if quantized \
+                else np.asarray(item_table, dtype=np.float32)
+            if pad:
+                f = np.concatenate([f, np.zeros((pad, self.dim), np.float32)])
+            self._tiles = jnp.asarray(f).astype(jnp.bfloat16).reshape(
+                nt, T, self.dim
+            )
+            self._scales = None
+        else:
+            if quantized:
+                vq = np.asarray(item_table[0], dtype=np.int8)
+                vs = np.asarray(item_table[1], dtype=np.float32)
+            else:
+                f = np.asarray(item_table, dtype=np.float32)
+                s = np.max(np.abs(f), axis=1) / 127.0
+                s = np.where(s > 0, s, 1.0).astype(np.float32)
+                vq = np.rint(f / s[:, None]).astype(np.int8)
+                vs = s
+            if pad:
+                vq = np.concatenate([vq, np.zeros((pad, self.dim), np.int8)])
+                vs = np.concatenate([vs, np.ones(pad, np.float32)])
+            self._tiles = jnp.asarray(vq.reshape(nt, T, self.dim))
+            self._scales = jnp.asarray(vs.reshape(nt, T))
+        ids = np.concatenate(
+            [np.arange(self.num_rows, dtype=np.int32),
+             np.full(pad, -1, np.int32)]
+        )
+        self._ids = jnp.asarray(ids.reshape(nt, T))
+
+    def nbytes(self) -> int:
+        """Device-resident coarse bytes (tiles + scales + ids)."""
+        n = self._tiles.size * self._tiles.dtype.itemsize
+        if self._scales is not None:
+            n += self._scales.size * 4
+        return n + self._ids.size * 4
+
+    def shortlist(self, queries, k: int):
+        """Coarse top-k' candidate ids for a [B, D] f32 query batch ->
+        ([B, k'] coarse scores, [B, k'] int32 ids, -1 past the catalog).
+        B pads to a pow2 bucket (copies of row 0, discarded) and k'
+        clamps to the tile width, so arbitrary traffic reuses a bounded
+        set of compiled programs."""
+        q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
+        B = q.shape[0]
+        k = max(1, min(int(k), self.tile))
+        bp = _pow2(max(1, B))
+        if bp > B:
+            q = np.concatenate([q, np.repeat(q[:1], bp - B, axis=0)])
+        t0 = time.perf_counter()
+        s, ids = _coarse_topk(
+            jnp.asarray(q), self._tiles, self._scales, self._ids, k, self.mode
+        )
+        s, ids = np.asarray(s)[:B], np.asarray(ids)[:B]
+        dt = time.perf_counter() - t0
+        _m_shortlist_secs.observe(dt)
+        _m_shortlist_size.observe(float(k))
+        _note_stage("shortlist", dt)
+        return s, ids
+
+
+# -- exact rescore kernels ---------------------------------------------------
+
+
+def _score_candidates(qvecs, item_factors, cand_ids, k: int):
+    """Shared exact-f32 candidate scorer: gather the [B, S] candidate
+    rows (dequantizing int8 pairs on device), dot against the query
+    vectors, top-k. -1 candidate slots can never win and report id -1."""
+    cand = jnp.maximum(cand_ids.astype(jnp.int32), 0)
+    if isinstance(item_factors, tuple):
+        vq, vs = item_factors
+        rows = vq[cand].astype(jnp.float32) * vs[cand][..., None]
+    else:
+        rows = item_factors[cand].astype(jnp.float32)
+    sc = jnp.einsum(
+        "bd,bsd->bs", qvecs.astype(jnp.float32), rows,
+        preferred_element_type=jnp.float32,
+    )
+    sc = jnp.where(cand_ids >= 0, sc, NEG_INF)
+    k = min(k, int(cand_ids.shape[1]))
+    s, ix = jax.lax.top_k(sc, k)
+    ids = jnp.take_along_axis(cand_ids.astype(jnp.int32), ix, axis=1)
+    return s, jnp.where(s > NEG_INF / 2, ids, -1)
+
+
+@obs_device.track_jit("retrieval.rescore_gather")
+@functools.partial(jax.jit, static_argnames=("k",))
+def _rescore_gather(user_ixs, user_factors, item_factors, cand_ids, k: int):
+    ixs = user_ixs.astype(jnp.int32)
+    if isinstance(user_factors, tuple):
+        uq, us = user_factors
+        qvecs = uq[ixs].astype(jnp.float32) * us[ixs][:, None]
+    else:
+        qvecs = user_factors[ixs].astype(jnp.float32)
+    return _score_candidates(qvecs, item_factors, cand_ids, k)
+
+
+@obs_device.track_jit("retrieval.rescore_vectors")
+@functools.partial(jax.jit, static_argnames=("k",))
+def _rescore_vectors(user_vectors, item_factors, cand_ids, k: int):
+    return _score_candidates(user_vectors, item_factors, cand_ids, k)
+
+
+@obs_device.track_jit("retrieval.rescore_sum_rows")
+@functools.partial(jax.jit, static_argnames=("k",))
+def _rescore_sum_rows(row_ixs, row_weights, item_factors, cand_ids, k: int):
+    ixs = row_ixs.astype(jnp.int32)
+    if isinstance(item_factors, tuple):
+        vq, vs = item_factors
+        rows = vq[ixs].astype(jnp.float32) * vs[ixs][..., None]
+    else:
+        rows = item_factors[ixs].astype(jnp.float32)
+    qvecs = jnp.sum(rows * row_weights[..., None], axis=1)
+    return _score_candidates(qvecs, item_factors, cand_ids, k)
+
+
+def _finish_rescore(t0: float, out, n_queries: int):
+    s, ids = np.asarray(out[0]), np.asarray(out[1])
+    dt = time.perf_counter() - t0
+    _m_rescore_secs.observe(dt)
+    _note_stage("rescore", dt)
+    _m_two_stage.inc(n_queries)
+    return s, ids
+
+
+def rescore_gather_top_k_batch(user_ixs, user_factors, item_factors,
+                               cand_ids, k: int):
+    """Shortlist-gather variant of ``gather_top_k_batch``: [B] user row
+    indices + the device-resident tables + a [B, S] candidate-id matrix
+    instead of scoring [B, I]. The query vectors are gathered and
+    dequantized exactly like the exact path's, so the returned ranking
+    equals the exact ranking restricted to the candidates."""
+    t0 = time.perf_counter()
+    out = _rescore_gather(
+        jnp.asarray(np.asarray(user_ixs, np.int32)), user_factors,
+        item_factors, jnp.asarray(np.asarray(cand_ids, np.int32)), k=k,
+    )
+    return _finish_rescore(t0, out, len(cand_ids))
+
+
+def rescore_top_k_batch(user_vectors, item_factors, cand_ids, k: int):
+    """Shortlist-gather variant of ``top_k_items_batch``: [B, D] query
+    vectors against a [B, S] candidate-id matrix."""
+    t0 = time.perf_counter()
+    out = _rescore_vectors(
+        jnp.asarray(np.asarray(user_vectors, np.float32)), item_factors,
+        jnp.asarray(np.asarray(cand_ids, np.int32)), k=k,
+    )
+    return _finish_rescore(t0, out, len(cand_ids))
+
+
+def rescore_sum_rows_top_k_batch(row_ixs, row_weights, item_factors,
+                                 cand_ids, k: int):
+    """Shortlist-gather variant of ``sum_rows_top_k_batch`` for the
+    cosine-family templates: the query vector is the weighted sum of
+    gathered catalog rows (built on device exactly like the exact op),
+    scored against the [B, S] candidates only."""
+    t0 = time.perf_counter()
+    out = _rescore_sum_rows(
+        jnp.asarray(np.asarray(row_ixs, np.int32)),
+        jnp.asarray(np.asarray(row_weights, np.float32)),
+        item_factors, jnp.asarray(np.asarray(cand_ids, np.int32)), k=k,
+    )
+    return _finish_rescore(t0, out, len(cand_ids))
+
+
+def rescore_host(query_vectors, values, scales, cand_ids, k: int):
+    """Host-side exact rescore for the mesh path: the ring coarse pass
+    returns [B, S] global candidate ids; the exact factors live host-side
+    in the model, and S is small, so the f32 gather + dot runs in numpy
+    without staging anything back to the mesh."""
+    t0 = time.perf_counter()
+    cand_ids = np.asarray(cand_ids, dtype=np.int32)
+    cand = np.maximum(cand_ids, 0)
+    rows = np.asarray(values)[cand].astype(np.float32)
+    if scales is not None:
+        rows *= np.asarray(scales, np.float32)[cand][..., None]
+    sc = np.einsum(
+        "bd,bsd->bs", np.asarray(query_vectors, np.float32), rows
+    )
+    sc[cand_ids < 0] = NEG_INF
+    k = min(k, cand_ids.shape[1])
+    order = np.argsort(-sc, axis=1, kind="stable")[:, :k]
+    s = np.take_along_axis(sc, order, axis=1)
+    ids = np.take_along_axis(cand_ids, order, axis=1)
+    ids[s <= NEG_INF / 2] = -1
+    return _finish_rescore(t0, (s, ids), len(cand_ids))

@@ -140,22 +140,37 @@ def sum_rows_top_k_batch(row_ixs, row_weights, item_factors, k: int,
     templates (similarproduct, recommendeduser), whose query vector is
     the SUM of several catalog rows.
 
-    ``row_ixs``: [B, L] int32 rows of ``item_factors`` (dense [I, D],
-    row-normalized) to sum per query, right-padded to a shared static L;
+    ``row_ixs``: [B, L] int32 rows of ``item_factors`` (dense [I, D]
+    row-normalized array, or the int8 (values, scales) pair whose
+    dequantized rows are the normalized catalog — models/filters.py
+    ``normalized_device_factors``; quantized cosine catalogs stay int8
+    on device, 4x smaller than the dense form) to sum per query,
+    right-padded to a shared static L;
     ``row_weights``: [B, L] f32, 1.0 for real rows and 0.0 for padding
     (adding an exactly-zero vector never perturbs the f32 sum, so rows
     are bitwise-invariant across padded widths).
     ``exclude_mask``: optional [I] mask shared by the batch — the
     complex-filter path calls this with B == 1 and its query's own mask.
     Returns ([B, k] scores, [B, k] ids)."""
-    V = item_factors
-    qvecs = jnp.sum(
-        V[row_ixs.astype(jnp.int32)] * row_weights[..., None], axis=1
-    )  # [B, D]
-    scores = jnp.matmul(qvecs, V.T, preferred_element_type=jnp.float32)
+    ixs = row_ixs.astype(jnp.int32)
+    if isinstance(item_factors, tuple):
+        vq, vs = item_factors
+        rows = vq[ixs].astype(jnp.float32) * vs[ixs][..., None]  # [B, L, D]
+        qvecs = jnp.sum(rows * row_weights[..., None], axis=1)  # [B, D]
+        scores = (
+            jnp.matmul(
+                qvecs, vq.T.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            * vs[None, :]
+        )
+    else:
+        V = item_factors
+        qvecs = jnp.sum(V[ixs] * row_weights[..., None], axis=1)  # [B, D]
+        scores = jnp.matmul(qvecs, V.T, preferred_element_type=jnp.float32)
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask.astype(bool)[None, :], NEG_INF, scores)
-    k = min(k, V.shape[0])
+    k = min(k, catalog_rows(item_factors))
     return jax.lax.top_k(scores, k)
 
 
@@ -213,12 +228,31 @@ def ranking_metrics_batch(pred_ids, actual_sorted, actual_counts, k: int):
     return precision, ap, ndcg, counts > 0
 
 
+@obs_device.track_jit("topk.catalog_norms")
+@jax.jit
+def catalog_norms(item_factors):
+    """Per-row L2 norms of a catalog's STORED values ([I] f32) — the
+    quantity ``top_k_similar`` needs per call. Compute once at model
+    build/load, keep device-resident, and pass as its ``norms`` argument
+    (the cosine-family models cache this next to their factor tables)."""
+    if isinstance(item_factors, tuple):
+        f32 = item_factors[0].astype(jnp.float32)
+    else:
+        f32 = item_factors.astype(jnp.float32)
+    return jnp.linalg.norm(f32, axis=1)
+
+
 @obs_device.track_jit("topk.top_k_similar")
 @functools.partial(jax.jit, static_argnames=("k",))
-def top_k_similar(item_vector, item_factors, k: int, exclude_mask=None):
+def top_k_similar(item_vector, item_factors, k: int, exclude_mask=None,
+                  norms=None):
     """Cosine item-item similarity top-k (similarproduct template's scoring,
     examples/scala-parallel-similarproduct/multi/src/main/scala/
-    ALSAlgorithm.scala:147,193,244)."""
+    ALSAlgorithm.scala:147,193,244).
+
+    ``norms``: optional precomputed ``catalog_norms(item_factors)`` —
+    without it every call re-reduces the whole [I, D] catalog just to
+    normalize scores."""
     if isinstance(item_factors, tuple):
         # cosine is scale-invariant per row, so the per-row scale drops
         # out entirely: normalize the int8 values directly
@@ -226,8 +260,10 @@ def top_k_similar(item_vector, item_factors, k: int, exclude_mask=None):
     else:
         f32 = item_factors.astype(jnp.float32)
     v32 = item_vector.astype(jnp.float32)
-    norms = jnp.linalg.norm(f32, axis=1) * jnp.linalg.norm(v32)
-    scores = (f32 @ v32) / jnp.maximum(norms, 1e-12)
+    if norms is None:
+        norms = jnp.linalg.norm(f32, axis=1)
+    denom = norms * jnp.linalg.norm(v32)
+    scores = (f32 @ v32) / jnp.maximum(denom, 1e-12)
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask.astype(bool), NEG_INF, scores)
     k = min(k, catalog_rows(item_factors))

@@ -37,7 +37,7 @@ from predictionio_tpu.parallel.compat import pcast_varying, shard_map
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "mesh", "axis", "normalize")
+    jax.jit, static_argnames=("k", "mesh", "axis", "normalize", "coarse")
 )
 def _ring_topk_device(
     queries,  # [B', D] sharded P(axis) on dim 0
@@ -49,6 +49,7 @@ def _ring_topk_device(
     mesh: Mesh,
     axis: str,
     normalize: bool,
+    coarse: bool = False,
 ):
     n = mesh.shape[axis]
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -76,10 +77,26 @@ def _ring_topk_device(
 
         def step(carry, _):
             v, ids, keep, best_s, best_i = carry
-            # int8 slabs dequantize per step, right before the matmul:
-            # ICI hops stay quantized, scores stay f32
-            vd = dequantize_rows(*v) if quantized else v
-            s = q_blk @ vd.T  # [b, i] — MXU matmul per ring step
+            if quantized and coarse:
+                # coarse shortlist pass (ops/retrieval.py): score the
+                # int8 slab WITHOUT materializing its dequantized f32
+                # copy — the per-row scale factors out of the dot and
+                # multiplies back per column. Ranking-equivalent to the
+                # dequantized score up to f32 rounding; two-stage
+                # serving rescores the shortlist exactly anyway.
+                vq, vs = v
+                s = (
+                    jnp.matmul(
+                        q_blk, vq.T.astype(q_blk.dtype),
+                        preferred_element_type=jnp.float32,
+                    )
+                    * vs[None, :]
+                )
+            else:
+                # int8 slabs dequantize per step, right before the
+                # matmul: ICI hops stay quantized, scores stay f32
+                vd = dequantize_rows(*v) if quantized else v
+                s = q_blk @ vd.T  # [b, i] — MXU matmul per ring step
             s = jnp.where(keep[None, :] > 0, s, NEG_INF)
             cand_s = jnp.concatenate([best_s, s], axis=1)
             cand_i = jnp.concatenate(
@@ -196,8 +213,15 @@ class RingCatalog:
         exclude_mask=None,
         exclude_ids=None,
         normalize=False,
+        coarse=False,
     ):
         """Top-k over the staged catalog. See :func:`ring_top_k`.
+
+        ``coarse=True`` is the mesh shortlist pass of two-stage
+        retrieval (ops/retrieval.py): int8 slabs are scored without
+        dequantization (ranking-equivalent, not bitwise-equal, to the
+        exact scores) — callers rescore the returned ids exactly.
+        Dense catalogs score identically either way.
 
         ``B`` and ``k`` are compile-time shapes in the device program, and
         serving traffic varies both per request (``query.num`` drives k).
@@ -256,6 +280,7 @@ class RingCatalog:
             mesh=self.mesh,
             axis=self.axis,
             normalize=normalize,
+            coarse=coarse,
         )
         return np.asarray(scores)[:B, :k], np.asarray(out_ids)[:B, :k]
 

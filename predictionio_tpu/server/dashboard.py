@@ -242,10 +242,12 @@ def render_device_panel(
     progress: dict | None,
     source: str,
     hist: dict | None = None,
+    retrieval: dict | None = None,
 ) -> str:
     """Device telemetry panel: per-device memory, transfer byte totals,
     the compile tracker table, history sparklines for the device-side
-    series, and — while a checkpointed ``pio train`` is live on this
+    series, two-stage retrieval counters when the source serves them,
+    and — while a checkpointed ``pio train`` is live on this
     host — its progress."""
     sections = []
     devices = block.get("devices") or []
@@ -302,6 +304,43 @@ def render_device_panel(
         if progress.get("mesh"):
             rows.append(("mesh", str(progress["mesh"])))
         sections.append("<h2>Training in progress</h2>" + _kv_table(rows))
+    if retrieval:
+        rows = [
+            ("threshold", f"{retrieval.get('threshold', 0):,} rows"),
+            ("oversample", str(retrieval.get("oversample", ""))),
+            (
+                "queries (two-stage / exact)",
+                f"{retrieval.get('two_stage_queries', 0):,} / "
+                f"{retrieval.get('exact_queries', 0):,}",
+            ),
+        ]
+        size = retrieval.get("shortlist_size") or {}
+        if size.get("count"):
+            rows.append(
+                (
+                    "shortlist size p50/p99",
+                    f"{size.get('p50', 0):,.0f} / {size.get('p99', 0):,.0f}",
+                )
+            )
+        for stage in ("shortlist", "rescore"):
+            s = retrieval.get(f"{stage}_seconds") or {}
+            if s.get("count"):
+                rows.append(
+                    (
+                        f"{stage} p50/p99",
+                        f"{s.get('p50', 0) * 1e3:.2f}ms / "
+                        f"{s.get('p99', 0) * 1e3:.2f}ms",
+                    )
+                )
+        if retrieval.get("probes"):
+            rows.append(
+                (
+                    "live recall probe (sampled)",
+                    f"{retrieval.get('probe_recall', 0):.4f} "
+                    f"({retrieval['probes']:,} probes)",
+                )
+            )
+        sections.append("<h2>Two-stage retrieval</h2>" + _kv_table(rows))
     if hist:
         spark = render_history_rows(hist, "pio_device") or render_history_rows(
             hist, "pio_jit"
@@ -506,7 +545,9 @@ class Dashboard:
                     with urllib.request.urlopen(
                         f"{src.rstrip('/')}/stats.json", timeout=2
                     ) as resp:
-                        block = json.loads(resp.read()).get("device", {})
+                        stats = json.loads(resp.read())
+                        block = stats.get("device", {})
+                        retrieval = stats.get("retrieval")
                 except Exception as e:
                     return Response.error(f"fetch from {src} failed: {e}", 502)
                 hist = _fetch_src_json(src, "/history.json")
@@ -515,10 +556,18 @@ class Dashboard:
                 block = obs_device.device_block()
                 hist = obs_history.snapshot()
                 source = "this dashboard process"
+                try:
+                    from predictionio_tpu.ops import retrieval as _r
+
+                    retrieval = _r.stats_block()
+                except Exception:
+                    retrieval = None
             doc = obs_progress.read_progress()
             progress = doc if obs_progress.is_live(doc) else None
             return Response.html(
-                render_device_panel(block, progress, source, hist=hist)
+                render_device_panel(
+                    block, progress, source, hist=hist, retrieval=retrieval
+                )
             )
 
         @router.route("GET", "/slo")

@@ -712,6 +712,19 @@ class EngineServer:
         predictions = [
             a.predict(m, supplemented) for a, m in zip(algorithms, models)
         ]
+        # drain the two-stage retrieval stage split unconditionally (the
+        # thread-local must not leak into the next query on this thread);
+        # attach sub-spans when this request is traced
+        from predictionio_tpu.ops import retrieval as _retrieval
+
+        split = _retrieval.take_stage_split()
+        if split is not None:
+            tr = obs_trace.current_trace()
+            if tr is not None:
+                ss = split.get("shortlist", 0.0)
+                rs = split.get("rescore", 0.0)
+                tr.add_span("dispatch.shortlist", t0, t0 + ss)
+                tr.add_span("dispatch.rescore", t0 + ss, t0 + ss + rs)
         return self._finish_query(body, query, predictions, serving, t0)
 
     @staticmethod
@@ -828,9 +841,22 @@ class EngineServer:
             t_d1 = time.perf_counter()
             if batcher is not None:
                 batcher._m_dispatch.observe(t_d1 - t_d0)
+            # two-stage retrieval stage split for this dispatch (if the
+            # batch went coarse+rescore): sub-spans let /traces.json show
+            # where dispatch time went without a device round-trip
+            from predictionio_tpu.ops import retrieval as _retrieval
+
+            split = _retrieval.take_stage_split()
             for _, _, tr, _ in items:
                 if tr is not None:
                     tr.add_span(f"batch.dispatch[{n_real}]", t_d0, t_d1)
+                    if split is not None:
+                        ss = split.get("shortlist", 0.0)
+                        rs = split.get("rescore", 0.0)
+                        tr.add_span("dispatch.shortlist", t_d0, t_d0 + ss)
+                        tr.add_span(
+                            "dispatch.rescore", t_d0 + ss, t_d0 + ss + rs
+                        )
         except Exception:
             logger.exception("batched scoring failed; retrying per query")
             per_algo = None
@@ -1050,6 +1076,12 @@ class EngineServer:
             body["obs"] = obs_metrics.stats_block()
             body["device"] = obs_device.device_block()
             body["freshness"] = obs_freshness.block()
+            try:
+                from predictionio_tpu.ops import retrieval as _retrieval
+
+                body["retrieval"] = _retrieval.stats_block()
+            except Exception:  # pragma: no cover - stats must never 500
+                pass
             return Response.json(body)
 
         @router.route("POST", "/queries.json")

@@ -1,0 +1,444 @@
+"""Two-stage retrieval: coarse shortlist + exact rescore vs the exact ops.
+
+The contract under test (ops/retrieval.py): the rescore stage rebuilds
+query vectors and scores exactly like the exact path, so a two-stage
+result equals the exact result whenever the shortlist covers the exact
+top-k — and the shortlist's oversampling buys that coverage across
+storage precisions (f32/bf16/int8), single chip and the virtual 8-device
+mesh. Sub-threshold catalogs must never route through this module at
+all (the byte-parity regression).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops import retrieval
+from predictionio_tpu.ops.als import quantize_rows
+from predictionio_tpu.ops.retrieval import CoarseCatalog
+from predictionio_tpu.ops.topk import (
+    catalog_norms,
+    gather_top_k_batch,
+    sum_rows_top_k_batch,
+    top_k_similar,
+)
+
+
+def _dense(i, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(i, d)).astype(np.float32)
+
+
+def _int8(i, d, seed=0):
+    f = _dense(i, d, seed)
+    q, s = quantize_rows(f)
+    return np.asarray(q), np.asarray(s)
+
+
+def _exact_top(q, v, scales, k):
+    """Numpy exact reference: ids of the top-k dequantized dot scores."""
+    vf = v.astype(np.float32)
+    if scales is not None:
+        vf = vf * scales[:, None]
+    sc = q @ vf.T
+    return np.argsort(-sc, axis=1, kind="stable")[:, :k]
+
+
+def _recall(cand, exact):
+    hits = sum(
+        len(set(cand[b].tolist()) & set(exact[b].tolist()))
+        for b in range(exact.shape[0])
+    )
+    return hits / exact.size
+
+
+class TestShortlistRecall:
+    """Coarse pass coverage across storage modes; tile=256 on a 4096-row
+    catalog forces the scan through 16 tiles (merge path exercised)."""
+
+    @pytest.mark.parametrize("mode", ["bf16", "int8", "int8_dot"])
+    def test_recall_at_default_oversample(self, mode):
+        v, s = _int8(4096, 16, seed=1)
+        q = _dense(8, 16, seed=2)
+        exact = _exact_top(q, v, s, 8)
+        cat = CoarseCatalog((v, s), tile=256, mode=mode)
+        _, cand = cat.shortlist(q, 64)  # 8x oversample of k=8
+        assert cand.shape == (8, 64)
+        assert _recall(cand, exact) >= 0.999
+
+    def test_dense_catalog_bf16_copy(self):
+        v = _dense(2048, 12, seed=3)
+        q = _dense(4, 12, seed=4)
+        exact = _exact_top(q, v, None, 8)
+        cat = CoarseCatalog(v, tile=512)
+        assert cat.mode == "bf16"
+        _, cand = cat.shortlist(q, 64)
+        assert _recall(cand, exact) >= 0.999
+
+    def test_pad_tile_ids_never_returned(self):
+        # 200 rows pad to one 256-wide tile; a 256-wide shortlist has
+        # only 200 eligible rows, so 56 slots per row must come back -1
+        v = _dense(200, 8, seed=5)
+        cat = CoarseCatalog(v, tile=256)
+        _, cand = cat.shortlist(_dense(3, 8, seed=6), 256)
+        valid = cand[cand >= 0]
+        assert valid.max() < 200
+        assert (cand < 0).sum() == 3 * 56
+        for row in cand:
+            vr = row[row >= 0]
+            assert len(set(vr.tolist())) == vr.size  # no duplicates
+
+    def test_shortlist_k_bucketing(self, monkeypatch):
+        monkeypatch.setenv("PIO_RETRIEVAL_OVERSAMPLE", "8")
+        monkeypatch.setenv("PIO_RETRIEVAL_TILE", str(1 << 18))
+        # pow2(8 * pow2(k)); capped by the catalog's pow2 envelope
+        assert retrieval.shortlist_k(5, 1 << 20) == 64
+        assert retrieval.shortlist_k(8, 1 << 20) == 64
+        assert retrieval.shortlist_k(9, 1 << 20) == 128
+        assert retrieval.shortlist_k(8, 100) == 64  # pow2(100) = 128 > 64
+        assert retrieval.shortlist_k(64, 80) == 128  # catalog envelope
+
+    def test_engagement_threshold(self, monkeypatch):
+        monkeypatch.setenv("PIO_RETRIEVAL_THRESHOLD", "1000")
+        assert not retrieval.engaged(999)
+        assert retrieval.engaged(1000)
+        monkeypatch.setenv("PIO_RETRIEVAL_THRESHOLD", "0")
+        assert not retrieval.engaged(10**9)  # <= 0 disables entirely
+
+
+class TestRescoreExactness:
+    """The rescore stage restricted to a full-coverage candidate set
+    must reproduce the exact ops' ranking."""
+
+    def test_rescore_gather_matches_exact(self):
+        for table in (_dense(256, 8, seed=7), _int8(256, 8, seed=7)):
+            quantized = isinstance(table, tuple)
+            U = _dense(32, 8, seed=8)
+            uixs = np.arange(4, dtype=np.int32)
+            es, ei = gather_top_k_batch(uixs, U, table, k=8)
+            # candidates = the whole catalog, shuffled per row
+            rng = np.random.default_rng(9)
+            cand = np.stack([rng.permutation(256) for _ in range(4)]).astype(
+                np.int32
+            )
+            s, ids = retrieval.rescore_gather_top_k_batch(
+                uixs, U, table, cand, k=8
+            )
+            np.testing.assert_array_equal(ids, np.asarray(ei))
+            np.testing.assert_allclose(
+                s, np.asarray(es), rtol=1e-5, atol=1e-6,
+                err_msg=f"quantized={quantized}",
+            )
+
+    def test_rescore_sum_rows_matches_exact(self):
+        table = _int8(200, 8, seed=10)
+        ixs = np.array([[0, 3, 7, 0], [5, 5, 9, 0]], np.int32)
+        w = np.array([[1, 1, 1, 0], [1, 0.5, 1, 0]], np.float32)
+        es, ei = sum_rows_top_k_batch(ixs, w, table, k=8)
+        cand = np.tile(np.arange(200, dtype=np.int32), (2, 1))
+        s, ids = retrieval.rescore_sum_rows_top_k_batch(ixs, w, table, cand, k=8)
+        np.testing.assert_array_equal(ids, np.asarray(ei))
+        np.testing.assert_allclose(s, np.asarray(es), rtol=1e-5, atol=1e-6)
+
+    def test_padded_candidates_report_minus_one(self):
+        v = _dense(64, 4, seed=11)
+        q = _dense(2, 4, seed=12)
+        cand = np.full((2, 16), -1, np.int32)
+        cand[:, :3] = [[1, 2, 3], [10, 11, 12]]
+        s, ids = retrieval.rescore_top_k_batch(q, v, cand, k=8)
+        assert (ids[:, 3:] == -1).all()
+        assert set(ids[0, :3].tolist()) == {1, 2, 3}
+
+    def test_rescore_host_matches_device_rescore(self):
+        v, sc = _int8(128, 8, seed=13)
+        q = _dense(3, 8, seed=14)
+        cand = np.stack(
+            [np.random.default_rng(b).permutation(128)[:32] for b in range(3)]
+        ).astype(np.int32)
+        hs, hi = retrieval.rescore_host(q, v, sc, cand, 8)
+        ds, di = retrieval.rescore_top_k_batch(q, (v, sc), cand, k=8)
+        np.testing.assert_array_equal(hi, di)
+        np.testing.assert_allclose(hs, ds, rtol=1e-5, atol=1e-6)
+
+    def test_near_ties_preserve_score_multiset(self):
+        """Adversarial near-ties: 512 rows drawn from 16 archetypes plus
+        1e-6 noise. Ids may legitimately differ between paths at equal
+        scores, so compare the sorted score arrays instead."""
+        rng = np.random.default_rng(15)
+        arch = rng.normal(size=(16, 8)).astype(np.float32)
+        v = (
+            arch[rng.integers(0, 16, size=512)]
+            + rng.normal(scale=1e-6, size=(512, 8))
+        ).astype(np.float32)
+        q = _dense(4, 8, seed=16)
+        cat = CoarseCatalog(v, tile=128, mode="bf16")
+        _, cand = cat.shortlist(q, 256)
+        s, _ = retrieval.rescore_top_k_batch(q, v, cand, k=16)
+        full = np.tile(np.arange(512, dtype=np.int32), (4, 1))
+        es, _ = retrieval.rescore_top_k_batch(q, v, full, k=16)
+        np.testing.assert_allclose(
+            np.sort(s, axis=1), np.sort(np.asarray(es), axis=1),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestSatelliteOps:
+    def test_sum_rows_accepts_int8_pair(self):
+        vq, vs = _int8(96, 8, seed=17)
+        dense = vq.astype(np.float32) * vs[:, None]
+        ixs = np.array([[0, 5], [9, 9]], np.int32)
+        w = np.ones((2, 2), np.float32)
+        ds, di = sum_rows_top_k_batch(ixs, w, dense, k=8)
+        qs, qi = sum_rows_top_k_batch(ixs, w, (vq, vs), k=8)
+        np.testing.assert_array_equal(np.asarray(qi), np.asarray(di))
+        np.testing.assert_allclose(
+            np.asarray(qs), np.asarray(ds), rtol=1e-5, atol=1e-6
+        )
+
+    def test_top_k_similar_precomputed_norms(self):
+        v = _dense(80, 8, seed=18)
+        norms = catalog_norms(v)
+        np.testing.assert_allclose(
+            np.asarray(norms), np.linalg.norm(v, axis=1), rtol=1e-6
+        )
+        s0, i0 = top_k_similar(v[3], v, 8)
+        s1, i1 = top_k_similar(v[3], v, 8, norms=norms)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(
+            np.asarray(s0), np.asarray(s1), rtol=1e-6
+        )
+
+    def test_cosine_model_tables_stay_quantized(self):
+        from predictionio_tpu.models.similarproduct import SimilarProductModel
+
+        vq, vs = _int8(64, 8, seed=19)
+        m = SimilarProductModel(
+            item_index=BiMap.from_dense([f"i{j}" for j in range(64)]),
+            item_factors=vq, categories={}, item_scales=vs,
+        )
+        table = m.device_factors()
+        assert isinstance(table, tuple)  # int8 catalog not densified
+        assert table[0].dtype == np.int8
+        rows = np.asarray(table[0], np.float32) * np.asarray(table[1])[:, None]
+        np.testing.assert_allclose(
+            np.linalg.norm(rows, axis=1), 1.0, rtol=1e-5
+        )
+        assert m.device_norms().shape == (64,)
+
+
+@pytest.fixture()
+def mesh():
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    return make_mesh([("data", 8)])
+
+
+class TestMeshCoarse:
+    def test_coarse_ring_matches_dense_ranking(self, mesh):
+        from predictionio_tpu.parallel.ring_topk import RingCatalog
+
+        vq, vs = _int8(208, 8, seed=20)  # not divisible by 8: padding
+        q = _dense(5, 8, seed=21)
+        cat = RingCatalog((vq, vs), mesh)
+        es, ei = cat.top_k(q, 8)
+        _, cand = cat.top_k(q, 64, coarse=True)
+        s, ids = retrieval.rescore_host(q, vq, vs, cand, 8)
+        np.testing.assert_array_equal(ids, ei)
+        np.testing.assert_allclose(s, es, rtol=1e-5, atol=1e-6)
+
+    def test_sharded_two_stage_template_parity(self, mesh, monkeypatch):
+        from predictionio_tpu.models import recommendation as rec
+
+        monkeypatch.setenv("PIO_RETRIEVAL_PROBE_EVERY", "1")
+        model = _rec_model(int8=True)
+        algo = rec.ALSAlgorithm(
+            rec.ALSAlgorithmParams(sharded_serving=True)
+        )
+        queries = [(i, rec.Query(user=f"u{i}", num=5)) for i in range(3)]
+        exact = algo.batch_predict(model, queries)
+        monkeypatch.setenv("PIO_RETRIEVAL_THRESHOLD", "64")
+        two = algo.batch_predict(model, queries)
+        _assert_same_results(exact, two)
+
+
+def _rec_model(i=512, d=8, users=16, int8=False, seed=22):
+    from predictionio_tpu.models.recommendation import ALSModel
+
+    U = _dense(users, d, seed=seed)
+    if int8:
+        vq, vs = _int8(i, d, seed=seed + 1)
+        V, S = vq, vs
+    else:
+        V, S = _dense(i, d, seed=seed + 1), None
+    return ALSModel(
+        user_index=BiMap.from_dense([f"u{j}" for j in range(users)]),
+        item_index=BiMap.from_dense([f"i{j}" for j in range(i)]),
+        user_factors=U, item_factors=V, item_scales=S,
+    )
+
+
+def _assert_same_results(exact, two_stage):
+    assert len(exact) == len(two_stage)
+    for (ix_a, ra), (ix_b, rb) in zip(
+        sorted(exact, key=lambda t: t[0]),
+        sorted(two_stage, key=lambda t: t[0]),
+    ):
+        assert ix_a == ix_b
+        la = getattr(ra, "itemScores", None) or getattr(ra, "userScores", [])
+        lb = getattr(rb, "itemScores", None) or getattr(rb, "userScores", [])
+        assert [getattr(x, "item", None) or getattr(x, "user", None)
+                for x in la] == \
+               [getattr(x, "item", None) or getattr(x, "user", None)
+                for x in lb]
+        np.testing.assert_allclose(
+            [x.score for x in la], [x.score for x in lb],
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestTemplateTwoStage:
+    """Each template's batch_predict, exact vs two-stage (threshold
+    forced below the fixture catalogs): identical ids, matching scores.
+    Oversampling at the default factor must cover every exact top-k on
+    these catalogs, so any divergence is a routing/rescore bug."""
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_recommendation(self, monkeypatch, int8):
+        from predictionio_tpu.models import recommendation as rec
+
+        model = _rec_model(int8=int8)
+        algo = rec.ALSAlgorithm(rec.ALSAlgorithmParams())
+        queries = [
+            (0, rec.Query(user="u0", num=5)),
+            (1, rec.Query(user="u3", num=3)),
+            (2, rec.Query(user="zz", num=4)),  # unknown user in batch
+            (3, rec.Query(user="u7", num=8)),
+        ]
+        exact = algo.batch_predict(model, queries)
+        monkeypatch.setenv("PIO_RETRIEVAL_THRESHOLD", "64")
+        monkeypatch.setenv("PIO_RETRIEVAL_TILE", "128")  # multi-tile
+        monkeypatch.setenv("PIO_RETRIEVAL_PROBE_EVERY", "1")
+        before = retrieval.stats_block()["two_stage_queries"]
+        two = algo.batch_predict(model, queries)
+        assert retrieval.stats_block()["two_stage_queries"] > before
+        _assert_same_results(exact, two)
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_similarproduct_with_boundary_exclusions(self, monkeypatch, int8):
+        from predictionio_tpu.models import similarproduct as sp
+
+        n = 512
+        if int8:
+            vq, vs = _int8(n, 8, seed=23)
+        else:
+            vq, vs = _dense(n, 8, seed=23), None
+        model = sp.SimilarProductModel(
+            item_index=BiMap.from_dense([f"i{j}" for j in range(n)]),
+            item_factors=vq, categories={}, item_scales=vs,
+        )
+        algo = sp.ALSAlgorithm(sp.ALSAlgorithmParams())
+        # blackList the exact top results so the answer must come from
+        # DEEPER in the shortlist than the unfiltered top-num
+        probe = algo.batch_predict(
+            model, [(0, sp.Query(items=["i0"], num=6))]
+        )[0][1]
+        top_ids = [x.item for x in probe.itemScores]
+        queries = [
+            (0, sp.Query(items=["i0"], num=4, blackList=top_ids)),
+            (1, sp.Query(items=["i1", "i2"], num=5)),
+            (2, sp.Query(items=["i3"], num=3, whiteList=[f"i{j}" for j in range(40)])),
+        ]
+        exact = algo.batch_predict(model, queries)
+        monkeypatch.setenv("PIO_RETRIEVAL_THRESHOLD", "64")
+        monkeypatch.setenv("PIO_RETRIEVAL_TILE", "128")
+        two = algo.batch_predict(model, queries)
+        _assert_same_results(exact, two)
+        # the blackListed query's answers must avoid the exact top ids
+        got = [x.item for x in dict(two)[0].itemScores]
+        assert not set(got) & set(top_ids)
+
+    def test_recommendeduser(self, monkeypatch):
+        from predictionio_tpu.models import recommendeduser as ru
+
+        n = 512
+        vq, vs = _int8(n, 8, seed=24)
+        model = ru.RecommendedUserModel(
+            followed_index=BiMap.from_dense([f"u{j}" for j in range(n)]),
+            followed_factors=vq, followed_scales=vs,
+        )
+        algo = ru.ALSAlgorithm(ru.ALSAlgorithmParams())
+        queries = [
+            (0, ru.Query(users=["u0", "u1"], num=5)),
+            (1, ru.Query(users=["u2"], num=4, blackList=["u5", "u6"])),
+        ]
+        exact = algo.batch_predict(model, queries)
+        monkeypatch.setenv("PIO_RETRIEVAL_THRESHOLD", "64")
+        monkeypatch.setenv("PIO_RETRIEVAL_TILE", "128")
+        two = algo.batch_predict(model, queries)
+        _assert_same_results(exact, two)
+
+    def test_ecommerce(self, monkeypatch):
+        from predictionio_tpu.models import ecommerce as ec
+
+        n = 512
+        model = ec.ECommModel(
+            user_index=BiMap.from_dense([f"u{j}" for j in range(8)]),
+            item_index=BiMap.from_dense([f"i{j}" for j in range(n)]),
+            user_factors=_dense(8, 8, seed=25),
+            item_factors=_dense(n, 8, seed=26),
+            categories={f"i{j}": ["c0"] for j in range(0, n, 2)},
+        )
+        algo = ec.ECommAlgorithm(
+            ec.ECommAlgorithmParams(unseen_only=False)
+        )
+        queries = [
+            (0, ec.Query(user="u0", num=5)),
+            (1, ec.Query(user="u1", num=4, blackList=["i3"])),
+            (2, ec.Query(user="u2", num=3, categories=["c0"])),  # complex
+        ]
+        exact = algo.batch_predict(model, queries)
+        monkeypatch.setenv("PIO_RETRIEVAL_THRESHOLD", "64")
+        monkeypatch.setenv("PIO_RETRIEVAL_TILE", "128")
+        before = retrieval.stats_block()["exact_queries"]
+        two = algo.batch_predict(model, queries)
+        # the categories query stays on the exact masked path, counted
+        assert retrieval.stats_block()["exact_queries"] > before
+        _assert_same_results(exact, two)
+
+
+class TestSubThresholdParity:
+    def test_small_catalogs_never_touch_two_stage(self):
+        """Regression pin for the byte-parity suites: below the default
+        threshold the two-stage counter must not move and results flow
+        through the unchanged exact ops."""
+        from predictionio_tpu.models import recommendation as rec
+
+        model = _rec_model(i=128)
+        algo = rec.ALSAlgorithm(rec.ALSAlgorithmParams())
+        before = retrieval.stats_block()["two_stage_queries"]
+        out = algo.batch_predict(
+            model, [(0, rec.Query(user="u0", num=4))]
+        )
+        assert retrieval.stats_block()["two_stage_queries"] == before
+        assert len(out[0][1].itemScores) == 4
+
+    def test_stats_block_shape(self):
+        block = retrieval.stats_block()
+        assert {"threshold", "oversample", "two_stage_queries",
+                "exact_queries", "shortlist_size", "probe_recall"} <= set(block)
+
+
+class TestStageSplit:
+    def test_take_stage_split_drains(self):
+        v = _dense(300, 8, seed=27)
+        cat = CoarseCatalog(v, tile=256)
+        retrieval.take_stage_split()  # drain anything earlier
+        _, cand = cat.shortlist(_dense(2, 8, seed=28), 32)
+        retrieval.rescore_top_k_batch(_dense(2, 8, seed=28), v, cand, k=8)
+        split = retrieval.take_stage_split()
+        assert split is not None
+        assert split.get("shortlist", 0) > 0
+        assert split.get("rescore", 0) > 0
+        assert retrieval.take_stage_split() is None  # drained
