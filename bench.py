@@ -2816,6 +2816,10 @@ def _compact_summary(result: dict) -> dict:
             "chaos_fired": sum(ps.get("chaos", {}).get("fired", {}).values()),
             "slo_states": ps.get("slo", {}).get("states"),
             "incidents": ps.get("incidents", {}).get("count"),
+            "restarts": ps.get("restarts"),
+            "rolling_restart_failed_requests": ps.get(
+                "rolling_restart_failed_requests"
+            ),
             "ok": ps.get("ok"),
         }
     errors = sorted(
@@ -2941,6 +2945,174 @@ def bench_serving_smoke(result: dict) -> None:
         set_storage(None)
 
 
+def _prod_supervised_crash(tmp: str, smoke: bool) -> dict:
+    """Supervised-child-crash phase of the production_stack scenario: a
+    real ``pio deploy`` child on zero-config sqlite storage runs under
+    the fleet supervisor (server/supervisor.py), gets kill -9'd, and
+    must be back serving byte-identical answers with the restart
+    recorded and the retry scheduled on the backoff policy."""
+    import http.client
+    import signal
+    import socket
+    import subprocess
+    import sys as _sys
+
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.models import recommendation
+    from predictionio_tpu.server import supervisor as sup_mod
+
+    subtmp = os.path.join(tmp, "supervised")
+    os.makedirs(subtmp, exist_ok=True)
+    # zero-config storage (sqlite + localfs under PIO_FS_BASEDIR): ONE
+    # env knob both this parent and the spawned `pio deploy` child
+    # resolve the same on-disk repositories from
+    storage = Storage(env={"PIO_FS_BASEDIR": subtmp})
+    app_id = storage.get_metadata_apps().insert(App(0, "SuperStack"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(SEED + 1)
+    n = 600 if smoke else 2000
+    events.batch_insert(
+        [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties={"rating": float(r)},
+            )
+            for u, i, r in zip(
+                rng.integers(0, 50, n),
+                rng.integers(0, 30, n),
+                rng.integers(1, 6, n),
+            )
+        ],
+        app_id,
+    )
+    engine = recommendation.engine()
+    variant = {
+        "id": "super-stack",
+        "engineFactory": "predictionio_tpu.models.recommendation.engine",
+        "datasource": {"params": {"app_name": "SuperStack"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 4, "num_iterations": 2}}],
+    }
+    vfile = os.path.join(subtmp, "variant.json")
+    with open(vfile, "w") as f:
+        json.dump(variant, f)
+    # the recommendation datasource resolves the app through the global
+    # storage singleton (store.app_name_to_id); point it at this phase's
+    # sqlite store for the train, then restore the scenario's binding
+    prev_storage = storage_mod._instance
+    storage_mod.set_storage(storage)
+    try:
+        run_train(
+            engine, engine.params_from_variant(variant),
+            engine_id="super-stack",
+            engine_variant=os.path.basename(vfile),  # deploy's lookup label
+            engine_factory=variant["engineFactory"],
+            workflow_params=WorkflowParams(batch="bench"),
+            storage=storage,
+        )
+    finally:
+        storage_mod.set_storage(prev_storage)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    child_env = dict(os.environ)
+    child_env.pop("PIO_FAULTS", None)  # chaos stays in the parent
+    child_env["PIO_FS_BASEDIR"] = subtmp
+    child_env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    child_env["PYTHONPATH"] = (
+        repo + os.pathsep + child_env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    # persistent compile cache: the respawn skips XLA recompiles, so
+    # recovery is backoff + boot, not backoff + compile
+    child_env.setdefault(
+        "PIO_COMPILATION_CACHE_DIR", os.path.join(subtmp, "jit_cache")
+    )
+
+    def spawn():
+        log = open(os.path.join(subtmp, "child.log"), "ab")
+        try:
+            return subprocess.Popen(
+                [_sys.executable, "-m", "predictionio_tpu.cli.main",
+                 "deploy", "--variant", vfile,
+                 "--ip", "127.0.0.1", "--port", str(port), "--reuse-port"],
+                stdout=log, stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL, start_new_session=True,
+                env=child_env,
+            )
+        finally:
+            log.close()
+
+    sup = sup_mod.Supervisor(
+        [sup_mod.ServiceSpec(
+            name="engine-child", port=port, spawn=spawn,
+            boot_timeout_s=240.0,
+        )],
+        poll_interval=0.1, base_backoff_s=0.3, max_backoff_s=3.0,
+        flap_max=10, seed=5,
+    )
+    block: dict = {}
+    try:
+        sup.start_all(wait_healthy_s=240.0)
+        child = sup._children[0]
+        assert child.state == sup_mod.UP, (
+            f"supervised child never booted: {child.last_exit}"
+        )
+
+        def fetch() -> bytes:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            try:
+                conn.request(
+                    "POST", "/queries.json",
+                    body=json.dumps({"user": "u3", "num": 3}),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                body = resp.read()
+                assert resp.status == 200, body[:200]
+                return body
+            finally:
+                conn.close()
+
+        baseline = fetch()
+        first_boot = child.instance
+        t_kill = time.perf_counter()
+        os.kill(child.pid, signal.SIGKILL)
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            sup.step()
+            if (
+                child.state == sup_mod.UP
+                and child.restarts == 1
+                and child.instance != first_boot
+            ):
+                break
+            time.sleep(0.1)
+        recover_s = time.perf_counter() - t_kill
+        assert child.state == sup_mod.UP and child.restarts == 1, (
+            f"kill -9'd child not restarted: state={child.state} "
+            f"restarts={child.restarts} last_exit={child.last_exit}"
+        )
+        after = fetch()
+        block.update(
+            restarts=child.restarts,
+            recover_s=round(recover_s, 2),
+            backoff_s=child.last_backoff_s,
+            last_exit=child.last_exit,
+            byte_parity=(after == baseline),
+            response_bytes=len(baseline),
+        )
+    finally:
+        sup.stop()
+    return block
+
+
 def bench_production_stack(result: dict, smoke: bool = False) -> None:
     """Everything on, under chaos: a trained engine serving closed-loop
     load while an HTTP ingest burst lands in the event server, the speed
@@ -3064,15 +3236,23 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
                 .get_latest_completed("prod-stack", "0", "default")
 
         inst = _train()
+        # explicit port + SO_REUSEPORT: the rolling-restart phase below
+        # overlaps a replacement listener on the same port (both ends of
+        # the handoff must set the flag, including this FIRST bind)
+        import socket as _socket
+
+        with _socket.socket() as _s:
+            _s.bind(("127.0.0.1", 0))
+            eport = _s.getsockname()[1]
         engine_server = EngineServer(
-            engine, inst, storage=storage, host="127.0.0.1", port=0,
-            batch_window_ms=5.0,
+            engine, inst, storage=storage, host="127.0.0.1", port=eport,
+            batch_window_ms=5.0, reuse_port=True,
         )
         event_server = EventServer(
             storage=storage, host="127.0.0.1", port=0
         )
         servers = [engine_server, event_server]
-        eport = engine_server.start(background=True)
+        engine_server.start(background=True)
         iport = event_server.start(background=True)
 
         from predictionio_tpu.realtime.speed_layer import SpeedLayer
@@ -3216,6 +3396,49 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             f"http://127.0.0.1:{eport}/reload", {}, timeout=60
         )
 
+        # zero-downtime rolling restart under load: the retrained
+        # instance comes up as a SECOND EngineServer on the same
+        # SO_REUSEPORT port, must pass /readyz, then the old instance
+        # drains out (its shutdown hook stops the old speed layer,
+        # persisting the tailer cursor) — all while the closed-loop
+        # serving ladder keeps firing and the chaos plan stays armed.
+        # The gate demands zero failed requests across the handoff.
+        from predictionio_tpu.cli import daemon as pio_daemon
+
+        inst2 = storage.get_metadata_engine_instances()\
+            .get_latest_completed("prod-stack", "0", "default")
+        old_instance = engine_server.app.instance_id
+        errors_before_roll = len(serving_errors)
+        rounds_before_roll = len(serving_rounds)
+        t_roll0 = time.perf_counter()
+        engine_server2 = EngineServer(
+            engine, inst2, storage=storage, host="127.0.0.1", port=eport,
+            batch_window_ms=5.0, reuse_port=True,
+        )
+        servers.append(engine_server2)
+        engine_server2.warmup()  # ready gate opens only post-warmup
+        engine_server2.start(background=True)
+        ready = pio_daemon.wait_ready(
+            "127.0.0.1", eport, timeout=60.0, not_instance=old_instance,
+        )
+        assert ready is not None, "replacement engine never turned ready"
+        engine_server.drain()
+        roll_s = time.perf_counter() - t_roll0
+        layer = SpeedLayer(
+            engine_server2, interval=fold_interval,
+            cursor_path=os.path.join(tmp, "cursor.json"),
+        )
+        layer.start()
+        engine_server = engine_server2
+        # let at least one full closed-loop round cross the handoff so
+        # the zero-failures gate actually measured post-roll traffic
+        deadline = time.time() + (30 if smoke else 60)
+        while time.time() < deadline:
+            if len(serving_rounds) > rounds_before_roll + 1 or serving_errors:
+                break
+            time.sleep(0.2)
+        rolling_failed = len(serving_errors) - errors_before_roll
+
         stop_serving.set()
         serve_t.join(timeout=180)
         run_s = time.perf_counter() - t_run0
@@ -3229,6 +3452,11 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             if (layer.tailer.events_behind() or 0) == 0:
                 break
             time.sleep(0.2)
+
+        # supervised-child-crash drill: a real `pio deploy` child under
+        # the fleet supervisor survives kill -9 with the restart
+        # recorded and byte-identical answers
+        supervised = _prod_supervised_crash(tmp, smoke)
 
         fire_counts = {
             point: plan.fire_count(point) for point in chaos_points
@@ -3339,6 +3567,17 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
                 "last_commit": obs_freshness.block().get("last_commit"),
             },
             "reload": reload_resp,
+            "rolling_restart": {
+                "roll_s": round(roll_s, 2),
+                "old_instance": old_instance,
+                "new_instance": ready["instance"] if ready else None,
+                "rounds_before": rounds_before_roll,
+                "rounds_after": len(serving_rounds) - rounds_before_roll,
+                "failed_requests": rolling_failed,
+            },
+            "rolling_restart_failed_requests": rolling_failed,
+            "supervised": supervised,
+            "restarts": supervised.get("restarts", 0),
             "chaos": {"plan": chaos, "fired": fire_counts},
             "slo": {"states": slo_states, "alerts": alerts},
             "incidents": incident_block,
@@ -3367,6 +3606,18 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             f"seconds_behind {gauges['seconds_behind']} over budget"
         )
         assert foldin_epoch_peak > 0, "speed layer never patched the model"
+        assert rolling_failed == 0, (
+            f"rolling restart dropped requests: {serving_errors}"
+        )
+        assert len(serving_rounds) > rounds_before_roll, (
+            "no closed-loop round crossed the rolling-restart handoff"
+        )
+        assert supervised.get("restarts") == 1, (
+            f"supervised crash drill incomplete: {supervised}"
+        )
+        assert supervised.get("byte_parity"), (
+            f"restarted child served different bytes: {supervised}"
+        )
         assert sum(fire_counts.values()) > 0, "chaos plan never fired"
         assert incident_block.get("bundle"), (
             "armed chaos tripped no incident bundle"

@@ -26,7 +26,7 @@ __all__ = ["compare", "leaf_direction", "format_report", "main"]
 _LOWER_BETTER = (
     "_ms", "_s", "_us", "_ns", "_seconds", "p50", "p99", "p90",
     "latency", "behind", "rss", "overhead", "cost", "lost", "rmse",
-    "compiles", "_pct",
+    "compiles", "_pct", "failed", "restarts",
 )
 _HIGHER_BETTER = (
     "per_s", "qps", "speedup", "events", "throughput", "hit_rate",

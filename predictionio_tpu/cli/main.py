@@ -125,9 +125,37 @@ def cmd_status(args) -> int:
     line = _training_line()
     if line:
         print(line)
+    for line in _supervisor_lines():
+        print(line)
     for line in _slo_lines():
         print(line)
     return 0
+
+
+def _supervisor_lines() -> list[str]:
+    """Human supervisor lines for ``pio status``, one per supervised
+    service: ``supervisor[engine]: up (restarts 1)`` — with the last
+    exit reason and next-retry ETA when it is mid-backoff or broken."""
+    from predictionio_tpu.server import supervisor as sup_mod
+
+    doc = sup_mod.read_state()
+    if doc is None:
+        return []
+    lines: list[str] = []
+    stale = "" if doc.get("live") else " [supervisor not running]"
+    for name, s in (doc.get("services") or {}).items():
+        parts = [f"restarts {s.get('restarts', 0)}"]
+        if s.get("pid"):
+            parts.append(f"pid {s['pid']}")
+        if s.get("last_exit") and s.get("state") != "up":
+            parts.append(f"last exit: {s['last_exit']}")
+        if s.get("next_retry_in_s") is not None:
+            parts.append(f"retry in {s['next_retry_in_s']}s")
+        lines.append(
+            f"supervisor[{name}]: {s.get('state', '?')} "
+            f"({', '.join(parts)}){stale}"
+        )
+    return lines
 
 
 def _training_progress() -> dict | None:
@@ -512,6 +540,13 @@ def _status_json() -> int:
                 pass
         services[name] = entry
     summary: dict = {"services": services}
+    # self-healing supervisor state (supervisor.json), when a fleet ran
+    # (or runs) under `pio start-all --supervise`
+    from predictionio_tpu.server import supervisor as sup_mod
+
+    sup_doc = sup_mod.read_state()
+    if sup_doc is not None:
+        summary["supervisor"] = sup_doc
     # the SLO alert ring across services, oldest->newest, each record
     # tagged with the daemon it came from (satellite: alerts were
     # counted but not inspectable without scraping /slo.json)
@@ -1073,13 +1108,18 @@ def cmd_run(args) -> int:
 
 def cmd_start_all(args) -> int:
     """Bring up the service fleet as detached daemons (reference
-    bin/pio-start-all; see cli/daemon.py for the process model)."""
+    bin/pio-start-all; see cli/daemon.py for the process model).
+    With ``--supervise`` the fleet runs under a foreground supervisor
+    (server/supervisor.py) that restarts crashed children with backoff."""
     from predictionio_tpu.cli import daemon
 
+    # --reuse-port on the HTTP services so `pio rolling-restart` can
+    # overlap a replacement instance on the same port later
     plan: list[tuple[str, list[str], int]] = [
         (
             "eventserver",
-            ["eventserver", "--ip", args.ip, "--port", str(args.event_port)]
+            ["eventserver", "--ip", args.ip, "--port", str(args.event_port),
+             "--reuse-port"]
             + (["--stats"] if args.stats else []),
             args.event_port,
         )
@@ -1104,7 +1144,8 @@ def cmd_start_all(args) -> int:
         # beyond the reference's script: also deploy the latest trained
         # engine so one verb yields a fully queryable stack. Paths go
         # absolute — the daemon child's cwd is not this shell's.
-        deploy = ["deploy", "--ip", args.ip, "--port", str(args.engine_port)]
+        deploy = ["deploy", "--ip", args.ip, "--port", str(args.engine_port),
+                  "--reuse-port"]
         if args.variant:
             deploy += ["--variant", os.path.abspath(args.variant)]
         if args.engine_factory:
@@ -1112,6 +1153,9 @@ def cmd_start_all(args) -> int:
         if args.engine_dir:
             deploy += ["--engine-dir", os.path.abspath(args.engine_dir)]
         plan.append(("engine", deploy, args.engine_port))
+
+    if getattr(args, "supervise", False):
+        return _run_supervised(args, plan)
 
     started: list[str] = []
     for name, argv, port in plan:
@@ -1126,6 +1170,76 @@ def cmd_start_all(args) -> int:
         started.append(name)
         print(f"{name}: up on port {port} (pid {pid})")
     print(f"Run dir: {daemon.run_dir()}")
+    return 0
+
+
+def _run_supervised(args, plan) -> int:
+    """``pio start-all --supervise`` / ``pio supervise``: run the fleet
+    under the self-healing supervisor in the FOREGROUND (the supervisor
+    is the thing an init system or terminal owns; its children are the
+    detached daemons). SIGTERM/SIGINT request an orderly reverse-order
+    stop — each child gets a drain-grace SIGTERM first."""
+    import signal
+
+    from predictionio_tpu.cli import daemon
+    from predictionio_tpu.server import supervisor as sup_mod
+
+    host = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+    specs = [
+        sup_mod.ServiceSpec(name=name, argv=argv, host=host, port=port)
+        for name, argv, port in plan
+    ]
+    sup = sup_mod.Supervisor(specs)
+
+    def _request_stop(signum, _frame):
+        sup.request_stop()
+
+    signal.signal(signal.SIGTERM, _request_stop)
+    signal.signal(signal.SIGINT, _request_stop)
+
+    stats = None
+    stats_port = getattr(args, "supervise_port", 0) or 0
+    if stats_port:
+        stats = sup_mod.stats_app(sup, host=host, port=stats_port)
+        stats.start(background=True)
+        print(f"supervisor: stats on http://{host}:{stats_port}/stats.json")
+    try:
+        sup.start_all()
+    except Exception as e:
+        print(f"supervise: {e}", file=sys.stderr)
+        sup.stop()
+        if stats is not None:
+            stats.stop()
+        return 1
+    for name, doc in sup.services().items():
+        print(
+            f"{name}: {doc['state']} on port {doc['port']} (pid {doc['pid']})"
+        )
+    print(f"Run dir: {daemon.run_dir()} (supervised; ^C or SIGTERM to stop)")
+    try:
+        sup.run()
+    finally:
+        if stats is not None:
+            stats.stop()
+    return 0
+
+
+def cmd_rolling_restart(args) -> int:
+    """``pio rolling-restart <service>``: zero-downtime replacement of a
+    recorded daemon — new instance overlaps on the same port via
+    SO_REUSEPORT, must pass /readyz, then the old one drains out."""
+    from predictionio_tpu.cli import daemon
+
+    try:
+        info = daemon.rolling_restart(args.service, wait=args.wait)
+    except RuntimeError as e:
+        print(f"rolling-restart: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"{info['service']}: rolled pid {info['old_pid']} -> "
+        f"{info['new_pid']} on port {info['port']} "
+        f"(instance {info['instance']})"
+    )
     return 0
 
 
@@ -1510,19 +1624,53 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("args", nargs="*")
     r.set_defaults(fn=cmd_run)
 
+    def _fleet_args(parser) -> None:
+        parser.add_argument("--ip", default="0.0.0.0")
+        parser.add_argument("--event-port", type=int, default=7070)
+        parser.add_argument("--dashboard-port", type=int, default=9000)
+        parser.add_argument("--admin-port", type=int, default=7071)
+        parser.add_argument("--engine-port", type=int, default=8000)
+        parser.add_argument("--stats", action="store_true")
+        parser.add_argument("--no-dashboard", action="store_true")
+        parser.add_argument("--no-adminserver", action="store_true")
+        parser.add_argument("--variant", help="also deploy this engine variant")
+        parser.add_argument(
+            "--engine-factory", help="also deploy this engine factory"
+        )
+        parser.add_argument(
+            "--engine-dir", help="also deploy the engine in this dir"
+        )
+        parser.add_argument(
+            "--supervise-port", type=int, default=0,
+            help="with --supervise: serve supervisor /stats.json and "
+            "/metrics on this port",
+        )
+
     sa = sub.add_parser("start-all")
-    sa.add_argument("--ip", default="0.0.0.0")
-    sa.add_argument("--event-port", type=int, default=7070)
-    sa.add_argument("--dashboard-port", type=int, default=9000)
-    sa.add_argument("--admin-port", type=int, default=7071)
-    sa.add_argument("--engine-port", type=int, default=8000)
-    sa.add_argument("--stats", action="store_true")
-    sa.add_argument("--no-dashboard", action="store_true")
-    sa.add_argument("--no-adminserver", action="store_true")
-    sa.add_argument("--variant", help="also deploy this engine variant")
-    sa.add_argument("--engine-factory", help="also deploy this engine factory")
-    sa.add_argument("--engine-dir", help="also deploy the engine in this dir")
+    _fleet_args(sa)
+    sa.add_argument(
+        "--supervise", action="store_true",
+        help="stay in the foreground and restart crashed services "
+        "with backoff (see docs/operations.md)",
+    )
     sa.set_defaults(fn=cmd_start_all)
+
+    sv = sub.add_parser(
+        "supervise", help="start-all under the self-healing supervisor"
+    )
+    _fleet_args(sv)
+    sv.set_defaults(fn=cmd_start_all, supervise=True)
+
+    rr = sub.add_parser(
+        "rolling-restart",
+        help="zero-downtime replacement of one recorded service",
+    )
+    rr.add_argument("service", help="a service name from `pio status`")
+    rr.add_argument(
+        "--wait", type=float, default=90.0,
+        help="seconds to wait for the replacement's /readyz (default 90)",
+    )
+    rr.set_defaults(fn=cmd_rolling_restart)
 
     sub.add_parser("stop-all").set_defaults(fn=cmd_stop_all)
 

@@ -34,6 +34,24 @@ HALF_OPEN = "half_open"
 _STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
 
 
+def backoff_interval(
+    attempt: int,
+    *,
+    base_s: float,
+    max_s: float,
+    jitter: float,
+    rng: random.Random,
+) -> float:
+    """The shared retry-backoff policy: ``base * 2^(attempt-1)`` capped
+    at ``max_s``, then jittered by ``±jitter``. ``attempt`` is 1-based
+    (attempt 1 waits ~``base_s``). Used by the breaker's open interval
+    and the fleet supervisor's crash-restart schedule — one policy, one
+    set of semantics to reason about."""
+    raw = base_s * (2 ** max(0, attempt - 1))
+    raw = min(raw, max_s)
+    return raw * (1.0 + jitter * rng.uniform(-1.0, 1.0))
+
+
 class CircuitBreaker:
     def __init__(
         self,
@@ -88,9 +106,13 @@ class CircuitBreaker:
 
     def backoff_s(self) -> float:
         """Current open-interval length: base * 2^(opens-1), jittered."""
-        raw = self.base_backoff_s * (2 ** max(0, self._opens - 1))
-        raw = min(raw, self.max_backoff_s)
-        return raw * (1.0 + self.jitter * self._rng.uniform(-1.0, 1.0))
+        return backoff_interval(
+            self._opens,
+            base_s=self.base_backoff_s,
+            max_s=self.max_backoff_s,
+            jitter=self.jitter,
+            rng=self._rng,
+        )
 
     def allow(self) -> bool:
         """May the guarded operation run now? OPEN: no until the backoff

@@ -198,6 +198,15 @@ class CoalescerMap:
             committers = list(self._map.values())
         return sum(c.backlog() for c in committers)
 
+    def sync_all(self) -> None:
+        """Force-fsync every registered log now — the graceful-shutdown
+        flush (server drain hooks): nothing acked may be lost to an
+        uncovered coalescer window when the process exits."""
+        with self._lock:
+            items = list(self._map.items())
+        for key, committer in items:
+            committer.sync_now(key)
+
     def _interval_loop(self) -> None:
         while not self._stop.wait(self._interval):
             with self._lock:

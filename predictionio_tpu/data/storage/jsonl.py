@@ -550,6 +550,10 @@ class JSONLEvents(base.Events):
         by an fsync (the event server's backpressure/stats probe)."""
         return self._c.committers.backlog()
 
+    def sync_commits(self) -> None:
+        """Force-fsync every open log now (drain-time flush)."""
+        self._c.committers.sync_all()
+
     def append_jsonl(
         self, blob: bytes, app_id: int, channel_id: int | None = None
     ) -> None:

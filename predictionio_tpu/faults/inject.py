@@ -78,6 +78,10 @@ KNOWN_POINTS: dict[str, str] = {
                        "(single-chip and sharded)",
     "train.checkpoint": "ALS checkpoint snapshot write",
     "foldin.fold": "speed-layer incremental fold-in solve",
+    "http.drain": "graceful-drain entry on an HTTP server "
+                  "(HTTPApp.begin_drain)",
+    "supervisor.spawn": "fleet-supervisor child (re)spawn "
+                        "(server/supervisor.py)",
 }
 
 _EXCEPTIONS: dict[str, type[BaseException]] = {

@@ -337,6 +337,13 @@ class SpeedLayer:
         self._thread = None
         if t is not None and t.is_alive():
             t.join(timeout=10)
+        # graceful-drain contract: the cursor must be on disk before the
+        # process exits so the replacement instance re-attaches exactly
+        # where this one left off
+        try:
+            self.tailer.persist()
+        except OSError:  # pragma: no cover - disk error at exit
+            logger.exception("tailer cursor persist on stop failed")
 
     def _loop(self) -> None:
         while not self._stop.is_set():

@@ -210,6 +210,13 @@ class EventTailer:
         faults.fault_point("storage.rename")
         os.replace(tmp, self._cursor_path)
 
+    def persist(self) -> None:
+        """Force the cursor to disk if it moved since the last save —
+        the graceful-shutdown flush (the speed layer calls this on
+        stop so a drained process re-attaches exactly where it left
+        off instead of re-delivering the last batch window)."""
+        self._save()
+
     # -- polling ------------------------------------------------------------
 
     def poll(self, limit: int | None = None) -> list[Event]:

@@ -463,7 +463,11 @@ class EngineServer:
             ),
             reuse_port=reuse_port,
             name="engine",
+            ready_check=self._ready_reason,
         )
+        # drain-time flush: the speed layer persists its tailer cursor
+        # and the batcher stops dispatching before the loop exits
+        self.app.add_shutdown_hook(self._drain_flush)
 
     def _load(self, instance: EngineInstance) -> None:
         engine_params, algorithms, models, serving = prepare_deploy(
@@ -1242,10 +1246,30 @@ class EngineServer:
             self._swapping.clear()
         return warmed
 
+    def _ready_reason(self) -> str | None:
+        """The engine half of ``/readyz`` (the HTTPApp adds the draining
+        check): warmup/model-swap fencing and a loaded model."""
+        if self._swapping.is_set():
+            return "model swap/warmup in progress"
+        if not self.models:
+            return "no model loaded"
+        return None
+
+    def _drain_flush(self) -> None:
+        if self.speed_layer is not None:
+            self.speed_layer.stop()
+        if self.batcher is not None:
+            self.batcher.stop()
+
     def start(self, background: bool = True) -> int:
         port = self.app.start(background=background)
         logger.info("Engine Server listening on %s:%d", self.host, port)
         return port
+
+    def drain(self) -> None:
+        """Graceful shutdown: finish in-flight queries, flush the speed
+        layer's cursor, then stop."""
+        self.app.drain()
 
     def stop(self) -> None:
         if self.speed_layer is not None:

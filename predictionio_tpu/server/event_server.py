@@ -195,7 +195,11 @@ class EventServer:
             port=port,
             reuse_port=reuse_port,
             name="eventserver",
+            ready_check=self._ready_reason,
         )
+        # drain-time flush: force-fsync the group-commit coalescers so
+        # every acked event is durable before the process exits
+        self.app.add_shutdown_hook(self._drain_flush)
 
     # -- auth --------------------------------------------------------------
     def _auth(self, request: Request) -> AuthData | Response:
@@ -312,6 +316,26 @@ class EventServer:
                         auth.app_id, 201, event.event, event.entity_type
                     )
         return results
+
+    # -- health/drain -------------------------------------------------------
+    def _ready_reason(self) -> str | None:
+        """Readiness gate: the event server is ready iff its events
+        backend answers (storage reachable)."""
+        try:
+            self.storage.get_events()
+        except Exception as exc:  # pragma: no cover - backend-specific
+            return f"storage unreachable: {exc}"
+        return None
+
+    def _drain_flush(self) -> None:
+        """Graceful-shutdown hook: force-fsync any group-commit backlog
+        so every acked event is durable before the process exits."""
+        try:
+            fn = getattr(self.storage.get_events(), "sync_commits", None)
+            if fn is not None:
+                fn()
+        except Exception:  # pragma: no cover - disk error at exit
+            logger.exception("drain-time event flush failed")
 
     # -- wire-speed binary ingest -------------------------------------------
     def _queue_depth(self) -> float:

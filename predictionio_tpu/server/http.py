@@ -34,9 +34,11 @@ import os
 import re
 import select as select_mod
 import selectors
+import signal as signal_mod
 import socket
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from http.client import responses as _RESPONSES
 from typing import Any, Callable
@@ -718,6 +720,16 @@ class _Connection:
             body=body,
             body_stream=body_stream,
         )
+        draining = app._draining.is_set()
+        if draining and time.monotonic() >= app._drain_deadline:
+            # past the drain deadline: shed without dispatching (a
+            # stream-route body may still be on the socket — the close
+            # below resynchronizes the stream)
+            self.close_connection = True
+            shed = Response.error("draining", 503)
+            shed.headers["Connection"] = "close"
+            self._send(shed)
+            return
         tr = None
         t_parsed = 0.0
         if obs_metrics.enabled():
@@ -773,6 +785,15 @@ class _Connection:
                     self.close_connection = True
             else:
                 self.close_connection = True
+        if draining or app._draining.is_set():
+            # within the drain window (re-checked at send time: drain
+            # may have begun while this request was in flight): the
+            # request is served normally, but the connection is handed
+            # back to the client closed so its NEXT request reconnects
+            # (and lands on whichever listener still accepts — the
+            # rolling-restart handoff)
+            response.headers.setdefault("Connection", "close")
+            self.close_connection = True
         if tr is not None:
             # bookkeeping runs BEFORE the response bytes leave:
             # once the client unblocks it starts contending for
@@ -903,6 +924,20 @@ class _EventLoop:
     def stop(self) -> None:
         self._stopping = True
         self._wakeup()
+
+    def close_listener(self) -> None:
+        """Stop accepting (loop thread only — reach it via
+        ``call_soon``). Parked keep-alive connections stay registered;
+        with ``SO_REUSEPORT`` the kernel routes new connections to the
+        remaining same-port listeners."""
+        try:
+            self.selector.unregister(self.lsock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            self.lsock.close()
+        except OSError:
+            pass
 
     def _wakeup(self) -> None:
         try:
@@ -1051,6 +1086,7 @@ class HTTPApp:
         recv_buffer: bool = True,
         name: str = "server",
         handler_threads: int = 16,
+        ready_check: "Callable[[], str | None] | None" = None,
     ):
         self.router = router
         self.host = host
@@ -1102,6 +1138,141 @@ class HTTPApp:
         self._thread: threading.Thread | None = None
         self._conns: set[_Connection] = set()
         self._conns_lock = threading.Lock()
+        # -- graceful lifecycle (liveness/readiness + drain) --------------
+        # per-boot identity: lets a health probe tell THIS instance from
+        # a foreign or stale listener on the same port
+        self.instance_id = uuid.uuid4().hex[:12]
+        # returns None when ready, else a human-readable reason — the
+        # server-specific half of /readyz (warmup, model, storage)
+        self.ready_check = ready_check
+        self._draining = threading.Event()
+        self._drain_deadline = float("inf")
+        self._shutdown_hooks: list[Callable[[], None]] = []
+        self._hooks_ran = False
+        self._active = 0  # connections currently inside a worker
+        router.add("GET", "/healthz", self._healthz_route)
+        router.add("GET", "/readyz", self._readyz_route)
+
+    # -- liveness / readiness ----------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def _healthz_route(self, _req: Request) -> Response:
+        """Liveness: the process is up and the loop answers. Returns the
+        per-boot instance id so callers can verify WHICH listener
+        answered (fixes the raw-TCP foreign-listener TOCTOU)."""
+        return Response.json({
+            "status": "ok",
+            "server": self.name,
+            "instance": self.instance_id,
+            "pid": os.getpid(),
+            "draining": self._draining.is_set(),
+        })
+
+    def _readyz_route(self, _req: Request) -> Response:
+        """Readiness: warmed up, dependencies reachable, not draining.
+        503 with a reason while not ready — load balancers and the
+        rolling-restart handoff key off this, not /healthz."""
+        reason: str | None = None
+        if self._draining.is_set():
+            reason = "draining"
+        elif self.ready_check is not None:
+            try:
+                reason = self.ready_check()
+            except Exception as exc:
+                reason = f"ready_check failed: {exc}"
+        doc = {
+            "ready": reason is None,
+            "server": self.name,
+            "instance": self.instance_id,
+        }
+        if reason is None:
+            return Response.json(doc)
+        doc["reason"] = reason
+        return Response.json(doc, status=503)
+
+    # -- graceful drain ----------------------------------------------------
+
+    def add_shutdown_hook(self, fn: Callable[[], None]) -> None:
+        """Register a flush hook (group-commit coalescers, tailer
+        cursors, ...) run exactly once after in-flight requests quiesce
+        during :meth:`drain`, before the loop stops."""
+        self._shutdown_hooks.append(fn)
+
+    def _run_shutdown_hooks(self) -> None:
+        with self._conns_lock:
+            if self._hooks_ran:
+                return
+            self._hooks_ran = True
+        for fn in self._shutdown_hooks:
+            try:
+                fn()
+            except Exception:
+                logger.exception("shutdown hook failed")
+
+    def begin_drain(self, timeout: float | None = None) -> float:
+        """Flip into draining (idempotent): stop accepting, fail
+        readiness, answer served requests with ``Connection: close``,
+        and shed everything past the deadline with 503. Returns the
+        monotonic drain deadline; does not block."""
+        if self._draining.is_set():
+            return self._drain_deadline
+        faults.fault_point("http.drain")
+        if timeout is None:
+            try:
+                timeout = float(
+                    os.environ.get("PIO_DRAIN_TIMEOUT_S", "") or 10.0
+                )
+            except ValueError:
+                timeout = 10.0
+        self._drain_deadline = time.monotonic() + max(0.0, timeout)
+        self._draining.set()
+        loop = self._loop
+        if loop is not None:
+            loop.call_soon(loop.close_listener)
+        return self._drain_deadline
+
+    def drain(self, timeout: float | None = None) -> None:
+        """Graceful shutdown: :meth:`begin_drain`, wait (bounded by the
+        deadline) for in-flight and parked keep-alive requests to
+        finish, run the shutdown hooks, then :meth:`stop`."""
+        deadline = self.begin_drain(timeout)
+        while time.monotonic() < deadline:
+            with self._conns_lock:
+                quiesced = self._active == 0 and not self._conns
+            if quiesced:
+                break
+            time.sleep(0.02)
+        self._run_shutdown_hooks()
+        self.stop()
+
+    def _install_signal_drain(self) -> None:
+        """SIGTERM -> drain -> clean loop exit (exit 0). Foreground
+        (main-thread) servers only: signal handlers cannot be installed
+        elsewhere."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _on_term(signum, frame):
+            threading.Thread(
+                target=self._drain_for_signal,
+                daemon=True,
+                name=f"pio-drain-{self.name}",
+            ).start()
+
+        try:
+            signal_mod.signal(signal_mod.SIGTERM, _on_term)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+
+    def _drain_for_signal(self) -> None:
+        try:
+            self.drain()
+        except Exception:
+            logger.exception("graceful drain failed; stopping hard")
+            self.stop()
 
     # -- timer wheel (shared clock for query deadlines etc.) ---------------
 
@@ -1173,6 +1344,8 @@ class HTTPApp:
         fallback connections — forever (worker-pinned, the old
         thread-per-connection behavior)."""
         loop = self._loop
+        with self._conns_lock:
+            self._active += 1
         try:
             while True:
                 conn.handle_one_request()
@@ -1195,6 +1368,9 @@ class HTTPApp:
         except Exception:
             logger.exception("connection worker failed")
             conn.close()
+        finally:
+            with self._conns_lock:
+                self._active -= 1
 
     # linger: when the server isn't fan-out loaded, blocking briefly on
     # the just-served socket keeps a busy keep-alive client at
@@ -1257,6 +1433,10 @@ class HTTPApp:
             )
             self._thread.start()
         else:
+            # foreground servers own the process: SIGTERM drains before
+            # the loop exits, so the command returns 0 after a clean
+            # shutdown instead of dying mid-response
+            self._install_signal_drain()
             try:
                 self._loop.run()
             except KeyboardInterrupt:

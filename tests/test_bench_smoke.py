@@ -57,6 +57,10 @@ def test_production_stack_smoke_gate():
     assert block["ok"] is True
     assert block["lost"] == 0
     assert block["chaos_fired"] > 0  # the faults really were armed
+    # self-healing drills: kill -9'd supervised child restarted once,
+    # and the rolling restart dropped nothing under load
+    assert block["restarts"] == 1
+    assert block["rolling_restart_failed_requests"] == 0
     assert all(s == "ok" for s in block["slo_states"].values()), block
 
 
@@ -109,6 +113,22 @@ class TestBenchCompare:
         assert bench_compare.leaf_direction("seconds_behind") == "lower"
         assert bench_compare.leaf_direction("conns") is None  # config
         assert bench_compare.leaf_direction("seed") is None
+        # self-healing counters: failures and restarts are lower-better
+        assert bench_compare.leaf_direction(
+            "rolling_restart_failed_requests") == "lower"
+        assert bench_compare.leaf_direction("restarts") == "lower"
+
+    def test_rolling_restart_failures_flagged(self):
+        old = {"production_stack": {
+            "rolling_restart_failed_requests": 0, "restarts": 1,
+        }}
+        new = {"production_stack": {
+            "rolling_restart_failed_requests": 3, "restarts": 1,
+        }}
+        report = bench_compare.compare(old, new)
+        assert [r["path"] for r in report["regressions"]] == [
+            "production_stack.rolling_restart_failed_requests"
+        ]
 
     def test_load_summary_unwraps_driver_tail_artifact(self, tmp_path):
         """The checked-in BENCH_r*.json files wrap a TRUNCATED copy of

@@ -272,3 +272,196 @@ class TestTLSFrontend:
             if probe is not None:
                 probe.close()
             app.stop()
+
+
+def _get_with_headers(sock, path="/ping") -> tuple[int, dict, bytes]:
+    """One GET; returns (status, header dict, body) — the drain tests
+    need the Connection header, which _read_response drops."""
+    sock.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    sock.settimeout(10)
+    buf = bytearray()
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError(f"closed mid-headers: {bytes(buf)!r}")
+        buf += chunk
+    head, _, rest = bytes(buf).partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.partition(b":")
+        headers[k.strip().lower().decode()] = v.strip().decode()
+    clen = int(headers.get("content-length", 0))
+    while len(rest) < clen:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("closed mid-body")
+        rest += chunk
+    return status, headers, rest[:clen]
+
+
+class TestHealthAndReadiness:
+    def test_healthz_carries_instance_identity(self):
+        app = _echo_app()
+        port = app.start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            status, _, body = _get_with_headers(sock, "/healthz")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc["instance"] == app.instance_id
+            assert doc["pid"] == __import__("os").getpid()
+            assert doc["draining"] is False
+            sock.close()
+        finally:
+            app.stop()
+
+    def test_readyz_gated_by_ready_check(self):
+        reason = {"why": "warming up"}
+        app = _echo_app(ready_check=lambda: reason["why"])
+        port = app.start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            status, _, body = _get_with_headers(sock, "/readyz")
+            assert status == 503
+            assert json.loads(body)["reason"] == "warming up"
+            reason["why"] = None
+            status, _, body = _get_with_headers(sock, "/readyz")
+            assert status == 200 and json.loads(body)["ready"] is True
+            sock.close()
+        finally:
+            app.stop()
+
+
+class TestGracefulDrain:
+    def _gated_app(self):
+        gate = threading.Event()
+        router = Router()
+
+        @router.route("GET", "/slow")
+        def slow(request):
+            gate.wait(10)
+            return Response.json({"ok": True})
+
+        @router.route("GET", "/ping")
+        def ping(request):
+            return Response.json({"ok": True})
+
+        return HTTPApp(router, host="127.0.0.1", port=0), gate
+
+    def test_inflight_request_completes_with_connection_close(self):
+        """A request in flight when drain begins is served normally,
+        but the response hands the connection back closed so the
+        client's next request reconnects elsewhere."""
+        app, gate = self._gated_app()
+        port = app.start()
+        result = {}
+
+        def bg():
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            result["resp"] = _get_with_headers(sock, "/slow")
+            sock.close()
+
+        t = threading.Thread(target=bg)
+        t.start()
+        time.sleep(0.2)  # the slow request is parked in its handler
+        drainer = threading.Thread(target=lambda: app.drain(timeout=10))
+        drainer.start()
+        time.sleep(0.1)
+        gate.set()
+        t.join(timeout=10)
+        drainer.join(timeout=10)
+        assert not drainer.is_alive()
+        status, headers, body = result["resp"]
+        assert status == 200 and json.loads(body) == {"ok": True}
+        assert headers.get("connection") == "close"
+
+    def test_past_deadline_requests_are_shed_503_close(self):
+        app, gate = self._gated_app()
+        port = app.start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            # park the conn with one served request first (keep-alive)
+            status, _, _ = _get_with_headers(sock, "/ping")
+            assert status == 200
+            app.begin_drain(timeout=0)  # deadline passes immediately
+            status, headers, body = _get_with_headers(sock, "/ping")
+            assert status == 503
+            assert headers.get("connection") == "close"
+            assert b"draining" in body
+            sock.close()
+        finally:
+            app.stop()
+
+    def test_drain_deadline_bounds_the_wait(self):
+        """A handler that never finishes can't hold drain past the
+        deadline."""
+        app, gate = self._gated_app()
+        port = app.start()
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        sock.sendall(b"GET /slow HTTP/1.1\r\nHost: x\r\n\r\n")
+        time.sleep(0.2)
+        t0 = time.monotonic()
+        app.drain(timeout=0.3)
+        assert time.monotonic() - t0 < 5.0
+        gate.set()
+        sock.close()
+
+    def test_new_connections_refused_after_drain_begins(self):
+        app, gate = self._gated_app()
+        port = app.start()
+        try:
+            app.begin_drain(timeout=5)
+            time.sleep(0.1)  # call_soon(close_listener) lands
+            with pytest.raises(OSError):
+                socket.create_connection(("127.0.0.1", port), timeout=1)
+        finally:
+            gate.set()
+            app.stop()
+
+    def test_readyz_fails_while_draining_healthz_stays_ok(self):
+        app, gate = self._gated_app()
+        port = app.start()
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        # second conn opened BEFORE drain (the listener closes with it)
+        sock2 = socket.create_connection(("127.0.0.1", port), timeout=10)
+        status, _, _ = _get_with_headers(sock, "/readyz")
+        assert status == 200
+        app.begin_drain(timeout=5)
+        status, _, body = _get_with_headers(sock, "/readyz")
+        assert status == 503 and json.loads(body)["reason"] == "draining"
+        # liveness is NOT readiness: the process is still healthy
+        status, _, body = _get_with_headers(sock2, "/healthz")
+        assert status == 200 and json.loads(body)["draining"] is True
+        sock.close()
+        sock2.close()
+        app.drain(timeout=0)
+
+    def test_shutdown_hooks_run_exactly_once(self):
+        app, gate = self._gated_app()
+        ran = []
+        app.add_shutdown_hook(lambda: ran.append(1))
+        app.start()
+        gate.set()
+        app.drain(timeout=1)
+        app.drain(timeout=1)  # idempotent re-entry
+        assert ran == [1]
+
+    def test_drain_fault_point_aborts_before_state_change(self):
+        """An injected http.drain fault must surface AND leave the app
+        serving (the fault fires before any drain state flips)."""
+        app, gate = self._gated_app()
+        port = app.start()
+        try:
+            with faults.injected("http.drain"):
+                with pytest.raises(faults.FaultError):
+                    app.begin_drain(timeout=5)
+            assert not app.draining
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            status, _, _ = _get_with_headers(sock, "/ping")
+            assert status == 200  # still accepting and serving
+            sock.close()
+        finally:
+            gate.set()
+            app.stop()

@@ -1,0 +1,434 @@
+"""Self-healing fleet supervisor (server/supervisor.py): seeded
+restart backoff, flap -> broken + incident bundle, spawn fault
+injection, kill -9 recovery of a real child, and the SO_REUSEPORT
+rolling-restart handoff's byte parity."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+import pytest
+
+from predictionio_tpu import faults
+from predictionio_tpu.cli import daemon
+from predictionio_tpu.common.breaker import backoff_interval
+from predictionio_tpu.server import supervisor as sup_mod
+from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+
+@pytest.fixture(autouse=True)
+def _run_dir(tmp_path, monkeypatch):
+    """Isolate pid files / service records / supervisor.json / incident
+    bundles per test."""
+    monkeypatch.setenv("PIO_RUN_DIR", str(tmp_path / "run"))
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _FakeProc:
+    """Popen-shaped handle the unit tests crash on demand."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._rc: int | None = None
+
+    def poll(self):
+        return self._rc
+
+    def wait(self, timeout=None):
+        if self._rc is None:
+            raise subprocess.TimeoutExpired("fake", timeout or 0)
+        return self._rc
+
+    def terminate(self):
+        if self._rc is None:
+            self._rc = -signal.SIGTERM
+
+    def kill(self):
+        if self._rc is None:
+            self._rc = -signal.SIGKILL
+
+    def die(self, rc: int):
+        self._rc = rc
+
+
+def _machine(
+    *, seed=7, base=0.5, max_s=30.0, flap_max=100, flap_window_s=60.0,
+    stable_s=30.0,
+):
+    """A single-service supervisor with injected clock/sleep/spawn/probe
+    so the crash/backoff/flap state machine runs without processes."""
+    clock = {"t": 0.0}
+    procs: list[_FakeProc] = []
+
+    def spawn():
+        p = _FakeProc(1000 + len(procs))
+        procs.append(p)
+        return p
+
+    def probe(_spec):
+        p = procs[-1] if procs else None
+        if p is not None and p.poll() is None:
+            return {"pid": p.pid, "instance": f"boot-{len(procs)}"}
+        return None
+
+    sup = sup_mod.Supervisor(
+        [sup_mod.ServiceSpec(name="engine", spawn=spawn)],
+        poll_interval=0.01,
+        base_backoff_s=base,
+        max_backoff_s=max_s,
+        jitter=0.2,
+        flap_max=flap_max,
+        flap_window_s=flap_window_s,
+        stable_s=stable_s,
+        health_fail_threshold=3,
+        seed=seed,
+        clock=lambda: clock["t"],
+        sleep=lambda s: clock.__setitem__("t", clock["t"] + s),
+        probe=probe,
+    )
+    return sup, clock, procs
+
+
+class TestBackoffStateMachine:
+    def test_restart_backoff_is_seeded_and_exponential(self):
+        sup, clock, procs = _machine(seed=7)
+        sup.start_all(wait_healthy_s=5.0)
+        child = sup._children[0]
+        assert child.state == sup_mod.UP
+
+        # the reference stream: same policy, same per-service seed
+        rng = random.Random(7 ^ zlib.crc32(b"engine"))
+        observed, expected = [], []
+        for attempt in (1, 2, 3):
+            procs[-1].die(1)
+            sup.step()
+            assert child.state == sup_mod.RESTARTING
+            observed.append(child.last_backoff_s)
+            expected.append(
+                backoff_interval(
+                    attempt, base_s=0.5, max_s=30.0, jitter=0.2, rng=rng
+                )
+            )
+            # one tick early: must still be waiting out the backoff
+            sup.step(now=child.next_retry_at - 0.01)
+            assert child.state == sup_mod.RESTARTING
+            clock["t"] = child.next_retry_at
+            sup.step()
+            assert child.state == sup_mod.STARTING
+            sup.step()
+            assert child.state == sup_mod.UP
+
+        assert observed == pytest.approx(expected)
+        assert child.restarts == 3
+        # successive delays grow (the jitter is only +/-20%)
+        assert observed[0] < observed[1] < observed[2]
+
+    def test_backoff_resets_after_stability_window(self):
+        sup, clock, procs = _machine(stable_s=5.0)
+        sup.start_all(wait_healthy_s=5.0)
+        child = sup._children[0]
+        procs[-1].die(1)
+        sup.step()
+        clock["t"] = child.next_retry_at
+        sup.step()
+        sup.step()
+        assert child.state == sup_mod.UP and child.attempt == 1
+        clock["t"] += 5.1  # outlive the stability window
+        sup.step()
+        assert child.attempt == 0  # next crash backs off from ~base again
+
+    def test_restart_metric_and_state_file(self):
+        before = sup_mod.Supervisor._m_restarts("engine").value()
+        sup, clock, procs = _machine()
+        sup.start_all(wait_healthy_s=5.0)
+        child = sup._children[0]
+        procs[-1].die(-signal.SIGKILL)
+        sup.step()
+        assert child.last_exit == "signal 9 (SIGKILL)"
+        clock["t"] = child.next_retry_at
+        sup.step()
+        sup.step()
+        assert sup_mod.Supervisor._m_restarts("engine").value() == before + 1
+        doc = json.loads(sup_mod.state_file().read_text())
+        svc = doc["services"]["engine"]
+        assert svc["state"] == "up" and svc["restarts"] == 1
+        assert svc["last_exit"] == "signal 9 (SIGKILL)"
+        # the gauge tracks the state code
+        g = sup_mod.Supervisor._g_state("engine")
+        assert g.value() == 0.0
+
+    def test_unhealthy_but_alive_child_is_restarted(self):
+        sup, clock, procs = _machine()
+        sup.start_all(wait_healthy_s=5.0)
+        child = sup._children[0]
+        # hang the child: pid alive, probes dead (monkey-wrench the
+        # probe by killing the fake's health without killing its pid)
+        alive = procs[-1]
+        sup._probe_fn = lambda spec: None
+        for _ in range(3):  # health_fail_threshold
+            sup.step()
+        assert child.state == sup_mod.RESTARTING
+        assert "unhealthy" in child.last_exit
+        assert alive.poll() is not None  # it was terminated, not leaked
+
+
+class TestFlapDetection:
+    def test_flap_declares_broken_and_fires_incident(self, monkeypatch):
+        monkeypatch.setenv("PIO_INCIDENT_MIN_INTERVAL_S", "0")
+        sup, clock, procs = _machine(flap_max=3, flap_window_s=60.0)
+        sup.start_all(wait_healthy_s=5.0)
+        child = sup._children[0]
+        for _ in range(3):
+            procs[-1].die(-signal.SIGKILL)
+            sup.step()
+            if child.state == sup_mod.RESTARTING:
+                clock["t"] = child.next_retry_at
+                sup.step()
+                sup.step()
+        assert child.state == sup_mod.BROKEN
+        assert child.next_retry_at is None  # no further respawns
+        # the flight recorder captured the flap
+        from predictionio_tpu.obs import incident as obs_incident
+
+        names = [b["name"] for b in obs_incident.list_incidents()]
+        assert any("supervisor-flap-engine" in n for n in names)
+        doc = json.loads(sup_mod.state_file().read_text())
+        assert doc["services"]["engine"]["state"] == "broken"
+
+    def test_slow_crashes_outside_window_never_break(self):
+        sup, clock, procs = _machine(flap_max=3, flap_window_s=10.0)
+        sup.start_all(wait_healthy_s=5.0)
+        child = sup._children[0]
+        for _ in range(6):  # 2x the flap budget, but spread out
+            procs[-1].die(1)
+            sup.step()
+            assert child.state == sup_mod.RESTARTING
+            clock["t"] = child.next_retry_at
+            sup.step()
+            sup.step()
+            assert child.state == sup_mod.UP
+            clock["t"] += 11.0  # next crash lands outside the window
+        assert child.restarts == 6
+
+
+class TestSpawnFaultInjection:
+    def test_spawn_fault_backs_off_then_recovers(self):
+        sup, clock, procs = _machine()
+        child = sup._children[0]
+        with faults.injected("supervisor.spawn:nth=1") as plan:
+            sup.start_all(wait_healthy_s=5.0)
+            assert plan.fire_count("supervisor.spawn") == 1
+            # first spawn raised -> scheduled with backoff, not crashed
+            if child.state == sup_mod.RESTARTING:
+                assert "spawn failed" in child.last_exit
+                clock["t"] = child.next_retry_at
+                sup.step()
+                sup.step()
+        assert child.state == sup_mod.UP
+        assert child.restarts == 1
+        assert len(procs) == 1  # exactly one real spawn happened
+
+
+class TestStatusReporting:
+    def test_read_state_reports_liveness(self):
+        sup, clock, procs = _machine()
+        sup.start_all(wait_healthy_s=5.0)
+        doc = sup_mod.read_state()
+        assert doc is not None
+        assert doc["pid"] == os.getpid() and doc["live"] is True
+        assert doc["services"]["engine"]["state"] == "up"
+
+    def test_status_lines_render_supervised_services(self):
+        from predictionio_tpu.cli.main import _supervisor_lines
+
+        sup, clock, procs = _machine()
+        sup.start_all(wait_healthy_s=5.0)
+        lines = _supervisor_lines()
+        assert any(
+            line.startswith("supervisor[engine]: up") for line in lines
+        )
+
+    def test_stop_reverses_and_marks_stopped(self):
+        sup, clock, procs = _machine()
+        sup.start_all(wait_healthy_s=5.0)
+        sup.stop()
+        child = sup._children[0]
+        assert child.state == sup_mod.STOPPED
+        assert procs[-1].poll() is not None
+        doc = json.loads(sup_mod.state_file().read_text())
+        assert doc["services"]["engine"]["state"] == "stopped"
+
+
+class TestServiceRecords:
+    def test_record_roundtrip(self):
+        daemon.write_service_record(
+            "engine", ["deploy", "--port", "1234"], "127.0.0.1", 1234,
+            instance="abc",
+        )
+        rec = daemon.read_service_record("engine")
+        assert rec == {
+            "name": "engine",
+            "argv": ["deploy", "--port", "1234"],
+            "host": "127.0.0.1",
+            "port": 1234,
+            "instance": "abc",
+        }
+
+    def test_rolling_restart_requires_a_record(self):
+        with pytest.raises(RuntimeError):
+            daemon.rolling_restart("engine")
+
+
+_CHILD_SCRIPT = """
+import sys
+from predictionio_tpu.server.http import HTTPApp, Response, Router
+
+router = Router()
+router.add(
+    "GET", "/answer",
+    lambda req: Response.json({"answer": 42, "payload": "x" * 256}),
+)
+HTTPApp(
+    router, host="127.0.0.1", port=int(sys.argv[1]), reuse_port=True,
+    name="chaos-child",
+).start(background=False)
+"""
+
+
+@pytest.mark.chaos
+class TestKillNineRecovery:
+    def test_kill9_child_restarts_and_serves_same_bytes(self):
+        port = _free_port()
+
+        def spawn():
+            return subprocess.Popen(
+                [sys.executable, "-c", _CHILD_SCRIPT, str(port)],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+
+        sup = sup_mod.Supervisor(
+            [sup_mod.ServiceSpec(name="engine", port=port, spawn=spawn)],
+            poll_interval=0.05,
+            base_backoff_s=0.1,
+            max_backoff_s=1.0,
+            flap_max=10,
+            seed=3,
+        )
+        try:
+            sup.start_all(wait_healthy_s=30.0)
+            child = sup._children[0]
+            assert child.state == sup_mod.UP
+
+            def fetch() -> bytes:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+                try:
+                    conn.request("GET", "/answer")
+                    resp = conn.getresponse()
+                    assert resp.status == 200
+                    return resp.read()
+                finally:
+                    conn.close()
+
+            baseline = fetch()
+            first_boot = child.instance
+            os.kill(child.pid, signal.SIGKILL)
+
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                sup.step()
+                if (
+                    child.state == sup_mod.UP
+                    and child.restarts == 1
+                    and child.instance != first_boot
+                ):
+                    break
+                time.sleep(0.05)
+            assert child.state == sup_mod.UP and child.restarts == 1
+            assert "signal 9" in child.last_exit
+            # the respawned child serves byte-identical answers
+            assert fetch() == baseline
+        finally:
+            sup.stop()
+
+
+class TestRollingRestartByteParity:
+    def test_handoff_under_keepalive_client_is_lossless(self):
+        """Two HTTPApps overlap on one SO_REUSEPORT port; a keep-alive
+        client keeps querying across the old instance's drain. Every
+        response must be 200 with byte-identical bodies — the
+        zero-downtime contract `pio rolling-restart` is built on."""
+
+        def app_on(port: int) -> HTTPApp:
+            router = Router()
+            router.add(
+                "GET", "/scores",
+                lambda req: Response.json(
+                    {"items": list(range(32)), "model": "m1"}
+                ),
+            )
+            return HTTPApp(
+                router, host="127.0.0.1", port=port, reuse_port=True,
+                name="parity",
+            )
+
+        port = _free_port()
+        old = app_on(port)
+        old.start()
+        new = None
+        drainer = None
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            bodies = []
+            for i in range(20):
+                conn.request("GET", "/scores")
+                resp = conn.getresponse()
+                assert resp.status == 200
+                bodies.append(resp.read())
+                if i == 5:
+                    # bring the replacement up on the SAME port, wait
+                    # for its readiness, then drain the old instance
+                    new = app_on(port)
+                    new.start()
+                    ready = daemon.wait_ready(
+                        "127.0.0.1", port, timeout=10.0,
+                        not_instance=old.instance_id,
+                    )
+                    assert ready is not None
+                    assert ready["instance"] == new.instance_id
+                    drainer = threading.Thread(
+                        target=lambda: old.drain(timeout=10.0)
+                    )
+                    drainer.start()
+                    time.sleep(0.05)  # let the old listener close
+            assert all(b == bodies[0] for b in bodies)
+            drainer.join(timeout=15)
+            assert not drainer.is_alive()
+            # the survivor is the new instance
+            doc = daemon.probe_health("127.0.0.1", port)
+            assert doc is not None and doc["instance"] == new.instance_id
+            conn.close()
+        finally:
+            if drainer is None:
+                old.stop()
+            if new is not None:
+                new.stop()
