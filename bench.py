@@ -1861,12 +1861,216 @@ def sharded_smoke_child() -> None:
     print(json.dumps(out))
 
 
+def _bench_tail_columnar(rt: dict, n_events: int) -> None:
+    """The ``tail_columnar`` rung: a burst lands in a file-backed log
+    through the splice write path (the same bytes ``POST
+    /batch/events.bin`` appends), with two tailers attached BEFORE the
+    burst — one object-path, one columnar — and each drains the
+    identical backlog. Gates: columnar delivery >= 1.7x the object
+    path's events/s, fold-in results bit-identical between the two
+    paths, and the columnar catch-up (decode + fold) holding
+    ``seconds_behind`` <= 1.5s.
+
+    The catch-up half runs on its own bounded store (one poll cycle's
+    backlog): fold-in re-reads the touched users' FULL histories, so
+    its cost scales with total log length, not with the batch — that
+    tail is the columnar cache's problem, while ``seconds_behind``
+    gauges how far one tail->fold cycle lags a saturated writer."""
+    import shutil
+    import tempfile as _tempfile
+
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.data.storage import colspans
+    from predictionio_tpu.data.storage.jsonl import (
+        JSONLEvents,
+        JSONLStorageClient,
+    )
+    from predictionio_tpu.models.recommendation import ALSModel
+    from predictionio_tpu.realtime import ALSFoldIn, EventTailer, FoldInConfig
+    from predictionio_tpu.realtime.tailer import TailedBatch
+    from datetime import datetime, timezone
+
+    n_users, n_items, rank = 500, 200, 16
+    fold_events = min(n_events, 20_000)
+    app_id = 9
+    tmp = _tempfile.mkdtemp(
+        prefix="pio_tailcol_", dir=os.environ.get("BENCH_TMPDIR")
+    )
+    tmp2 = _tempfile.mkdtemp(
+        prefix="pio_tailfold_", dir=os.environ.get("BENCH_TMPDIR")
+    )
+    client = client2 = None
+    try:
+        client = JSONLStorageClient({"path": tmp, "sync": "interval:1000"})
+        events = JSONLEvents(client)
+        now = datetime.now(timezone.utc).isoformat(timespec="milliseconds")
+        now = now.replace("+00:00", "Z")
+        # seed one line so the log exists: both tailers then attach at
+        # its end with live lineage (a file born after attach re-reads
+        # as FRESH, which routes to the object path by design)
+        seed = json.dumps({
+            "event": "rate", "entityType": "user", "entityId": "u0",
+            "targetEntityType": "item", "targetEntityId": "i0",
+            "properties": {"rating": 3.0}, "eventId": "seed0",
+            "eventTime": now, "creationTime": now,
+        }).encode()
+        events.append_jsonl(seed, app_id)
+        cfg = FoldInConfig(
+            event_names=("rate", "buy"), override_ratings={"buy": 4.0}
+        )
+        dcfg = colspans.DecodeConfig(
+            event_names=cfg.event_names,
+            rating_key=cfg.rating_key,
+            override_ratings=cfg.override_ratings,
+            entity_type=cfg.entity_type,
+            target_entity_type=cfg.target_entity_type,
+        )
+        t_obj = EventTailer(events, app_id, batch_limit=100_000)
+        t_col = EventTailer(
+            events, app_id, batch_limit=100_000, columnar_config=dcfg
+        )
+
+        rng = np.random.default_rng(SEED)
+        ratings = rng.integers(1, 6, n_events)
+        lines = [
+            json.dumps({
+                "event": "rate", "entityType": "user",
+                "entityId": f"u{j % n_users}",
+                "targetEntityType": "item",
+                "targetEntityId": f"i{j % n_items}",
+                "properties": {"rating": float(ratings[j])},
+                "eventId": f"b{j}", "eventTime": now, "creationTime": now,
+            }).encode()
+            for j in range(n_events)
+        ]
+        blob = b"\n".join(lines) + b"\n"
+        t_w0 = time.perf_counter()
+        events.append_jsonl(blob, app_id)
+        write_s = time.perf_counter() - t_w0
+
+        # object-path drain (poll only: the read-side decode is what
+        # the rung compares; fold cost is identical for both paths)
+        obj_events = []
+        t0 = time.perf_counter()
+        while True:
+            got = t_obj.poll()
+            if not got:
+                break
+            obj_events.extend(got)
+        obj_s = time.perf_counter() - t0
+
+        col_segments = []
+        t0 = time.perf_counter()
+        while True:
+            batch = t_col.poll_columnar()
+            if not batch.n_events:
+                break
+            col_segments.extend(batch.segments)
+        col_s = time.perf_counter() - t0
+        col_batch = TailedBatch(col_segments)
+        n_col = col_batch.n_events
+        assert n_col == len(obj_events) == n_events, (
+            f"tail delivery mismatch: object {len(obj_events)}, "
+            f"columnar {n_col}, written {n_events}"
+        )
+        col_lines = sum(
+            s.n_rows for s in col_segments if hasattr(s, "n_rows")
+        )
+
+        # catch-up + fold parity on the bounded store: one poll cycle's
+        # backlog, timed end to end (columnar poll + fold), against an
+        # object-path fold of the identical events for bit-parity
+        client2 = JSONLStorageClient({"path": tmp2, "sync": "interval:1000"})
+        events2 = JSONLEvents(client2)
+        events2.append_jsonl(seed, app_id)
+        t2_obj = EventTailer(events2, app_id, batch_limit=100_000)
+        t2_col = EventTailer(
+            events2, app_id, batch_limit=100_000, columnar_config=dcfg
+        )
+        events2.append_jsonl(b"\n".join(lines[:fold_events]) + b"\n", app_id)
+        obj2_events = []
+        while True:
+            got = t2_obj.poll()
+            if not got:
+                break
+            obj2_events.extend(got)
+
+        model = ALSModel(
+            user_index=BiMap.from_dense([f"u{i}" for i in range(n_users)]),
+            item_index=BiMap.from_dense([f"i{i}" for i in range(n_items)]),
+            user_factors=rng.normal(size=(n_users, rank)).astype(np.float32),
+            item_factors=rng.normal(size=(n_items, rank)).astype(np.float32),
+        )
+        foldin = ALSFoldIn(events2, app_id, config=cfg)
+        # the object-path fold runs first: it is the parity reference
+        # AND it compiles the identical padded solve shape, so the
+        # timed columnar catch-up below excludes jit compiles
+        patched_o, stats_o = ALSFoldIn(events2, app_id, config=cfg).fold(
+            model, obj2_events
+        )
+        t0 = time.perf_counter()
+        fold_segments = []
+        while True:
+            batch = t2_col.poll_columnar()
+            if not batch.n_events:
+                break
+            fold_segments.extend(batch.segments)
+        catch_batch = TailedBatch(fold_segments)
+        patched_c, stats_c = foldin.fold_in_columnar(model, catch_batch)
+        seconds_behind = time.perf_counter() - t0
+        assert catch_batch.n_events == len(obj2_events) == fold_events
+        assert patched_c is not None and patched_o is not None
+        parity = bool(
+            np.array_equal(patched_c.user_factors, patched_o.user_factors)
+            and list(patched_c.user_index) == list(patched_o.user_index)
+            and stats_c.rating_events == stats_o.rating_events
+        )
+        assert parity, "columnar fold-in diverged from the object path"
+
+        speedup = obj_s / col_s if col_s > 0 else float("inf")
+        rt["tail_columnar"] = {
+            "events": n_events,
+            "write_events_per_s": round(n_events / write_s)
+            if write_s > 0 else None,
+            "tail_object_events_per_s": round(n_events / obj_s),
+            "tail_events_per_s": round(n_events / col_s),
+            "tail_columnar_speedup": round(speedup, 2),
+            "columnar_lines": int(col_lines),
+            "fold_events": fold_events,
+            "seconds_behind": round(seconds_behind, 3),
+            "fold_parity": parity,
+        }
+        assert speedup >= 1.7, (
+            f"columnar tail only {speedup:.2f}x the object path "
+            f"({rt['tail_columnar']})"
+        )
+        assert seconds_behind <= 1.5, (
+            f"columnar catch-up took {seconds_behind:.2f}s "
+            f"({rt['tail_columnar']})"
+        )
+        if n_events >= 50_000:
+            assert rt["tail_columnar"]["tail_events_per_s"] >= 200_000, (
+                f"columnar tail below the 200k/s gate "
+                f"({rt['tail_columnar']})"
+            )
+    finally:
+        for c in (client, client2):
+            try:
+                if c is not None:
+                    c.close()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(tmp2, ignore_errors=True)
+
+
 def bench_realtime(
     extras: dict,
     n_users: int = 2000,
     n_items: int = 500,
     batches: int = 5,
     batch_events: int = 1000,
+    tail_events: int = 120_000,
 ) -> None:
     """Speed-layer fold-in: latency per 1k-event batch, sustained
     events/s through tail->fold, and the max events_behind backlog while
@@ -1965,6 +2169,8 @@ def bench_realtime(
         "burst_drain_s": round(drain_s, 3),
         "users_in_model": len(model.user_index),
     }
+    if tail_events > 0:
+        _bench_tail_columnar(extras["realtime"], tail_events)
 
 
 def bench_eval(
@@ -2729,6 +2935,14 @@ def _compact_summary(result: dict) -> dict:
             for k in ("foldin_latency_s", "events_per_s", "max_events_behind")
             if k in rt
         }
+        tc = rt.get("tail_columnar")
+        if isinstance(tc, dict):
+            s["realtime"]["tail_columnar"] = {
+                k: tc[k]
+                for k in ("tail_events_per_s", "tail_columnar_speedup",
+                          "seconds_behind")
+                if k in tc
+            }
     ev = result.get("eval")
     if isinstance(ev, dict) and "error" not in ev:
         s["eval"] = {
@@ -4168,7 +4382,8 @@ def smoke_main() -> None:
         result["storage"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         bench_realtime(
-            result, n_users=200, n_items=50, batches=2, batch_events=100
+            result, n_users=200, n_items=50, batches=2, batch_events=100,
+            tail_events=20_000,
         )
     except Exception as e:
         result["realtime"] = {"error": f"{type(e).__name__}: {e}"}

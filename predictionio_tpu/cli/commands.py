@@ -405,8 +405,7 @@ def import_events(
 
     from predictionio_tpu.data import store
     from predictionio_tpu.data.event import validate
-
-    from predictionio_tpu import native
+    from predictionio_tpu.data.storage import colspans
 
     storage = storage or get_storage()
     app_name = _resolve_app_name(app_name, storage)
@@ -434,9 +433,11 @@ def import_events(
     def _flush_slow(data: bytes | list[bytes]) -> int:
         if isinstance(data, list):
             data = b"\n".join(data)
-        # native span-scanning codec decodes the fixed wire fields without
-        # a per-line DOM parse (json fallback for flagged lines inside)
-        events = native.parse_events_jsonl(data)
+        # shared span-scanning decoder (data/storage/colspans.py — the
+        # same one under the columnar cache and the tail path) decodes
+        # the fixed wire fields without a per-line DOM parse (json
+        # fallback for flagged lines inside)
+        events = colspans.parse_events(data)
         done = 0
         for start in range(0, len(events), 500):
             batch = events[start : start + 500]

@@ -51,6 +51,7 @@ from pathlib import Path
 import numpy as np
 
 from predictionio_tpu import faults
+from predictionio_tpu.data.storage import colspans
 
 logger = logging.getLogger(__name__)
 
@@ -58,7 +59,8 @@ MAGIC = b"PIOCOLC1"
 SUFFIX = ".colcache"
 _ALIGN = 64
 # int64-microsecond sentinel for rows without a parseable eventTime
-TIME_ABSENT = np.int64(np.iinfo(np.int64).min)
+# (defined in colspans — the shared decoder — and re-exported here)
+TIME_ABSENT = colspans.TIME_ABSENT
 
 _FALSEY = ("0", "false", "no", "off")
 
@@ -117,44 +119,11 @@ _ROW_BLOCKS = (
 
 def _build_chunk(buf: bytes, rating_key: str | None, scanned=None):
     """Columns for one scanned buffer, or None when any line needs the
-    json fallback (the cache only ever holds fully span-decodable logs)."""
-    from predictionio_tpu import native
-
-    if scanned is None:
-        scanned = native.scan_events(buf)
-    if ((scanned.flags & native.FLAG_FALLBACK) != 0).any():
-        return None
-    keep = (scanned.flags & native.FLAG_EMPTY) == 0
-    offs = scanned.offs[keep]
-    lens = scanned.lens[keep]
-
-    cols: dict[str, np.ndarray] = {}
-    names: dict[str, list[str]] = {}
-    for col, field, dict_name in (
-        ("ent_code", native.F_ENTITY_ID, "ent"),
-        ("tgt_code", native.F_TARGET_ENTITY_ID, "tgt"),
-        ("ev_code", native.F_EVENT, "ev"),
-        ("etype_code", native.F_ENTITY_TYPE, "etype"),
-        ("ttype_code", native.F_TARGET_ENTITY_TYPE, "ttype"),
-    ):
-        idx, ids = native.index_spans(buf, offs[:, field], lens[:, field])
-        cols[col] = idx
-        names[dict_name] = ids
-    if rating_key is None:
-        cols["rating"] = np.full(len(offs), np.nan, dtype=np.float32)
-    else:
-        cols["rating"] = native.extract_number(
-            buf, offs[:, native.F_PROPERTIES], lens[:, native.F_PROPERTIES],
-            rating_key,
-        ).astype(np.float32)
-    t = native.parse_times(
-        buf, offs[:, native.F_EVENT_TIME], lens[:, native.F_EVENT_TIME]
-    )
-    with np.errstate(invalid="ignore"):
-        cols["time_us"] = np.where(
-            np.isnan(t), TIME_ABSENT, (t * 1e6)
-        ).astype(np.int64)
-    return cols, names
+    json fallback (the cache only ever holds fully span-decodable logs).
+    The decode itself lives in :func:`colspans.decode_columns` — the
+    same implementation the tailer's columnar poll and ``pio import``
+    route through, so one set of parity tests covers all three."""
+    return colspans.decode_columns(buf, rating_key, scanned=scanned)
 
 
 def build_blocks(
@@ -403,24 +372,9 @@ class ColumnarBlocks:
             ratings = np.full(self.n, np.nan, dtype=np.float64)
         else:
             ratings = self._arr("rating").astype(np.float64)
-        if default_ratings and len(self.ev_names):
-            defaults = np.array(
-                [default_ratings.get(name, np.nan) for name in self.ev_names],
-                dtype=np.float64,
-            )
-            line_default = np.where(
-                ev >= 0, defaults[np.clip(ev, 0, None)], np.nan
-            )
-            ratings = np.where(np.isnan(ratings), line_default, ratings)
-        if override_ratings and len(self.ev_names):
-            forced = np.array(
-                [override_ratings.get(name, np.nan) for name in self.ev_names],
-                dtype=np.float64,
-            )
-            line_forced = np.where(
-                ev >= 0, forced[np.clip(ev, 0, None)], np.nan
-            )
-            ratings = np.where(np.isnan(line_forced), ratings, line_forced)
+        ratings = colspans.resolve_ratings(
+            ratings, ev, self.ev_names, default_ratings, override_ratings
+        )
         keep &= ~np.isnan(ratings)
 
         kept = np.flatnonzero(keep)

@@ -78,6 +78,10 @@ KNOWN_POINTS: dict[str, str] = {
                        "(single-chip and sharded)",
     "train.checkpoint": "ALS checkpoint snapshot write",
     "foldin.fold": "speed-layer incremental fold-in solve",
+    "tail.decode": "columnar tail span->array decode of one polled "
+                   "chunk (realtime/tailer.py; a raise falls the chunk "
+                   "back to the object parser, counted in "
+                   "pio_tailer_columnar_fallback_lines_total)",
     "http.drain": "graceful-drain entry on an HTTP server "
                   "(HTTPApp.begin_drain)",
     "supervisor.spawn": "fleet-supervisor child (re)spawn "

@@ -392,13 +392,15 @@ def extract_number(
     return out
 
 
-def parse_events_jsonl(data: bytes) -> list:
+def parse_events_jsonl(data: bytes, scanned: "ScannedEvents | None" = None) -> list:
     """JSONL buffer -> list[Event]: native span scan for well-formed
-    lines, json fallback for flagged ones (the import-path codec)."""
+    lines, json fallback for flagged ones (the import-path codec).
+    Pass ``scanned`` to reuse a prior :func:`scan_events` of ``data``."""
     from predictionio_tpu.data.datamap import DataMap
     from predictionio_tpu.data.event import Event, parse_time
 
-    scanned = scan_events(data)
+    if scanned is None:
+        scanned = scan_events(data)
     buf = scanned.buf
     # plain-list span indexing: numpy scalar getitem per field per line
     # costs more than the slice+decode it addresses; tolist() once makes

@@ -166,6 +166,39 @@ class ALSFoldIn:
         stats = FoldInStats(events=len(events))
         touched: list[str] = []
         touched_set: set[str] = set()
+        self._collect_events(model, events, stats, touched, touched_set)
+        if not touched:
+            return None, stats
+        return self._fold_touched(model, touched, stats)
+
+    def fold_in_columnar(
+        self, model: ALSModel, batch
+    ) -> tuple[ALSModel | None, FoldInStats]:
+        """Fold one :class:`~realtime.tailer.TailedBatch` — columnar
+        array segments and object-path Event segments, in delivery
+        order — without constructing an Event for any columnar row.
+
+        The columnar rows were already shape-classified by the decoder
+        (``colspans.decode_tail`` keeps exactly what :meth:`_rating_of`
+        would accept), so collection reduces to touched-user and
+        cold-item accumulation over arrays; the solve and patch are the
+        same jitted path :meth:`fold` takes, hence bit-identical
+        results across f32/bf16/int8 storage."""
+        stats = FoldInStats(events=batch.n_events)
+        touched: list[str] = []
+        touched_set: set[str] = set()
+        for seg in batch.segments:
+            if isinstance(seg, list):
+                self._collect_events(model, seg, stats, touched, touched_set)
+            else:
+                self._collect_columnar(model, seg, stats, touched, touched_set)
+        if not touched:
+            return None, stats
+        return self._fold_touched(model, touched, stats)
+
+    def _collect_events(
+        self, model, events, stats, touched, touched_set
+    ) -> None:
         for e in events:
             v = self._rating_of(e)
             if v is None:
@@ -179,9 +212,40 @@ class ALSFoldIn:
             if e.entity_id not in touched_set:
                 touched_set.add(e.entity_id)
                 touched.append(e.entity_id)
-        if not touched:
-            return None, stats
 
+    def _collect_columnar(
+        self, model, tail, stats, touched, touched_set
+    ) -> None:
+        n = tail.n_rows
+        if n == 0:
+            return
+        stats.rating_events += n
+        # cold-item accumulation, vectorized per distinct item (the
+        # per-event loop's counts/sums, bincount-shaped)
+        counts = np.bincount(tail.item_idx, minlength=len(tail.item_ids))
+        sums = np.bincount(
+            tail.item_idx, weights=tail.ratings,
+            minlength=len(tail.item_ids),
+        )
+        for j, iid in enumerate(tail.item_ids):
+            if iid in model.item_index:
+                continue
+            acc = self.cold_items.setdefault(iid, [0, 0.0])
+            acc[0] += int(counts[j])
+            acc[1] += float(sums[j])
+            stats.cold_item_events += int(counts[j])
+        for uid in tail.user_ids:  # first-appearance order, like events
+            if uid not in touched_set:
+                touched_set.add(uid)
+                touched.append(uid)
+
+    def _fold_touched(
+        self, model: ALSModel, touched: list[str], stats: FoldInStats
+    ) -> tuple[ALSModel | None, FoldInStats]:
+        """History re-read + solve + patch for the touched users (the
+        shared tail of :meth:`fold` and :meth:`fold_in_columnar` — the
+        solve is exact against full histories, so results can't depend
+        on which decode path delivered the triggering events)."""
         histories = self._histories(touched)
         users: list[str] = []
         pairs: list[list[tuple[int, float]]] = []

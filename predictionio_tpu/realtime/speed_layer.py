@@ -22,6 +22,7 @@ epoch fence. The invariants (docs/realtime.md):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
@@ -95,12 +96,32 @@ class SpeedLayer:
             ds_params.app_name, None, server.storage
         )
         events = server.storage.get_events()
+        # columnar tail preference (docs/realtime.md "Columnar tail
+        # path"): rate-shaped chunks decode straight to arrays; the
+        # tailer falls back per chunk/per line on anything else.
+        # PIO_TAIL_COLUMNAR=0 pins the object path.
+        columnar_config = None
+        if os.environ.get("PIO_TAIL_COLUMNAR", "1").strip().lower() not in (
+            "0", "false", "no", "off"
+        ):
+            from predictionio_tpu.data.storage import colspans
+
+            cfg = self._config
+            columnar_config = colspans.DecodeConfig(
+                event_names=cfg.event_names,
+                rating_key=cfg.rating_key,
+                default_ratings=cfg.default_ratings,
+                override_ratings=cfg.override_ratings,
+                entity_type=cfg.entity_type,
+                target_entity_type=cfg.target_entity_type,
+            )
         self.tailer = EventTailer(
             events,
             app_id,
             channel_id,
             cursor_path=cursor_path,
             batch_limit=batch_limit,
+            columnar_config=columnar_config,
         )
         self.foldin = ALSFoldIn(events, app_id, channel_id, config=self._config)
         # the instance this layer's fold-in state belongs to; a snapshot
@@ -191,16 +212,17 @@ class SpeedLayer:
             return "breaker_open"
 
         t_p0 = time.perf_counter()
-        events = self.tailer.poll()
+        batch = self.tailer.poll_columnar()
         t_p1 = time.perf_counter()
         _m_poll.observe(t_p1 - t_p0)
         if tr is not None:
             tr.add_span("tail.poll", t_p0, t_p1)
-        if not events:
+        n_events = batch.n_events
+        if not n_events:
             if (self.tailer.events_behind() or 0) == 0:
                 self._caught_up_at = time.time()
             return "idle"
-        _m_tailed.inc(len(events))
+        _m_tailed.inc(n_events)
 
         t0 = time.perf_counter()
         for _attempt in range(3):
@@ -212,7 +234,9 @@ class SpeedLayer:
                     try:
                         faults.fault_point("foldin.fold")
                         t_f0 = time.perf_counter()
-                        patched, stats = self.foldin.fold(m, events)
+                        patched, stats = self.foldin.fold_in_columnar(
+                            m, batch
+                        )
                         if tr is not None:
                             tr.add_span(
                                 "foldin.fold", t_f0, time.perf_counter()
@@ -227,7 +251,7 @@ class SpeedLayer:
                         self._last_fold_s = time.perf_counter() - t0
                         logger.exception(
                             "fold-in failed (%d events not folded; "
-                            "breaker %s)", len(events), self.breaker.state,
+                            "breaker %s)", n_events, self.breaker.state,
                         )
                         return "fold_failed"
                     if patched is not None:
@@ -257,11 +281,7 @@ class SpeedLayer:
                 with self.server._lock:
                     foldin_epoch = self.server._foldin_epoch
                 obs_freshness.observe_commit(
-                    [
-                        e.creation_time.timestamp()
-                        for e in events
-                        if e.creation_time is not None
-                    ],
+                    batch.creation_timestamps(),
                     kind="patch",
                     epoch=epoch + 1,
                     foldin_epoch=foldin_epoch,

@@ -37,8 +37,22 @@ from pathlib import Path
 from predictionio_tpu import faults
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import trace as obs_trace
 
 logger = logging.getLogger(__name__)
+
+# columnar/object split of the columnar poll mode's decode, per line
+# (docs/observability.md): lines that went straight to arrays vs lines
+# routed to the per-line object parser (mixed-stream fallbacks, chunks
+# that failed or were fault-injected at tail.decode)
+_m_col_lines = obs_metrics.counter(
+    "pio_tailer_columnar_lines_total",
+    "Log lines the columnar tail path decoded straight to arrays",
+)
+_m_col_fallback = obs_metrics.counter(
+    "pio_tailer_columnar_fallback_lines_total",
+    "Log lines the columnar tail path routed to the object parser",
+)
 
 _CURSOR_VERSION = 1
 # cap for the events_behind estimate scan, per file
@@ -86,11 +100,57 @@ def _end_offset(path: Path) -> int:
         return 0
 
 
+class TailedBatch:
+    """What one :meth:`EventTailer.poll_columnar` returned: an ordered
+    list of segments, each either a ``list[Event]`` (object path) or a
+    :class:`colspans.ColumnarTail` (array path). Counts and freshness
+    stamps are uniform across both so the speed layer never branches."""
+
+    __slots__ = ("segments",)
+
+    def __init__(self, segments: list):
+        self.segments = [s for s in segments if _seg_len(s)]
+
+    @property
+    def n_events(self) -> int:
+        return sum(_seg_len(s) for s in self.segments)
+
+    def creation_timestamps(self) -> list[float]:
+        """Epoch creation stamps of every delivered event (absent ones
+        skipped) — the freshness-lineage input for observe_commit."""
+        out: list[float] = []
+        for s in self.segments:
+            if isinstance(s, list):
+                out.extend(
+                    e.creation_time.timestamp()
+                    for e in s
+                    if e.creation_time is not None
+                )
+            else:
+                ts = s.creation_ts
+                out.extend(ts[~_np_isnan(ts)].tolist())
+        return out
+
+
+def _seg_len(seg) -> int:
+    return seg.n_rows if hasattr(seg, "n_rows") else len(seg)
+
+
+def _np_isnan(arr):
+    import numpy as np
+
+    return np.isnan(arr)
+
+
 class EventTailer:
     """Follow one (app, channel) event stream with a durable cursor.
 
     ``cursor_path=None`` keeps the cursor in memory only (tests, bench);
     otherwise every poll that moved the cursor persists it atomically.
+
+    ``columnar_config`` (a :class:`colspans.DecodeConfig`) arms the
+    columnar poll mode: :meth:`poll_columnar` then decodes rate-shaped
+    chunks straight to arrays instead of per-line Event objects.
     """
 
     def __init__(
@@ -100,12 +160,14 @@ class EventTailer:
         channel_id: int | None = None,
         cursor_path: str | Path | None = None,
         batch_limit: int = 5000,
+        columnar_config=None,
     ):
         self._events = events
         self._app_id = app_id
         self._channel_id = channel_id
         self._cursor_path = Path(cursor_path) if cursor_path else None
         self._batch_limit = int(batch_limit)
+        self._columnar_config = columnar_config
         if callable(getattr(events, "tail_files", None)):
             self.mode = "files"
         elif events.tail_end(app_id, channel_id) is not None:
@@ -253,109 +315,279 @@ class EventTailer:
             logger.warning("tailer: skipping unparseable log line: %s", err)
             return None
 
+    def _read_file(self, path):
+        """Open + fstat + capped read of one tailed file. Returns
+        ``(st, fresh, start, buf, capped)`` or None when the file is
+        unreadable or unchanged since the last poll."""
+        key = str(path)
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return None
+        with f:
+            # fstat AFTER open: a rotation between a stat and the open
+            # could otherwise pair old lineage with new bytes
+            st = os.fstat(f.fileno())
+            cur = self._files.get(key)
+            fresh = (
+                cur is None
+                or st.st_ino != cur.ino
+                or st.st_size < cur.offset
+            )
+            if (
+                not fresh
+                and st.st_size == cur.size
+                and st.st_mtime_ns == cur.mtime_ns
+            ):
+                return None  # unchanged since last poll
+            start = 0 if fresh else cur.offset
+            f.seek(start)
+            # bound the read to the fstat'ed size: bytes appended
+            # after the fstat belong to the next poll's lineage.
+            # Also cap the read: far behind a burst, the remainder
+            # can be 100s of MB while the batch limit only lets one
+            # poll deliver a few MB of lines — reading it all every
+            # poll would make catch-up quadratic in the backlog.
+            to_read = max(0, st.st_size - start)
+            capped = to_read > _READ_CAP
+            buf = f.read(_READ_CAP if capped else to_read)
+        return st, fresh, start, buf, capped
+
+    def _consume_object(
+        self, key, st, buf, start, fresh, capped, remaining
+    ) -> list[Event]:
+        """Deliver one read buffer through the Event path and advance
+        the file cursor (object poll mode, and the columnar mode's
+        whole-chunk fallback)."""
+        out: list[Event] = []
+        consumed = 0
+        truncated = capped
+        # bulk fast path: hand every complete line in the buffer to
+        # the native span scanner in one call (~an order of magnitude
+        # cheaper than per-line Event.from_json — this is what keeps
+        # seconds_behind bounded under a wire-speed ingest burst).
+        # Bail to the per-line loop when the chunk carries tombstones
+        # (the scanner has no $delete shape) or fails to parse.
+        end = buf.rfind(b"\n") + 1
+        chunk = buf[:end]
+        parsed = None
+        if chunk and b'"$delete"' not in chunk:
+            if chunk.count(b"\n") > remaining:
+                # trim to the remaining-limit'th newline; the rest of
+                # the buffer is re-read on the next poll
+                cut = -1
+                for _ in range(remaining):
+                    cut = chunk.find(b"\n", cut + 1)
+                chunk = chunk[: cut + 1]
+                truncated = True
+            try:
+                from predictionio_tpu.data.storage import colspans
+
+                parsed = colspans.parse_events(chunk)
+            except (ValueError, KeyError, UnicodeDecodeError) as err:
+                logger.warning(
+                    "tailer: bulk parse failed, falling back "
+                    "per-line: %s",
+                    err,
+                )
+                parsed = None
+                truncated = capped
+        if parsed is not None:
+            consumed = len(chunk)
+            for event in parsed:
+                if (
+                    fresh
+                    and event.creation_time.timestamp()
+                    <= self._watermark
+                ):
+                    continue
+                if self._mark_seen(event):
+                    out.append(event)
+            self._finish_file(key, st, start + consumed, truncated)
+            return out
+        pos = 0
+        while pos < len(buf):
+            nl = buf.find(b"\n", pos)
+            if nl < 0:
+                break  # torn trailing line: wait for the newline
+            if len(out) >= remaining:
+                truncated = True
+                break
+            raw = buf[pos:nl]
+            pos = nl + 1
+            consumed = pos
+            event = self._parse_line(raw)
+            if event is None:
+                continue
+            if fresh and event.creation_time.timestamp() <= self._watermark:
+                # rewrite resurfaced pre-attach history; not ours
+                continue
+            if self._mark_seen(event):
+                out.append(event)
+        self._finish_file(key, st, start + consumed, truncated)
+        return out
+
     def _poll_files(self, limit: int) -> list[Event]:
         out: list[Event] = []
         for path in self._events.tail_files(self._app_id, self._channel_id):
             if len(out) >= limit:
                 break
-            key = str(path)
-            try:
-                f = open(path, "rb")
-            except OSError:
+            read = self._read_file(path)
+            if read is None:
                 continue
-            with f:
-                # fstat AFTER open: a rotation between a stat and the open
-                # could otherwise pair old lineage with new bytes
-                st = os.fstat(f.fileno())
-                cur = self._files.get(key)
-                fresh = (
-                    cur is None
-                    or st.st_ino != cur.ino
-                    or st.st_size < cur.offset
+            st, fresh, start, buf, capped = read
+            out.extend(
+                self._consume_object(
+                    str(path), st, buf, start, fresh, capped,
+                    limit - len(out),
                 )
-                if (
-                    not fresh
-                    and st.st_size == cur.size
-                    and st.st_mtime_ns == cur.mtime_ns
-                ):
-                    continue  # unchanged since last poll
-                start = 0 if fresh else cur.offset
-                f.seek(start)
-                # bound the read to the fstat'ed size: bytes appended
-                # after the fstat belong to the next poll's lineage.
-                # Also cap the read: far behind a burst, the remainder
-                # can be 100s of MB while the batch limit only lets one
-                # poll deliver a few MB of lines — reading it all every
-                # poll would make catch-up quadratic in the backlog.
-                to_read = max(0, st.st_size - start)
-                capped = to_read > _READ_CAP
-                buf = f.read(_READ_CAP if capped else to_read)
-            consumed = 0
-            truncated = capped
-            # bulk fast path: hand every complete line in the buffer to
-            # the native span scanner in one call (~an order of magnitude
-            # cheaper than per-line Event.from_json — this is what keeps
-            # seconds_behind bounded under a wire-speed ingest burst).
-            # Bail to the per-line loop when the chunk carries tombstones
-            # (the scanner has no $delete shape) or fails to parse.
-            end = buf.rfind(b"\n") + 1
-            chunk = buf[:end]
-            parsed = None
-            if chunk and b'"$delete"' not in chunk:
-                remaining = limit - len(out)
-                if chunk.count(b"\n") > remaining:
-                    # trim to the remaining-limit'th newline; the rest of
-                    # the buffer is re-read on the next poll
-                    cut = -1
-                    for _ in range(remaining):
-                        cut = chunk.find(b"\n", cut + 1)
-                    chunk = chunk[: cut + 1]
-                    truncated = True
-                try:
-                    from predictionio_tpu import native
+            )
+        return out
 
-                    parsed = native.parse_events_jsonl(chunk)
-                except (ValueError, KeyError, UnicodeDecodeError) as err:
-                    logger.warning(
-                        "tailer: bulk parse failed, falling back "
-                        "per-line: %s",
-                        err,
-                    )
-                    parsed = None
-                    truncated = capped
-            if parsed is not None:
-                consumed = len(chunk)
-                for event in parsed:
-                    if (
-                        fresh
-                        and event.creation_time.timestamp()
-                        <= self._watermark
-                    ):
-                        continue
-                    if self._mark_seen(event):
-                        out.append(event)
-                self._finish_file(key, st, start + consumed, truncated)
+    # -- columnar poll mode -------------------------------------------------
+
+    def poll_columnar(self, limit: int | None = None) -> TailedBatch:
+        """Like :meth:`poll`, but rate-shaped chunks decode straight to
+        :class:`colspans.ColumnarTail` arrays (no per-line Event
+        objects). Cursor, rotation, torn-line, and dedupe semantics are
+        identical to :meth:`poll`; streams the classifier can't take
+        fall back to the object path per chunk or per line. Modes other
+        than "files" (and degraded no-native installs) deliver the
+        plain object poll wrapped in a one-segment batch."""
+        from predictionio_tpu import native
+
+        limit = self._batch_limit if limit is None else int(limit)
+        if (
+            self.mode != "files"
+            or self._columnar_config is None
+            or not native.native_available()
+        ):
+            events = self.poll(limit)  # poll() persists the cursor
+            return TailedBatch([events] if events else [])
+        segments = self._poll_files_columnar(limit)
+        self._save()
+        return TailedBatch(segments)
+
+    def _poll_files_columnar(self, limit: int) -> list:
+        segments: list = []
+        delivered = 0
+        for path in self._events.tail_files(self._app_id, self._channel_id):
+            if delivered >= limit:
+                break
+            read = self._read_file(path)
+            if read is None:
                 continue
-            pos = 0
-            while pos < len(buf):
-                nl = buf.find(b"\n", pos)
-                if nl < 0:
-                    break  # torn trailing line: wait for the newline
-                if len(out) >= limit:
-                    truncated = True
-                    break
-                raw = buf[pos:nl]
-                pos = nl + 1
-                consumed = pos
-                event = self._parse_line(raw)
+            st, fresh, start, buf, capped = read
+            key = str(path)
+            remaining = limit - delivered
+            if fresh:
+                # broken lineage (rotation/compaction/attach): the re-read
+                # from byte 0 needs watermark + per-event dedupe filtering,
+                # which is exactly the object path's job
+                segs = [
+                    self._consume_object(
+                        key, st, buf, start, True, capped, remaining
+                    )
+                ]
+            else:
+                segs = self._consume_columnar(
+                    key, st, buf, start, capped, remaining
+                )
+            for seg in segs:
+                n = _seg_len(seg)
+                if n:
+                    segments.append(seg)
+                    delivered += n
+        return segments
+
+    def _consume_columnar(
+        self, key, st, buf, start, capped, remaining
+    ) -> list:
+        """Deliver one read buffer through the span->array decoder.
+
+        The complete-line prefix of the buffer goes to the decoder in
+        one call; a chunk cut mid-line by the read cap hands only that
+        clean prefix over and records an offset-only cursor for the
+        remainder (no re-read of decoded bytes, no double-fold). Any
+        decode failure — including an injected ``tail.decode`` fault —
+        falls back to the object path for the whole chunk, counted in
+        ``pio_tailer_columnar_fallback_lines_total``."""
+        from predictionio_tpu.data.storage import colspans
+
+        end = buf.rfind(b"\n") + 1
+        chunk = buf[:end]
+        truncated = capped
+        if not chunk:
+            # torn-only buffer: wait for the writer to finish the line
+            self._finish_file(key, st, start, truncated)
+            return []
+        if b'"$delete"' in chunk:
+            # tombstones have no rate shape; the object path skips them
+            return [
+                self._consume_object(
+                    key, st, buf, start, False, capped, remaining
+                )
+            ]
+        if chunk.count(b"\n") > remaining:
+            cut = -1
+            for _ in range(remaining):
+                cut = chunk.find(b"\n", cut + 1)
+            chunk = chunk[: cut + 1]
+            truncated = True
+        t0 = time.perf_counter()
+        try:
+            faults.fault_point("tail.decode")
+            tail = colspans.decode_tail(chunk, self._columnar_config)
+        except Exception as err:
+            logger.warning(
+                "tailer: columnar decode failed, falling back to the "
+                "object path: %s", err,
+            )
+            _m_col_fallback.inc(chunk.count(b"\n"))
+            return [
+                self._consume_object(
+                    key, st, buf, start, False, capped, remaining
+                )
+            ]
+        # seen-id dedupe must stay sequential (an id can repeat within
+        # one chunk — replacement events — and across polls after a
+        # rotation re-read); rows without an id always deliver
+        drop: list[int] = []
+        for i, eid in enumerate(tail.event_ids):
+            if eid is None:
+                continue
+            if eid in self._seen:
+                drop.append(i)
+            else:
+                self._seen.add(eid)
+        if drop:
+            import numpy as np
+
+            keep = np.ones(tail.n_rows, dtype=bool)
+            keep[drop] = False
+            tail = tail.select(keep)
+        fb_events: list[Event] = []
+        if len(tail.fallback_lines):
+            # mixed stream: the classifier routed these line numbers to
+            # the object parser ($set payloads, non-rate events, odd
+            # syntax) — same per-line loop the object path runs
+            lines = chunk.split(b"\n")
+            for i in tail.fallback_lines:
+                event = self._parse_line(lines[i])
                 if event is None:
                     continue
-                if fresh and event.creation_time.timestamp() <= self._watermark:
-                    # rewrite resurfaced pre-attach history; not ours
-                    continue
                 if self._mark_seen(event):
-                    out.append(event)
-            self._finish_file(key, st, start + consumed, truncated)
+                    fb_events.append(event)
+        t1 = time.perf_counter()
+        _m_col_lines.inc(tail.n_rows)
+        _m_col_fallback.inc(len(tail.fallback_lines))
+        tr = obs_trace.current_trace()
+        if tr is not None:
+            tr.add_span("tail.decode", t0, t1)
+        self._finish_file(key, st, start + len(chunk), truncated)
+        out: list = [tail]
+        if fb_events:
+            out.append(fb_events)
         return out
 
     def _finish_file(self, key, st, new_offset: int, truncated: bool) -> None:

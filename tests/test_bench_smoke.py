@@ -117,6 +117,24 @@ class TestBenchCompare:
         assert bench_compare.leaf_direction(
             "rolling_restart_failed_requests") == "lower"
         assert bench_compare.leaf_direction("restarts") == "lower"
+        # columnar tail rung leaves
+        assert bench_compare.leaf_direction("tail_events_per_s") == "higher"
+        assert bench_compare.leaf_direction("tail_columnar_speedup") \
+            == "higher"
+        assert bench_compare.leaf_direction(
+            "tail_object_events_per_s") == "higher"
+
+    def test_columnar_tail_regression_flagged(self):
+        old = {"realtime": {"tail_columnar": {
+            "tail_events_per_s": 600000.0, "seconds_behind": 0.5,
+        }}}
+        new = {"realtime": {"tail_columnar": {
+            "tail_events_per_s": 250000.0, "seconds_behind": 0.5,
+        }}}
+        report = bench_compare.compare(old, new, tolerance=0.10)
+        assert [r["path"] for r in report["regressions"]] == [
+            "realtime.tail_columnar.tail_events_per_s"
+        ]
 
     def test_rolling_restart_failures_flagged(self):
         old = {"production_stack": {

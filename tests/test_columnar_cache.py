@@ -313,6 +313,98 @@ class TestFormat:
         assert columnar_cache.build_blocks(src.read_bytes()) is None
 
 
+class TestSharedDecoder:
+    """Tentpole invariant: ONE span->array decoder (colspans) under the
+    cache cold-build, the tailer's columnar poll, and ``pio import`` —
+    the cache must literally call it, and the tail decoder's shape
+    classifier must keep exactly the rows the native rating oracle
+    keeps."""
+
+    def test_cache_build_calls_shared_decoder(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.storage import colspans
+
+        src = tmp_path / "events_1.jsonl"
+        src.write_text(
+            '{"event":"rate","entityType":"user","entityId":"u1",'
+            '"targetEntityType":"item","targetEntityId":"i1",'
+            '"properties":{"rating":3.0},"eventId":"e1"}\n'
+        )
+        calls = []
+        orig = colspans.decode_columns
+
+        def spying(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(colspans, "decode_columns", spying)
+        blocks = columnar_cache.build_blocks(src.read_bytes())
+        assert blocks is not None
+        assert calls, "cache cold-build bypassed the shared decoder"
+        # the sentinel rides along too: one definition, re-exported
+        assert columnar_cache.TIME_ABSENT is colspans.TIME_ABSENT
+
+    def test_tail_decoder_matches_native_oracle(self):
+        from predictionio_tpu import native
+        from predictionio_tpu.data.storage import colspans
+
+        if not native.native_available():
+            pytest.skip("native scanner unavailable")
+        lines = [
+            json.dumps({
+                "event": "rate", "entityType": "user", "entityId": f"u{i}",
+                "targetEntityType": "item", "targetEntityId": f"i{i % 3}",
+                "properties": {"rating": float(i % 5 + 1)},
+                "eventId": f"e{i}",
+            }) for i in range(8)
+        ]
+        lines.append(json.dumps({
+            "event": "like", "entityType": "user", "entityId": "u1",
+            "targetEntityType": "item", "targetEntityId": "i9",
+            "eventId": "lk1",
+        }))
+        lines.append(json.dumps({
+            "event": "buy", "entityType": "user", "entityId": "u2",
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 99.0}, "eventId": "by1",
+        }))
+        lines.append(json.dumps({
+            "event": "$set", "entityType": "item", "entityId": "i1",
+            "properties": {"categories": ["c1"]}, "eventId": "st1",
+        }))
+        lines.append(json.dumps({
+            "event": "rate", "entityType": "user", "entityId": "u3",
+            "targetEntityType": "item", "targetEntityId": "i2",
+            "eventId": "nr1",  # rate-shaped, no resolvable rating
+        }))
+        buf = ("\n".join(lines) + "\n").encode()
+        sel = dict(
+            event_names=("rate", "like", "buy"),
+            default_ratings={"like": 1.0},
+            override_ratings={"buy": 4.0},
+            entity_type="user",
+            target_entity_type="item",
+        )
+        tail = colspans.decode_tail(
+            buf, colspans.DecodeConfig(rating_key="rating", **sel)
+        )
+        users, items, rows, cols, vals = native.load_ratings_jsonl(
+            buf, rating_key="rating", **sel
+        )
+        got = sorted(
+            (tail.user_ids[u], tail.item_ids[it], float(v))
+            for u, it, v in zip(tail.user_idx, tail.item_idx, tail.ratings)
+        )
+        want = sorted(
+            (users[r], items[c], float(v))
+            for r, c, v in zip(rows, cols, vals)
+        )
+        assert got == want
+        # the classifier routed the $set and the bare rate — and ONLY
+        # those — to the object path
+        routed = {buf.split(b"\n")[i] for i in tail.fallback_lines}
+        assert routed == {lines[-2].encode(), lines[-1].encode()}
+
+
 @pytest.mark.chaos
 class TestCrashConsistency:
     """Torn-write / kill-9 behavior of the cache publish path: a crash

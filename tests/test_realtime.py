@@ -1038,3 +1038,364 @@ class TestFoldInCircuitBreaker:
         assert status == 200
         assert body["realtime"]["breaker"]["state"] == "closed"
         assert body["realtime"]["breaker"]["trips_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# columnar tail path: span->array decode from log to fold-in (tentpole)
+# ---------------------------------------------------------------------------
+
+FILE_BACKENDS = {"jsonl": _jsonl_events, "partitioned": _partitioned_events}
+
+
+def _columnar_configs():
+    """Matching FoldInConfig/DecodeConfig exercising every rating
+    resolution rule: property extraction, per-event defaults, and
+    overrides."""
+    from predictionio_tpu.data.storage import colspans
+
+    cfg = FoldInConfig(
+        event_names=("rate", "buy", "like"),
+        default_ratings={"like": 5.0},
+        override_ratings={"buy": 4.0},
+    )
+    dcfg = colspans.DecodeConfig(
+        event_names=cfg.event_names,
+        rating_key=cfg.rating_key,
+        default_ratings=cfg.default_ratings,
+        override_ratings=cfg.override_ratings,
+        entity_type=cfg.entity_type,
+        target_entity_type=cfg.target_entity_type,
+    )
+    return cfg, dcfg
+
+
+def _batch_entity_ids(batch):
+    """Delivered entity ids across a TailedBatch's mixed segments, in
+    delivery order."""
+    out = []
+    for seg in batch.segments:
+        if isinstance(seg, list):
+            out.extend(e.entity_id for e in seg)
+        else:
+            out.extend(seg.user_ids[i] for i in seg.user_idx)
+    return out
+
+
+def _columnar_rows(batch):
+    return sum(
+        seg.n_rows for seg in batch.segments if not isinstance(seg, list)
+    )
+
+
+def _mixed_stream(events, app):
+    """One of every classifier route: plain rates, a default-rated
+    event, an override-rated event, a properties-rich $set, a
+    rate-shaped line with no resolvable rating, a brand-new user, and a
+    cold item."""
+    evs = [
+        _rate("u1", "i1", 5),
+        _rate("u2", "i2", 3),
+        Event(
+            event="like", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i3",
+        ),  # no rating property: default_ratings resolves 5.0
+        Event(
+            event="buy", entity_type="user", entity_id="u2",
+            target_entity_type="item", target_entity_id="i1",
+            properties={"rating": 1.0},
+        ),  # override_ratings forces 4.0 over the property
+        Event(
+            event="$set", entity_type="user", entity_id="u1",
+            properties={"plan": "pro"},
+        ),  # properties-rich: must route to the object path
+        _rate("u3", "i2", 4),
+        Event(
+            event="rate", entity_type="user", entity_id="u3",
+            target_entity_type="item", target_entity_id="i4",
+        ),  # rate-shaped but unresolvable: object path, not dropped
+        _rate("nu1", "i0", 5),  # user unknown to the model
+        _rate("u0", "COLD_ITEM", 4),  # item unknown to the model
+    ]
+    for e in evs:
+        events.insert(e, app)
+    return evs
+
+
+def _synthetic_model(storage_dtype="float32", n_users=4, n_items=6, rank=4):
+    from predictionio_tpu.data.bimap import BiMap
+
+    rng = np.random.default_rng(11)
+    U = rng.normal(size=(n_users, rank)).astype(np.float32)
+    V = rng.normal(size=(n_items, rank)).astype(np.float32)
+    user_scales = item_scales = None
+    if storage_dtype == "int8":
+        q, s = als_ops.quantize_rows(U)
+        U, user_scales = np.asarray(q), np.asarray(s)
+        q, s = als_ops.quantize_rows(V)
+        V, item_scales = np.asarray(q), np.asarray(s)
+    elif storage_dtype != "float32":
+        U = np.asarray(als_ops.to_storage(U, storage_dtype))
+        V = np.asarray(als_ops.to_storage(V, storage_dtype))
+    return rec.ALSModel(
+        user_index=BiMap.from_dense([f"u{i}" for i in range(n_users)]),
+        item_index=BiMap.from_dense([f"i{i}" for i in range(n_items)]),
+        user_factors=U,
+        item_factors=V,
+        user_scales=user_scales,
+        item_scales=item_scales,
+    )
+
+
+class TestColumnarTail:
+    """poll_columnar/fold_in_columnar must be observably identical to
+    poll/fold — same deliveries, same cursor durability, bit-identical
+    patches — while actually taking the span->array path for the
+    rate-shaped lines."""
+
+    APP = 7
+
+    def _attach_pair(self, make, tmp_path):
+        _, dcfg = _columnar_configs()
+        events = make(tmp_path)
+        # seed every partition so the logs exist BEFORE attach: a file
+        # born after attach re-reads as fresh, which by design routes
+        # to the object path
+        for k in range(4):
+            events.insert(_rate(f"pre{k}", "i0", 1), self.APP)
+        t_obj = EventTailer(events, self.APP)
+        t_col = EventTailer(events, self.APP, columnar_config=dcfg)
+        return events, t_obj, t_col
+
+    @pytest.mark.parametrize("storage_dtype", ["float32", "bfloat16", "int8"])
+    @pytest.mark.parametrize("backend", sorted(FILE_BACKENDS))
+    def test_mixed_stream_bit_parity(self, tmp_path, backend, storage_dtype):
+        cfg, _ = _columnar_configs()
+        events, t_obj, t_col = self._attach_pair(
+            FILE_BACKENDS[backend], tmp_path
+        )
+        inserted = _mixed_stream(events, self.APP)
+        obj_events = t_obj.poll()
+        batch = t_col.poll_columnar()
+        assert batch.n_events == len(obj_events) == len(inserted)
+        assert _columnar_rows(batch) > 0  # the array path actually ran
+        assert sorted(_batch_entity_ids(batch)) == sorted(
+            e.entity_id for e in obj_events
+        )
+
+        model = _synthetic_model(storage_dtype)
+        foldin_o = ALSFoldIn(events, self.APP, config=cfg)
+        patched_o, stats_o = foldin_o.fold(model, obj_events)
+        foldin_c = ALSFoldIn(events, self.APP, config=cfg)
+        patched_c, stats_c = foldin_c.fold_in_columnar(model, batch)
+        assert patched_o is not None and patched_c is not None
+        assert stats_c == stats_o
+        assert stats_c.users_added == 1  # nu1
+        assert stats_c.cold_item_events == 1  # COLD_ITEM
+        assert list(patched_c.user_index) == list(patched_o.user_index)
+        assert patched_c.user_factors.dtype == patched_o.user_factors.dtype
+        assert np.array_equal(patched_c.user_factors, patched_o.user_factors)
+        if storage_dtype == "int8":
+            assert np.array_equal(
+                patched_c.user_scales, patched_o.user_scales
+            )
+        assert foldin_c.cold_start_stats() == foldin_o.cold_start_stats()
+
+    def test_rotation_mid_stream_no_duplicates(self, tmp_path):
+        _, dcfg = _columnar_configs()
+        events = _jsonl_events(tmp_path)
+        events.insert(_rate("old", "i0", 1), self.APP)
+        t = EventTailer(events, self.APP, columnar_config=dcfg)
+        events.insert(_rate("u1", "i1", 5), self.APP)
+        assert _batch_entity_ids(t.poll_columnar()) == ["u1"]
+        # compact() rewrites the log into a NEW inode: the re-read goes
+        # through the object path (fresh lineage) and the seen-id set
+        # must swallow u1 instead of re-delivering it
+        events.compact(self.APP)
+        assert t.poll_columnar().n_events == 0
+        events.insert(_rate("u2", "i2", 5), self.APP)
+        batch = t.poll_columnar()
+        assert _batch_entity_ids(batch) == ["u2"]
+        assert _columnar_rows(batch) == 1  # back on the array path
+
+    def test_torn_trailing_line_columnar(self, tmp_path):
+        events = _jsonl_events(tmp_path)
+        events.insert(_rate("pre", "i0", 1), self.APP)
+        _, dcfg = _columnar_configs()
+        cursor = tmp_path / "cursor.json"
+        t = EventTailer(
+            events, self.APP, cursor_path=cursor, columnar_config=dcfg
+        )
+        path = events._file(self.APP, None)
+        rec_line = json.dumps(
+            _rate("torn", "i5", 2)
+            .with_event_id("torn-col")
+            .to_dict(for_api=False)
+        )
+        with open(path, "ab") as f:
+            f.write(rec_line[:25].encode())  # writer died mid-append
+        assert t.poll_columnar().n_events == 0
+        with open(path, "ab") as f:
+            f.write((rec_line[25:] + "\n").encode())
+        batch = t.poll_columnar()
+        assert _batch_entity_ids(batch) == ["torn"]  # exactly once
+        assert _columnar_rows(batch) == 1
+        assert t.poll_columnar().n_events == 0
+        # restart across the healed line: still not re-delivered
+        t2 = EventTailer(
+            events, self.APP, cursor_path=cursor, columnar_config=dcfg
+        )
+        assert t2.poll_columnar().n_events == 0
+
+    def test_read_cap_resumes_without_rereading(self, tmp_path, monkeypatch):
+        """A capped read hands the decoder a clean newline prefix and
+        parks the remainder behind an offset-only cursor: every line is
+        delivered exactly once, in order, with no re-read."""
+        from predictionio_tpu.realtime import tailer as tailer_mod
+
+        _, dcfg = _columnar_configs()
+        events = _jsonl_events(tmp_path)
+        events.insert(_rate("pre", "i0", 1), self.APP)
+        t = EventTailer(events, self.APP, columnar_config=dcfg)
+        for k in range(40):
+            events.insert(_rate(f"u{k}", "i1", 5), self.APP)
+        monkeypatch.setattr(tailer_mod, "_READ_CAP", 1024)
+        batch = t.poll_columnar()
+        assert 0 < batch.n_events < 40
+        cur = t._files[str(events._file(self.APP, None))]
+        # the cap leaves an offset-only cursor (lineage unverifiable
+        # until the remainder is consumed)
+        assert cur.mtime_ns == -1 and cur.size == -1
+        delivered = _batch_entity_ids(batch)
+        polls = 1
+        while True:
+            got = t.poll_columnar()
+            if not got.n_events:
+                break
+            delivered.extend(_batch_entity_ids(got))
+            polls += 1
+        assert polls > 1
+        assert delivered == [f"u{k}" for k in range(40)]
+
+    def test_decode_fault_falls_back_to_object_path(self, tmp_path):
+        from predictionio_tpu import faults
+        from predictionio_tpu.realtime import tailer as tailer_mod
+
+        _, dcfg = _columnar_configs()
+        events = _jsonl_events(tmp_path)
+        events.insert(_rate("pre", "i0", 1), self.APP)
+        t = EventTailer(events, self.APP, columnar_config=dcfg)
+        for k in range(3):
+            events.insert(_rate(f"u{k}", "i1", 4), self.APP)
+        fb_before = tailer_mod._m_col_fallback.value()
+        with faults.injected("tail.decode:always") as plan:
+            batch = t.poll_columnar()
+        assert plan.fire_count("tail.decode") == 1
+        # identical delivery, just via the object parser
+        assert _batch_entity_ids(batch) == ["u0", "u1", "u2"]
+        assert _columnar_rows(batch) == 0
+        assert tailer_mod._m_col_fallback.value() == fb_before + 3
+        # and nothing is re-delivered once the fault clears
+        assert t.poll_columnar().n_events == 0
+
+    def test_counters_split_columnar_vs_fallback(self, tmp_path):
+        from predictionio_tpu.realtime import tailer as tailer_mod
+
+        events, _, t_col = self._attach_pair(_jsonl_events, tmp_path)
+        col0 = tailer_mod._m_col_lines.value()
+        fb0 = tailer_mod._m_col_fallback.value()
+        _mixed_stream(events, self.APP)
+        batch = t_col.poll_columnar()
+        col_rows = _columnar_rows(batch)
+        assert col_rows == 7  # 9 lines minus $set minus the bare rate
+        assert tailer_mod._m_col_lines.value() == col0 + col_rows
+        assert (
+            tailer_mod._m_col_fallback.value()
+            == fb0 + batch.n_events - col_rows
+        )
+
+    def test_decode_records_trace_span(self, tmp_path):
+        from predictionio_tpu.obs import trace as obs_trace
+
+        events, _, t_col = self._attach_pair(_jsonl_events, tmp_path)
+        events.insert(_rate("u1", "i1", 5), self.APP)
+        tr = obs_trace.Trace("poll")
+        obs_trace.set_current_trace(tr)
+        try:
+            assert t_col.poll_columnar().n_events == 1
+        finally:
+            obs_trace.set_current_trace(None)
+        assert any(name == "tail.decode" for name, _, _ in tr.spans)
+
+    def test_seq_backend_wraps_object_poll(self, tmp_path):
+        """Backends without tail_files() keep working: poll_columnar
+        degrades to the object poll, one Event segment."""
+        _, dcfg = _columnar_configs()
+        events = _memory_events(tmp_path)
+        t = EventTailer(events, self.APP, columnar_config=dcfg)
+        events.insert(_rate("u1", "i1", 5), self.APP)
+        batch = t.poll_columnar()
+        assert batch.n_events == 1 and _columnar_rows(batch) == 0
+        assert _batch_entity_ids(batch) == ["u1"]
+
+
+def test_columnar_foldin_vs_retrain(storage, tmp_path):
+    """The retrain leg of the parity matrix: a columnar fold of a new
+    user's ratings must rank like a from-scratch retrain that saw the
+    same events (test_foldin_parity_vs_retrain pins the object path;
+    the bit-parity tests above pin columnar == object; this closes the
+    triangle directly)."""
+    info = commands.app_new("ColFoldApp", storage=storage)
+    app_id = info["id"]
+    mem_events = storage.get_events()
+    log_events = _jsonl_events(tmp_path)
+    APP = 7
+
+    def both(mk):
+        mem_events.insert(mk(), app_id)
+        log_events.insert(mk(), APP)
+
+    for u in range(6):
+        for i in range(8):
+            both(lambda: _rate(f"a{u}", f"i{i}", 5 if i < 4 else 1))
+            both(lambda: _rate(f"b{u}", f"i{i}", 1 if i < 4 else 5))
+    base_model, _ = _train_model(
+        storage, "ColFoldApp", "float32", False, "colfold"
+    )
+    assert "newu" not in base_model.user_index
+
+    from predictionio_tpu.data.storage import colspans
+
+    t = EventTailer(
+        log_events, APP, columnar_config=colspans.DecodeConfig()
+    )
+    new_ratings = {"i0": 5, "i1": 5, "i4": 1, "i5": 1}
+    for iid, v in new_ratings.items():
+        both(lambda: _rate("newu", iid, v))
+    batch = t.poll_columnar()
+    assert batch.n_events == len(new_ratings)
+    assert _columnar_rows(batch) == len(new_ratings)
+
+    foldin = ALSFoldIn(log_events, APP, config=FoldInConfig())
+    patched, stats = foldin.fold_in_columnar(base_model, batch)
+    assert patched is not None and stats.users_added == 1
+
+    retrained, _ = _train_model(
+        storage, "ColFoldApp", "float32", False, "colfold2"
+    )
+    s_fold = _scores(patched, "newu")
+    s_full = _scores(retrained, "newu")
+    for s in (s_fold, s_full):
+        assert min(s["i2"], s["i3"]) > max(s["i6"], s["i7"]), s
+    top3 = lambda s: {  # noqa: E731
+        i for i, _ in sorted(s.items(), key=lambda kv: -kv[1])[:3]
+    }
+    assert len(top3(s_fold) & top3(s_full)) >= 2
+
+    def rmse(s):
+        err = [s[iid] - v for iid, v in new_ratings.items()]
+        return float(np.sqrt(np.mean(np.square(err))))
+
+    assert rmse(s_fold) <= rmse(s_full) + RMSE_TOL["float32"], (
+        rmse(s_fold),
+        rmse(s_full),
+    )
