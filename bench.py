@@ -3036,6 +3036,14 @@ def _compact_summary(result: dict) -> dict:
             ),
             "ok": ps.get("ok"),
         }
+    dn = result.get("density")
+    if isinstance(dn, dict) and "error" not in dn:
+        s["density"] = {
+            k: dn[k]
+            for k in ("mmap_cold_load_speedup", "rss_ratio",
+                      "rss_pickle_n8_mb", "jit_compiles_added", "ok")
+            if k in dn
+        }
     errors = sorted(
         k for k, v in result.items()
         if isinstance(v, dict) and "error" in v
@@ -3157,6 +3165,240 @@ def bench_serving_smoke(result: dict) -> None:
         )
     finally:
         set_storage(None)
+
+
+def _density_model(n_users: int, n_items: int, rank: int):
+    """Synthetic int8 ALSModel at multi-tenant density scale: dense id
+    dictionaries (u0..uN / i0..iN) plus quantized factor tables with
+    per-row scales — exactly the shape the modelfile encodes zero-copy."""
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.recommendation import ALSModel
+
+    rng = np.random.default_rng(SEED)
+    return ALSModel(
+        user_index=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_index=BiMap({f"i{i}": i for i in range(n_items)}),
+        user_factors=rng.integers(
+            -127, 128, size=(n_users, rank), dtype=np.int8
+        ),
+        item_factors=rng.integers(
+            -127, 128, size=(n_items, rank), dtype=np.int8
+        ),
+        user_scales=rng.random(n_users, dtype=np.float32) * 0.02 + 1e-3,
+        item_scales=rng.random(n_items, dtype=np.float32) * 0.02 + 1e-3,
+    )
+
+
+def _density_rss_child(path: str, n: int, mode: str) -> None:
+    """--density-rss-child <path> <n> <mode>: load one model the way N
+    tenant mounts would and print peak RSS in KB. mode=mmap goes through
+    modelfile.shared_entries — N mounts share ONE mapping and ONE
+    decoded entries list. mode=pickle is the pre-modelfile counterfactual:
+    N private deserialized copies."""
+    import pickle
+
+    models = []
+    if mode == "mmap":
+        from predictionio_tpu.models import modelfile
+
+        for _ in range(n):
+            ents = modelfile.shared_entries(path)
+            models.append([payload for _kind, payload in ents])
+    else:
+        for _ in range(n):
+            with open(path, "rb") as f:
+                models.append([p for _kind, p in pickle.loads(f.read())])
+    for ms in models:  # touch what a tenant's first query touches
+        m = ms[0]
+        _ = m.user_index["u0"]
+        _ = m.user_rows([0, 1, 2])
+    print(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+
+
+def _density_jit_added(smoke: bool) -> int:
+    """Train one tiny rec instance, mount it 8 times on one EngineServer
+    (1 default + 7 co-tenants), warm the DEFAULT tenant's jit shape
+    buckets, then replay the same query mix through tenants 2..8 and
+    return how many NEW compiles that added. Pow2 bucketing makes the
+    compiled programs tenant-independent, so the answer must be 0."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import App, set_storage, test_storage
+    from predictionio_tpu.models import recommendation
+    from predictionio_tpu.obs import device as obs_device
+    from predictionio_tpu.server.engine_server import EngineServer
+
+    storage = test_storage()
+    set_storage(storage)
+    try:
+        apps = storage.get_metadata_apps()
+        events = storage.get_events()
+        app_id = apps.insert(App(0, "DensityJit"))
+        events.init(app_id)
+        rng = np.random.default_rng(SEED)
+        batch = [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties={"rating": float(r)},
+            )
+            for u, i, r in zip(
+                rng.integers(0, 200, 2000), rng.integers(0, 60, 2000),
+                rng.integers(1, 6, 2000),
+            )
+        ]
+        events.batch_insert(batch, app_id)
+        engine = recommendation.engine()
+        variant = {
+            "id": "density-jit",
+            "engineFactory": "predictionio_tpu.models.recommendation.engine",
+            "datasource": {"params": {"app_name": "DensityJit"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 8, "num_iterations": 2}}],
+        }
+        run_train(
+            engine, engine.params_from_variant(variant),
+            engine_id="density-jit",
+            engine_factory="predictionio_tpu.models.recommendation.engine",
+            workflow_params=WorkflowParams(batch="bench"), storage=storage,
+        )
+        inst = storage.get_metadata_engine_instances().get_latest_completed(
+            "density-jit", "0", "default"
+        )
+        # never started: serve_query_bytes is the in-process read path,
+        # which is exactly the jit-facing part under test
+        server = EngineServer(
+            engine, inst, storage=storage, host="127.0.0.1", port=0,
+            extra_variants=[
+                (f"t{i}", recommendation.engine(), inst) for i in range(2, 9)
+            ],
+        )
+        bodies = [{"user": f"u{u}", "num": 4} for u in range(0, 64, 2)]
+        for b in bodies:  # warm the default tenant's shape buckets
+            server.serve_query_bytes(b)
+
+        def compiles() -> int:
+            return sum(
+                row.get("compiles", 0)
+                for row in obs_device.compile_snapshot().values()
+            )
+
+        base = compiles()
+        for v in server.variants.values():
+            if v is server._default_variant:
+                continue
+            for b in bodies:
+                server.serve_query_bytes(b, v)
+        return compiles() - base
+    finally:
+        set_storage(None)
+
+
+def bench_density(result: dict, smoke: bool = False) -> None:
+    """Multi-tenant density gates — N variants of one int8 model in one
+    process. Gate 1: cold load through the zero-copy modelfile beats
+    pickle >= 20x (header parse + mmap views, no byte churn). Gate 2:
+    peak RSS with 8 tenants mounting one model file stays <= 1.35x the
+    single-tenant RSS (shared mapping + shared decoded entries). Gate 3:
+    adding tenants adds ZERO jit compiles (pow2 buckets keep compiled
+    programs tenant-independent)."""
+    import pickle
+    import subprocess
+    import sys as _sys
+
+    from predictionio_tpu.models import modelfile
+
+    n_users, n_items, rank = (
+        (200_000, 5_000, 32) if smoke else (1_000_000, 50_000, 32)
+    )
+    block: dict = {
+        "users": n_users, "items": n_items, "rank": rank, "tenants": 8,
+    }
+    result["density"] = block
+    tmp = os.environ.get("BENCH_TMPDIR") or tempfile.mkdtemp(
+        prefix="pio_bench_density_"
+    )
+    model = _density_model(n_users, n_items, rank)
+    entries = [("arrays", model)]
+    assert modelfile.can_encode(model), "density model must be encodable"
+    blob = modelfile.serialize(entries, model_id="bench-density")
+    mf_path = os.path.join(tmp, "density.piomf")
+    pkl_path = os.path.join(tmp, "density.pkl")
+    with open(mf_path, "wb") as f:
+        f.write(blob)
+    with open(pkl_path, "wb") as f:
+        pickle.dump(entries, f, protocol=pickle.HIGHEST_PROTOCOL)
+    block["modelfile_mb"] = round(len(blob) / 2**20, 1)
+    block["pickle_mb"] = round(os.path.getsize(pkl_path) / 2**20, 1)
+
+    # gate 1: cold load, best-of-N each way; file read included on both
+    # sides, and the shared-entries cache cleared so every mmap rep
+    # pays the full open+map+header-parse cost
+    reps = 3 if smoke else 5
+
+    def best_of(fn) -> float:
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def load_pickle():
+        with open(pkl_path, "rb") as f:
+            pickle.loads(f.read())
+
+    def load_mmap():
+        modelfile._clear_shared()
+        modelfile.load_path(mf_path).entries()
+
+    t_pk = best_of(load_pickle)
+    t_mm = best_of(load_mmap)
+    block["pickle_load_ms"] = round(t_pk * 1e3, 2)
+    block["mmap_load_ms"] = round(t_mm * 1e3, 3)
+    block["mmap_cold_load_speedup"] = round(t_pk / t_mm, 1)
+
+    # gate 2: child processes so ru_maxrss isolates each mount count
+    def rss_kb(path: str, n: int, mode: str) -> int:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [_sys.executable, os.path.abspath(__file__),
+             "--density-rss-child", path, str(n), mode],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"rss child ({mode}, n={n}) failed: "
+                f"{proc.stderr.strip()[-400:]}"
+            )
+        return int(proc.stdout.strip().splitlines()[-1])
+
+    rss1 = rss_kb(mf_path, 1, "mmap")
+    rss8 = rss_kb(mf_path, 8, "mmap")
+    block["rss_n1_mb"] = round(rss1 / 1024, 1)
+    block["rss_n8_mb"] = round(rss8 / 1024, 1)
+    block["rss_ratio"] = round(rss8 / rss1, 3)
+    # counterfactual: 8 private pickle copies of the same model
+    block["rss_pickle_n8_mb"] = round(rss_kb(pkl_path, 8, "pickle") / 1024, 1)
+
+    # gate 3: compiles must stay flat as tenants 2..8 come online
+    block["jit_compiles_added"] = _density_jit_added(smoke)
+
+    block["load_ok"] = block["mmap_cold_load_speedup"] >= 20
+    block["rss_ok"] = block["rss_ratio"] <= 1.35
+    block["jit_ok"] = block["jit_compiles_added"] == 0
+    block["ok"] = block["load_ok"] and block["rss_ok"] and block["jit_ok"]
+    assert block["load_ok"], (
+        f"mmap cold load speedup {block['mmap_cold_load_speedup']}x < 20x"
+    )
+    assert block["rss_ok"], (
+        f"RSS(N=8) is {block['rss_ratio']}x RSS(N=1), budget 1.35x"
+    )
+    assert block["jit_ok"], (
+        f"adding 7 tenants added {block['jit_compiles_added']} jit compiles"
+    )
 
 
 def _prod_supervised_crash(tmp: str, smoke: bool) -> dict:
@@ -4310,6 +4552,48 @@ def production_stack_main(smoke: bool) -> None:
     _sys.exit(0 if ok else 1)
 
 
+def density_main(smoke: bool) -> None:
+    """``bench.py density [--smoke]``: the multi-tenant density scenario
+    on its own — modelfile cold-load speedup, 8-tenant RSS ratio, and
+    jit-compile flatness. Prints the full-detail line plus the compact
+    summary line; exits non-zero unless every gate passed."""
+    import atexit
+    import shutil
+    import sys as _sys
+
+    if smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    from predictionio_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+    tmpdir = tempfile.mkdtemp(prefix="pio_bench_density_")
+    atexit.register(shutil.rmtree, tmpdir, ignore_errors=True)
+    os.environ["BENCH_TMPDIR"] = tmpdir
+    result: dict = {
+        "metric": "bench_density",
+        "value": None,
+        "unit": "s",
+        "device": "cpu (smoke)" if smoke else "default",
+        "smoke": smoke,
+    }
+    t0 = time.perf_counter()
+    try:
+        bench_density(result, smoke=smoke)
+    except Exception as e:
+        block = result.get("density")
+        err = f"{type(e).__name__}: {e}"
+        if isinstance(block, dict):
+            block["error"] = err
+        else:
+            result["density"] = {"error": err}
+    result["value"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(result))
+    print(json.dumps(_compact_summary(result)))
+    d = result.get("density", {})
+    ok = d.get("ok") is True and "error" not in d
+    _sys.exit(0 if ok else 1)
+
+
 def obs_main() -> None:
     """``bench.py obs``: the observability-tax section on its own — the
     serving A/B, the instrumented-sequence gate, the device tracker
@@ -4454,6 +4738,15 @@ def main() -> None:
         return
     if "obs" in sys.argv:
         obs_main()
+        return
+    if "--density-rss-child" in sys.argv:
+        i = sys.argv.index("--density-rss-child")
+        _density_rss_child(
+            sys.argv[i + 1], int(sys.argv[i + 2]), sys.argv[i + 3]
+        )
+        return
+    if "density" in sys.argv:
+        density_main(smoke="--smoke" in sys.argv)
         return
     if "--smoke" in sys.argv:
         smoke_main()

@@ -36,7 +36,7 @@ _HIGHER_BETTER = (
 _SKIP = (
     "value", "conns", "clients", "workers", "batch_size", "cores",
     "acked", "n", "count", "rounds", "budget", "objective", "seed",
-    "port", "pid", "capacity", "scale",
+    "port", "pid", "capacity", "scale", "tenants", "variants",
 )
 
 

@@ -129,7 +129,48 @@ def cmd_status(args) -> int:
         print(line)
     for line in _slo_lines():
         print(line)
+    for line in _variant_lines():
+        print(line)
     return 0
+
+
+def _variant_lines() -> list[str]:
+    """Human per-tenant lines for ``pio status`` when a live engine
+    daemon mounts more than one variant: one row per mount off its
+    /stats.json ``variants`` block, e.g.
+    ``variant[engine/b]: 124 reqs, p99 3.1ms, epoch 2``."""
+    import urllib.request
+
+    from predictionio_tpu.cli import daemon
+
+    lines: list[str] = []
+    for name in daemon.known_services():
+        if daemon.read_pid(name) is None:
+            continue
+        port = daemon.DEFAULT_PORTS.get(name, 0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats.json", timeout=2.0
+            ) as r:
+                stats = json.loads(r.read())
+        except Exception:
+            continue
+        variants = (
+            stats.get("variants") if isinstance(stats, dict) else None
+        ) or {}
+        if len(variants) <= 1:
+            continue
+        for vname, v in variants.items():
+            parts = [f"{v.get('requestCount', 0)} reqs"]
+            if v.get("p99Ms") is not None:
+                parts.append(f"p99 {v['p99Ms']}ms")
+            parts.append(f"epoch {v.get('epoch', '?')}")
+            if v.get("secondsBehind") is not None:
+                parts.append(f"{v['secondsBehind']}s behind")
+            if v.get("modelAgeSec") is not None:
+                parts.append(f"model age {v['modelAgeSec']}s")
+            lines.append(f"variant[{name}/{vname}]: {', '.join(parts)}")
+    return lines
 
 
 def _supervisor_lines() -> list[str]:
@@ -451,6 +492,24 @@ def _top_row(name: str, base: str) -> dict:
         row["alerts"] = len(slo_doc.get("alerts", []))
     except Exception:
         pass
+    # multi-tenant engine servers: one sub-row per mounted variant
+    # (/stats.json "variants" block); solo deploys render no sub-rows
+    try:
+        stats = fetch("/stats.json")
+        variants = stats.get("variants") or {}
+        if len(variants) > 1:
+            row["variants"] = {
+                vname: {
+                    "requests": v.get("requestCount"),
+                    "p99_ms": v.get("p99Ms"),
+                    "epoch": v.get("epoch"),
+                    "seconds_behind": v.get("secondsBehind"),
+                    "model_age_s": v.get("modelAgeSec"),
+                }
+                for vname, v in variants.items()
+            }
+    except Exception:
+        pass
     return row
 
 
@@ -488,6 +547,14 @@ def cmd_top(args) -> int:
                 f"{row.get('burn', '-'):>7} {slo_str:<22} "
                 f"{row.get('alerts', 0):>6}"
             )
+            for vname, v in (row.get("variants") or {}).items():
+                req = v.get("requests")
+                print(
+                    f"  ↳{vname:<12} {req if req is not None else '-':>9} "
+                    f"{v.get('p99_ms') if v.get('p99_ms') is not None else '-':>9} "
+                    f"{v.get('seconds_behind') if v.get('seconds_behind') is not None else '-':>9} "
+                    f"{'':>7} epoch:{v.get('epoch', '-')}"
+                )
         if not rows:
             print("no live daemons (and no --url given)")
         if once:
@@ -926,6 +993,13 @@ def cmd_deploy(args) -> int:
                 file=sys.stderr,
             )
             return 1
+    try:
+        extra_variants = _resolve_extra_variants(args, instances)
+    except SystemExit:
+        raise
+    except Exception as e:
+        print(f"--variants resolution failed: {e}", file=sys.stderr)
+        return 1
     server = EngineServer(
         engine,
         instance,
@@ -945,13 +1019,14 @@ def cmd_deploy(args) -> int:
         batch_window_ms=args.batch_window_ms,
         reuse_port=args.reuse_port,
         query_cache_mb=args.query_cache_mb,
+        extra_variants=extra_variants,
     )
     # AOT warmup BEFORE the port binds: the first real query hits a
     # compiled scoring program (and, with PIO_COMPILATION_CACHE_DIR, the
     # compile itself persists across restarts)
     if not getattr(args, "no_warmup", False):
         server.warmup()
-    layer = None
+    layers = []
     if getattr(args, "realtime", 0.0) and args.realtime > 0:
         from pathlib import Path
 
@@ -962,16 +1037,77 @@ def cmd_deploy(args) -> int:
             / "realtime"
             / f"cursor_{instance.engine_id}_{args.port}.json"
         )
-        layer = SpeedLayer(server, interval=args.realtime, cursor_path=cursor)
-        layer.start()
+        layers.append(
+            SpeedLayer(server, interval=args.realtime, cursor_path=cursor)
+        )
+        # every co-tenant mount tails and folds independently — its
+        # layer holds the _Variant, so patches land behind that mount's
+        # own epoch fence, never a neighbor's
+        for name, v in server.variants.items():
+            if v is server._default_variant:
+                continue
+            vcursor = str(
+                Path("~/.pio_tpu").expanduser()
+                / "realtime"
+                / f"cursor_{v.instance.engine_id}_{args.port}_{name}.json"
+            )
+            layers.append(
+                SpeedLayer(v, interval=args.realtime, cursor_path=vcursor)
+            )
+        for layer in layers:
+            layer.start()
     # foreground, like the reference: backgrounding is the caller's job
     # (shell &, supervisor); a daemon thread would die with this process
     try:
         server.start(background=False)
     finally:
-        if layer is not None:
+        for layer in layers:
             layer.stop()
     return 0
+
+
+def _resolve_extra_variants(args, instances) -> list:
+    """``--variants a.json,b.json`` -> [(mount_name, engine, instance)].
+
+    Each file resolves exactly like a solo ``pio deploy --variant`` of
+    that path: its own engineFactory (falling back to the primary's),
+    its own (id, version, basename-label) instance lookup. The mount
+    name is the file's basename minus ``.json`` — the path prefix
+    queries route on (``/<name>/queries.json``)."""
+    spec = getattr(args, "variants", None) or ""
+    paths = [p.strip() for p in spec.split(",") if p.strip()]
+    if not paths:
+        return []
+    from predictionio_tpu.core.engine import resolve_engine_factory
+    from predictionio_tpu.core.workflow import load_variant
+
+    extra = []
+    for path in paths:
+        variant = load_variant(path)
+        factory = variant.get("engineFactory") or getattr(
+            args, "engine_factory", None
+        )
+        if not factory:
+            raise SystemExit(
+                f"error: variant file {path} has no engineFactory field "
+                "and no --engine-factory was given"
+            )
+        engine = resolve_engine_factory(factory)
+        engine_id = variant.get("id") or os.path.dirname(
+            os.path.realpath(path)
+        )
+        label = os.path.basename(path)
+        inst = instances.get_latest_completed(
+            engine_id, variant.get("version", "0"), label
+        )
+        if inst is None:
+            raise SystemExit(
+                f"error: no completed engine instance for variant {path} "
+                "(train it first: pio train --variant " + path + ")"
+            )
+        name = label[:-5] if label.endswith(".json") else label
+        extra.append((name, engine, inst))
+    return extra
 
 
 def cmd_undeploy(args) -> int:
@@ -1152,6 +1288,15 @@ def cmd_start_all(args) -> int:
             deploy += ["--engine-factory", args.engine_factory]
         if args.engine_dir:
             deploy += ["--engine-dir", os.path.abspath(args.engine_dir)]
+        if getattr(args, "variants", None):
+            deploy += [
+                "--variants",
+                ",".join(
+                    os.path.abspath(p.strip())
+                    for p in args.variants.split(",")
+                    if p.strip()
+                ),
+            ]
         plan.append(("engine", deploy, args.engine_port))
 
     if getattr(args, "supervise", False):
@@ -1541,6 +1686,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="durable tailer cursor file (default: "
         "~/.pio_tpu/realtime/cursor_<engine>_<port>.json)",
     )
+    d.add_argument(
+        "--variants", metavar="A.JSON,B.JSON",
+        help="mount additional trained engine variants in THIS process, "
+        "routed by path prefix (/<name>/queries.json, name = file "
+        "basename minus .json) or the X-PIO-Variant header; each mount "
+        "keeps its own epoch fence, /reload, and speed layer while "
+        "sharing the HTTP front end, micro-batcher, and jit cache — "
+        "see docs/serving.md",
+    )
     d.set_defaults(fn=cmd_deploy)
 
     u = sub.add_parser("undeploy")
@@ -1639,6 +1793,11 @@ def build_parser() -> argparse.ArgumentParser:
         )
         parser.add_argument(
             "--engine-dir", help="also deploy the engine in this dir"
+        )
+        parser.add_argument(
+            "--variants", metavar="A.JSON,B.JSON",
+            help="co-mount these trained engine variants in the "
+            "deployed engine process (see pio deploy --variants)",
         )
         parser.add_argument(
             "--supervise-port", type=int, default=0,

@@ -166,13 +166,28 @@ def prepare_deploy(
     algorithms = engine.make_algorithms(engine_params)
     serving = engine.make_serving(engine_params)
 
-    blob = storage.get_model_data_models().get(instance.id)
-    if blob is None:
-        raise RuntimeError(
-            f"no persisted model for engine instance {instance.id}; "
-            "was it trained with save_model=False?"
+    # zero-copy fast path: when the model store keeps the blob as a local
+    # file in the flat model-file format, mmap it in place — no byte
+    # copy, and variants/replicas of this instance share pages and
+    # decoded model objects. Falls through to the byte read for remote
+    # stores and legacy pickle blobs.
+    model_store = storage.get_model_data_models()
+    models = None
+    local = model_store.local_path(instance.id)
+    if local is not None:
+        models = persistence.deserialize_model_path(
+            local, algorithms, instance.id
         )
-    models = persistence.deserialize_models(blob.models, algorithms, instance.id)
+    if models is None:
+        blob = model_store.get(instance.id)
+        if blob is None:
+            raise RuntimeError(
+                f"no persisted model for engine instance {instance.id}; "
+                "was it trained with save_model=False?"
+            )
+        models = persistence.deserialize_models(
+            blob.models, algorithms, instance.id
+        )
     if any(m is persistence.RETRAIN for m in models):
         logger.info("instance %s has retrain-on-deploy models; training", instance.id)
         retrained = engine.train(ctx, engine_params, algorithms=algorithms)

@@ -264,6 +264,13 @@ class Models(abc.ABC):
     @abc.abstractmethod
     def delete(self, model_id: str) -> bool: ...
 
+    def local_path(self, model_id: str) -> str | None:
+        """Filesystem path of the stored blob when the backend keeps it
+        as a plain local file (localfs), else None. The deploy path uses
+        this to mmap model files in place instead of copying the bytes
+        through :meth:`get`."""
+        return None
+
 
 @dataclass
 class RatingsBatch:

@@ -11,6 +11,7 @@ import os
 from pathlib import Path
 from urllib.parse import quote
 
+from predictionio_tpu import faults
 from predictionio_tpu.data.storage import base
 
 
@@ -40,7 +41,9 @@ class LocalFSModels(base.Models):
         with open(tmp, "wb") as f:
             f.write(model.models)
             f.flush()
+            faults.fault_point("storage.fsync")
             os.fsync(f.fileno())
+        faults.fault_point("storage.rename")
         tmp.replace(path)
 
     def get(self, model_id: str) -> base.Model | None:
@@ -48,6 +51,10 @@ class LocalFSModels(base.Models):
         if not p.exists():
             return None
         return base.Model(model_id, p.read_bytes())
+
+    def local_path(self, model_id: str) -> str | None:
+        p = self._path(model_id)
+        return str(p) if p.exists() else None
 
     def delete(self, model_id: str) -> bool:
         p = self._path(model_id)

@@ -86,6 +86,10 @@ KNOWN_POINTS: dict[str, str] = {
                   "(HTTPApp.begin_drain)",
     "supervisor.spawn": "fleet-supervisor child (re)spawn "
                         "(server/supervisor.py)",
+    "serve.model_mmap": "model-file mmap attempt at deploy/reload "
+                        "(models/modelfile.py; a raise falls the load "
+                        "back to a plain byte read, counted in "
+                        "pio_model_mmap_fallback_total)",
 }
 
 _EXCEPTIONS: dict[str, type[BaseException]] = {

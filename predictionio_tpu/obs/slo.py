@@ -650,6 +650,28 @@ def install_engine_slos(server) -> list[Slo]:
     return [register(s) for s in slos]
 
 
+def install_variant_slos(variant) -> list[Slo]:
+    """Per-tenant latency objective for one mount of a multi-tenant
+    engine server: same budget knobs as ``engine.latency``, observed on
+    the mount's ``variant=``-labeled histogram and named
+    ``engine.latency[<mount>]`` so one noisy tenant pages as itself
+    rather than as the process aggregate. Solo deploys never install
+    these — their names and series stay byte-identical."""
+    slos = [
+        LatencySlo(
+            f"engine.latency[{variant.name}]",
+            variant._m_serving_v,
+            threshold_s=_env_float("PIO_SLO_SERVING_MS", 250.0) / 1e3,
+            objective=_env_float("PIO_SLO_SERVING_OBJECTIVE", 0.99),
+            description=(
+                f"Queries for mount {variant.name!r} served under the "
+                "latency budget"
+            ),
+        ),
+    ]
+    return [register(s) for s in slos]
+
+
 def install_event_server_slos(server) -> list[Slo]:
     """Event server defaults: ingest availability + group-commit
     latency."""
@@ -693,8 +715,12 @@ def install_event_server_slos(server) -> list[Slo]:
 
 def install_speed_layer_slos(layer) -> list[Slo]:
     """Speed-layer defaults: bounded ``seconds_behind`` + a fold-in
-    breaker open-time budget."""
+    breaker open-time budget. On a multi-tenant engine server each
+    mount's layer gets its own pair, suffixed ``[<mount>]`` — solo
+    deploys keep the unsuffixed names."""
     breaker = layer.breaker
+    vn = getattr(layer.server, "variant_name", None)
+    sfx = f"[{vn}]" if vn else ""
 
     def _seconds_behind() -> float:
         try:
@@ -704,14 +730,14 @@ def install_speed_layer_slos(layer) -> list[Slo]:
 
     slos = [
         BoundSlo(
-            "realtime.seconds_behind",
+            f"realtime.seconds_behind{sfx}",
             _seconds_behind,
             bound=_env_float("PIO_SLO_SECONDS_BEHIND", 60.0),
             objective=_env_float("PIO_SLO_SECONDS_BEHIND_OBJECTIVE", 0.95),
             description="Serving staleness vs the event log stays bounded",
         ),
         BoundSlo(
-            "realtime.breaker_open",
+            f"realtime.breaker_open{sfx}",
             lambda: 1.0 if breaker.state != "closed" else 0.0,
             bound=0.5,
             objective=_env_float("PIO_SLO_BREAKER_OBJECTIVE", 0.9),

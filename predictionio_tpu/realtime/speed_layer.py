@@ -143,18 +143,24 @@ class SpeedLayer:
         server.speed_layer = self
         # staleness as real Prometheus gauges, not just /stats.json:
         # scrape-time callbacks read this layer's live state (the newest
-        # layer wins the registration — one layer per server process)
+        # layer wins the registration — one layer per server process per
+        # SERIES; multi-tenant mounts each get their own variant= series)
+        vn = getattr(server, "variant_name", None)
+        labels = {"variant": vn} if vn else {}
         obs_metrics.gauge(
             "pio_realtime_events_behind",
             "Events in the log the speed layer has not folded yet",
+            **labels,
         ).set_function(lambda: float(self.tailer.events_behind() or 0))
         obs_metrics.gauge(
             "pio_realtime_seconds_behind",
             "Seconds since the speed layer was last caught up",
+            **labels,
         ).set_function(lambda: float(self.gauges()["seconds_behind"]))
         obs_metrics.gauge(
             "pio_realtime_foldin_epoch",
             "Fold-in patches applied since the last full reload",
+            **labels,
         ).set_function(lambda: float(self.server._foldin_epoch))
         # default objectives: bounded staleness + breaker open budget
         obs_slo.install_speed_layer_slos(self)

@@ -431,15 +431,43 @@ class Dashboard:
             # operations strip: recent SLO alerts + request-rate
             # sparklines — ?src= aims them at a live serving process
             src = request.query.get("src")
+            variants = {}
             if src and src.startswith(("http://", "https://")):
                 slo_doc = _fetch_src_json(src, "/slo.json") or {}
                 hist = _fetch_src_json(src, "/history.json") or {}
+                stats = _fetch_src_json(src, "/stats.json") or {}
+                # one row per mounted tenant of a multi-tenant engine
+                # server; solo deploys show no table
+                v = stats.get("variants")
+                if isinstance(v, dict) and len(v) > 1:
+                    variants = v
                 ops_source = src
             else:
                 slo_doc = obs_slo.document()
                 hist = obs_history.snapshot()
                 ops_source = "this dashboard process"
             spark = render_history_rows(hist, "pio_http_requests_total")
+            variants_html = ""
+            if variants:
+                vrows = "".join(
+                    "<tr>"
+                    f"<td>{html.escape(str(name))}</td>"
+                    f"<td>{v.get('requestCount', 0)}</td>"
+                    f"<td>{v.get('p99Ms', '-')}</td>"
+                    f"<td>{v.get('epoch', '-')}</td>"
+                    f"<td>{v.get('foldinEpoch', '-')}</td>"
+                    f"<td>{v.get('secondsBehind', '-')}</td>"
+                    f"<td>{v.get('modelAgeSec', '-')}</td>"
+                    "</tr>"
+                    for name, v in variants.items()
+                )
+                variants_html = (
+                    "<h3>Engine variants</h3>"
+                    "<table border='1'><tr><th>Mount</th><th>Requests</th>"
+                    "<th>p99 ms</th><th>Epoch</th><th>Fold-ins</th>"
+                    "<th>Behind s</th><th>Model age s</th></tr>"
+                    f"{vrows}</table>"
+                )
             ops = (
                 f"<h2>Operations <small>({html.escape(ops_source)})</small>"
                 "</h2>"
@@ -449,6 +477,7 @@ class Dashboard:
                 "<code>?src=http://host:port</code> for a live server.</p>"
                 "<h3>Recent SLO alerts</h3>"
                 + render_alerts_table(slo_doc.get("alerts", []))
+                + variants_html
                 + (
                     "<h3>Request rate (per history step)</h3>" + spark
                     if spark

@@ -230,7 +230,7 @@ class _MicroBatcher:
     def active(self) -> bool:
         return not self._stopped
 
-    def submit(self, body: dict) -> "_Submitted":
+    def submit(self, body: dict, variant=None) -> "_Submitted":
         """Parse on the request thread, enqueue for the worker. Returns
         the pending future (resolving to the per-algorithm predictions)
         plus the parsed context the request thread needs to finish the
@@ -239,8 +239,9 @@ class _MicroBatcher:
         from concurrent.futures import Future
 
         server = self._server
+        v = variant if variant is not None else server._default_variant
         with server._lock:
-            algorithms, serving = server.algorithms, server.serving
+            algorithms, serving = v.algorithms, v.serving
         query, sup = server._parse_query(body, algorithms, serving)
         f: Future = Future()
         t0 = time.perf_counter()
@@ -251,7 +252,7 @@ class _MicroBatcher:
                 raise RuntimeError("server stopping")
             # the request thread's trace rides the queue item — the
             # worker thread can't see this thread's thread-local
-            self._q.put((f, t0, obs_trace.current_trace(), sup))
+            self._q.put((f, t0, obs_trace.current_trace(), sup, v))
         return _Submitted(f, query, serving, t0)
 
     def stop(self) -> None:
@@ -324,6 +325,210 @@ class _Submitted(NamedTuple):
     t0: float
 
 
+class _Variant:
+    """One mounted tenant of an EngineServer: its own engine, instance,
+    models, epoch fence, serving bookkeeping, and (optionally) speed
+    layer — while the HTTP front end, micro-batcher worker, jit cache,
+    and query-cache byte budget stay shared process-wide.
+
+    Duck-types the surface ``realtime.SpeedLayer`` expects from a
+    "server" (engine_params / storage / instance / model_snapshot /
+    apply_patch / query_cache / _lock / _foldin_epoch / speed_layer), so
+    a layer constructed with a mount folds into exactly that tenant.
+
+    ``labeled`` is True on multi-tenant servers: per-tenant metric
+    series ride a ``variant=<name>`` label and ``variant_name`` suffixes
+    the mount's SLO names. Solo deploys stay unlabeled so their metric
+    and SLO names are byte-identical to the pre-multi-tenant server."""
+
+    def __init__(
+        self,
+        server: "EngineServer",
+        name: str,
+        engine: Engine,
+        instance: EngineInstance,
+        labeled: bool,
+    ):
+        self.server = server
+        self.name = name
+        self.engine = engine
+        self._epoch = 0
+        self._foldin_epoch = 0
+        self.speed_layer = None  # attached by realtime.SpeedLayer
+        self.request_count = 0
+        self.serving_seconds = 0.0
+        self.last_serving_sec = 0.0
+        self.last_reload_ts = 0.0
+        self.variant_name = name if labeled else None
+        self._m_serving_v = (
+            obs_metrics.histogram(
+                "pio_serving_seconds",
+                "Per-query scoring+serve time (parse through plugins)",
+                variant=name,
+            )
+            if labeled
+            else None
+        )
+        self._m_requests_v = (
+            obs_metrics.counter(
+                "pio_serving_requests_total",
+                "Queries served, per tenant",
+                variant=name,
+            )
+            if labeled
+            else None
+        )
+        self._slos: list = []
+        self._load(instance)
+
+    # -- shared infrastructure (also the SpeedLayer "server" surface) ------
+    @property
+    def _lock(self):
+        return self.server._lock
+
+    @property
+    def storage(self):
+        return self.server.storage
+
+    @property
+    def query_cache(self):
+        return self.server.query_cache
+
+    # -- load / reload ------------------------------------------------------
+    def _load(self, instance: EngineInstance) -> None:
+        engine_params, algorithms, models, serving = prepare_deploy(
+            self.engine, instance, storage=self.server.storage
+        )
+        obs_device.count_transfer(
+            "h2d", "serve.model_put", _model_bytes(models)
+        )
+        with self._lock:
+            self.instance = instance
+            self.engine_params = engine_params
+            self.algorithms = algorithms
+            self.models = models
+            self.serving = serving
+            # retrain wins: a reload supersedes any applied fold-in
+            # patches (the new instance was trained on the full log)
+            self._epoch += 1
+            self._foldin_epoch = 0
+            epoch = self._epoch
+        # entries under older epochs are unreachable by key the moment
+        # the counter moves; the sweep reclaims their bytes — scoped to
+        # THIS tenant's partition, so reloading one mount never flushes
+        # a co-tenant's cached results
+        if self.query_cache is not None:
+            self.query_cache.sweep(epoch, variant=self.name)
+        # freshness lineage, batch side: events ingested before this
+        # instance's training began are servable NOW — one sample of
+        # (commit - train_start) records the batch-layer staleness floor
+        try:
+            train_start = instance.start_time.timestamp()
+        except (AttributeError, OSError, ValueError):
+            train_start = None
+        obs_freshness.observe_commit(
+            [train_start] if train_start is not None else [],
+            kind="reload",
+            epoch=epoch,
+        )
+        self.last_reload_ts = time.time()
+        if self.variant_name is not None:
+            obs_metrics.gauge(
+                "pio_serving_epoch",
+                "Model swap epoch, per tenant",
+                variant=self.name,
+            ).set(float(epoch))
+        logger.info(
+            "engine instance %s loaded for serving (variant %s)",
+            instance.id,
+            self.name,
+        )
+
+    def reload(self) -> bool:
+        """Swap this mount to its latest completed instance."""
+        latest = self.storage.get_metadata_engine_instances().get_latest_completed(
+            self.instance.engine_id,
+            self.instance.engine_version,
+            self.instance.engine_variant,
+        )
+        if latest is None:
+            return False
+        # prepare_deploy runs OFF the server lock; the swap is atomic —
+        # the old model keeps serving 200s through the whole reload
+        self._load(latest)
+        return True
+
+    # -- speed-layer hot patching -------------------------------------------
+    def model_snapshot(self):
+        """(instance_id, models, epoch) under the lock — the fenced read
+        a fold-in starts from."""
+        with self._lock:
+            return self.instance.id, self.models, self._epoch
+
+    def apply_patch(self, models, expected_epoch: int) -> bool:
+        """Epoch-fenced swap of this mount's model list."""
+        with self._lock:
+            if expected_epoch != self._epoch:
+                return False
+            self.models = models
+            self._epoch += 1
+            self._foldin_epoch += 1
+            epoch = self._epoch
+        obs_device.count_transfer(
+            "h2d", "serve.model_patch", _model_bytes(models)
+        )
+        if self.query_cache is not None:
+            self.query_cache.sweep(epoch, variant=self.name)
+        if self.variant_name is not None:
+            obs_metrics.gauge(
+                "pio_serving_epoch",
+                "Model swap epoch, per tenant",
+                variant=self.name,
+            ).set(float(epoch))
+        return True
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        """One row of the /stats.json ``variants`` block: qps inputs,
+        p99, epoch, freshness, SLO states for this tenant."""
+        with self._lock:
+            avg = (
+                self.serving_seconds / self.request_count
+                if self.request_count
+                else 0.0
+            )
+            d: dict[str, Any] = {
+                "engineInstanceId": self.instance.id,
+                "engineVariant": self.instance.engine_variant,
+                "epoch": self._epoch,
+                "foldinEpoch": self._foldin_epoch,
+                "requestCount": self.request_count,
+                "avgServingSec": round(avg, 6),
+                "lastServingSec": round(self.last_serving_sec, 6),
+            }
+        hist = (
+            self._m_serving_v
+            if self._m_serving_v is not None
+            else self.server._m_serving
+        )
+        try:
+            d["p99Ms"] = round(hist.percentile(0.99) * 1e3, 3)
+        except Exception:  # pragma: no cover - stats must never 500
+            d["p99Ms"] = None
+        d["modelAgeSec"] = (
+            round(time.time() - self.last_reload_ts, 1)
+            if self.last_reload_ts
+            else None
+        )
+        layer = self.speed_layer
+        d["secondsBehind"] = (
+            layer.gauges().get("seconds_behind") if layer is not None else None
+        )
+        if self._slos:
+            d["slo"] = {s.name: s.state for s in self._slos}
+        return d
+
+
 class EngineServer:
     def __init__(
         self,
@@ -344,8 +549,8 @@ class EngineServer:
         reuse_port: bool = False,
         query_cache_mb: float = 0.0,
         query_deadline_ms: float | None = None,
+        extra_variants: list[tuple[str, Engine, EngineInstance]] | None = None,
     ):
-        self.engine = engine
         self.storage = storage or get_storage()
         self.host = host
         # server.conf-style config supplies the control key and TLS
@@ -360,13 +565,6 @@ class EngineServer:
         self.log_url = log_url
         self.log_prefix = log_prefix or ""
         self._lock = threading.RLock()
-        # one epoch counter fences BOTH full reloads and speed-layer
-        # patches: every swap of self.models bumps it, and apply_patch
-        # refuses when its snapshot epoch is stale — a reload racing a
-        # fold-in can never be overwritten by pre-retrain factors
-        self._epoch = 0
-        self._foldin_epoch = 0
-        self.speed_layer = None  # attached by realtime.SpeedLayer
         self.query_cache: QueryCache | None = None
         # set while deploy warmup overlaps live traffic (reuse_port
         # workers, late warmups): /queries.json answers 503 +
@@ -396,11 +594,30 @@ class EngineServer:
         # does that, capped so an overload degrades to inline scoring
         # with post-hoc shedding instead of unbounded thread spawn.
         self._ddl_slots = threading.BoundedSemaphore(32)
-        self._load(instance)
 
-        self.request_count = 0
-        self.serving_seconds = 0.0
-        self.last_serving_sec = 0.0
+        # tenant mounts: the primary (engine, instance) is the DEFAULT
+        # variant — bare /queries.json serves it, and every legacy
+        # attribute (engine/instance/models/_epoch/...) delegates to it,
+        # so a solo deploy behaves byte-identically to the
+        # single-tenant server. extra_variants adds co-tenants routed by
+        # /<name>/queries.json or the X-PIO-Variant header; each keeps
+        # its own epoch fence, /reload, speed layer, and query-cache
+        # partition while sharing this process's HTTP front end,
+        # micro-batcher, jit cache (pow2 buckets make compiled programs
+        # tenant-independent), and query-cache byte budget.
+        default_name = instance.engine_variant or "default"
+        mounts = [(default_name, engine, instance)] + list(extra_variants or [])
+        labeled = len(mounts) > 1
+        self.variants: dict[str, _Variant] = {}
+        for name, eng, inst in mounts:
+            if not name or "/" in name:
+                raise ValueError(f"invalid variant mount name {name!r}")
+            if name in self.variants:
+                raise ValueError(f"duplicate variant mount name {name!r}")
+            self.variants[name] = _Variant(self, name, eng, inst, labeled)
+        self.default_variant_name = default_name
+        self._default_variant = self.variants[default_name]
+
         self.start_time = time.time()
         self._m_serving = obs_metrics.histogram(
             "pio_serving_seconds",
@@ -413,6 +630,12 @@ class EngineServer:
         # default objectives: p99 latency, 5xx availability, the
         # warmup/deadline 503 budget, ingest-to-servable freshness
         obs_slo.install_engine_slos(self)
+        # multi-tenant servers additionally get one latency objective
+        # per mount (names suffixed [mount]) so one noisy tenant pages
+        # as itself, not as the process aggregate
+        if labeled:
+            for v in self.variants.values():
+                v._slos = obs_slo.install_variant_slos(v)
 
         self.plugins = plugin_mod.load_plugins(plugin_mod.EngineServerPlugin)
         self.plugin_context: dict[str, Any] = {"storage": self.storage}
@@ -469,45 +692,41 @@ class EngineServer:
         # and the batcher stops dispatching before the loop exits
         self.app.add_shutdown_hook(self._drain_flush)
 
+    # -- default-variant delegation -----------------------------------------
+    # Legacy surface: every pre-multi-tenant attribute reads/writes the
+    # default mount. SpeedLayer(server), the supervisor, the CLI, and
+    # tests keep working unchanged; per-tenant state lives on _Variant.
+
+    def _delegate(attr):  # noqa: N805 - descriptor factory, not a method
+        def _get(self):
+            return getattr(self._default_variant, attr)
+
+        def _set(self, value):
+            setattr(self._default_variant, attr, value)
+
+        return property(_get, _set)
+
+    engine = _delegate("engine")
+    instance = _delegate("instance")
+    engine_params = _delegate("engine_params")
+    algorithms = _delegate("algorithms")
+    models = _delegate("models")
+    serving = _delegate("serving")
+    _epoch = _delegate("_epoch")
+    _foldin_epoch = _delegate("_foldin_epoch")
+    speed_layer = _delegate("speed_layer")
+    request_count = _delegate("request_count")
+    serving_seconds = _delegate("serving_seconds")
+    last_serving_sec = _delegate("last_serving_sec")
+    del _delegate
+
     def _load(self, instance: EngineInstance) -> None:
-        engine_params, algorithms, models, serving = prepare_deploy(
-            self.engine, instance, storage=self.storage
-        )
-        obs_device.count_transfer(
-            "h2d", "serve.model_put", _model_bytes(models)
-        )
-        with self._lock:
-            self.instance = instance
-            self.engine_params = engine_params
-            self.algorithms = algorithms
-            self.models = models
-            self.serving = serving
-            # retrain wins: a reload supersedes any applied fold-in
-            # patches (the new instance was trained on the full log)
-            self._epoch += 1
-            self._foldin_epoch = 0
-            epoch = self._epoch
-        # entries under older epochs are unreachable by key the moment
-        # the counter moves; the sweep just reclaims their bytes (done
-        # off the server lock — the cache has its own shard locks)
-        if self.query_cache is not None:
-            self.query_cache.sweep(epoch)
-        # freshness lineage, batch side: events ingested before this
-        # instance's training began are servable NOW — one sample of
-        # (commit - train_start) records the batch-layer staleness floor
-        try:
-            train_start = instance.start_time.timestamp()
-        except (AttributeError, OSError, ValueError):
-            train_start = None
-        obs_freshness.observe_commit(
-            [train_start] if train_start is not None else [],
-            kind="reload",
-            epoch=epoch,
-        )
-        logger.info("engine instance %s loaded for serving", instance.id)
+        self._default_variant._load(instance)
 
     # -- query path --------------------------------------------------------
-    def serve_query_bytes(self, body: dict[str, Any]) -> bytes:
+    def serve_query_bytes(
+        self, body: dict[str, Any], variant: "_Variant | None" = None
+    ) -> bytes:
         """THE /queries.json read path: preserialized response bytes.
 
         Cache hit: one canonical-bytes build + one sharded dict lookup —
@@ -521,15 +740,18 @@ class EngineServer:
         the pre-swap epoch — it can never be served after the swap. (The
         reverse order would race: old-model results could be filed under
         the new epoch.)"""
+        v = variant if variant is not None else self._default_variant
         cache = self.query_cache
         key = None
         if cache is not None:
             t_c0 = time.perf_counter()
             with self._lock:
-                epoch = self._epoch
-                variant = self.instance.engine_variant
+                epoch = v._epoch
             try:
-                key = (variant, canonical_query_bytes(body), epoch)
+                # keyed by the MOUNT name (not instance.engine_variant):
+                # unique even when several mounts share one instance, so
+                # each tenant keeps its own cache partition
+                key = (v.name, canonical_query_bytes(body), epoch)
             except (TypeError, ValueError):
                 key = None  # non-canonicalizable body: uncacheable
             payload = cache.get(key) if key is not None else None
@@ -545,11 +767,13 @@ class EngineServer:
                 # a hit is still a served request; it adds ~0 to
                 # serving_seconds by construction
                 with self._lock:
-                    self.request_count += 1
+                    v.request_count += 1
+                if v._m_requests_v is not None:
+                    v._m_requests_v.inc()
                 return payload
         if self.batcher is not None and self.batcher.active:
             try:
-                response_obj = self._serve_batched(body)
+                response_obj = self._serve_batched(body, v)
             except RuntimeError as e:
                 # batcher INFRASTRUCTURE failure (dead worker / stopping
                 # server), not a query error: degrade to the unbatched
@@ -563,19 +787,22 @@ class EngineServer:
                 logger.warning(
                     "micro-batcher unavailable (%s); serving unbatched", e
                 )
-                response_obj = self._query_with_deadline(body)
+                response_obj = self._query_with_deadline(body, v)
         else:
-            response_obj = self._query_with_deadline(body)
+            response_obj = self._query_with_deadline(body, v)
         payload = jsonx.dumps_bytes(response_obj)
-        if key is not None and self._query_cacheable(body):
+        if key is not None and self._query_cacheable(body, v):
             cache.put(key, payload)
         return payload
 
-    def _query_cacheable(self, body: dict[str, Any]) -> bool:
+    def _query_cacheable(
+        self, body: dict[str, Any], variant: "_Variant | None" = None
+    ) -> bool:
         """Every Algorithm AND the Serving must consent (core/base.py
         ``cacheable_query``). Runs on the miss path only."""
+        v = variant if variant is not None else self._default_variant
         with self._lock:
-            algorithms, serving = self.algorithms, self.serving
+            algorithms, serving = v.algorithms, v.serving
         try:
             query, supplemented = self._parse_query(body, algorithms, serving)
         except Exception:
@@ -584,7 +811,9 @@ class EngineServer:
             return False
         return all(a.cacheable_query(supplemented) for a in algorithms)
 
-    def _serve_batched(self, body: dict[str, Any]) -> dict[str, Any]:
+    def _serve_batched(
+        self, body: dict[str, Any], variant: "_Variant | None" = None
+    ) -> dict[str, Any]:
         """Score through the micro-batcher. The worker resolves the
         future with the per-algorithm predictions; serving/feedback/
         plugins (``_finish_query``) run HERE on the request thread, so
@@ -592,7 +821,12 @@ class EngineServer:
         the worker. Deadline expiry is a timer-wheel entry that fails
         the future — the client gets its 503 AT the deadline even while
         the device call is still in flight."""
-        sub = self.batcher.submit(body)
+        # legacy single-arg call for the default mount (submit defaults
+        # to it): solo-deploy wrappers/stubs of submit keep working
+        if variant is None or variant is self._default_variant:
+            sub = self.batcher.submit(body)
+        else:
+            sub = self.batcher.submit(body, variant)
         fut = sub.fut
         handle = None
         if self.query_deadline_s is not None:
@@ -620,7 +854,7 @@ class EngineServer:
             if handle is not None:
                 handle.cancel()
         return self._finish_query(
-            body, sub.query, predictions, sub.serving, sub.t0
+            body, sub.query, predictions, sub.serving, sub.t0, variant=variant
         )
 
     @staticmethod
@@ -645,7 +879,9 @@ class EngineServer:
             return
         self._count_deadline(path)
 
-    def _query_with_deadline(self, body: dict[str, Any]) -> dict[str, Any]:
+    def _query_with_deadline(
+        self, body: dict[str, Any], variant: "_Variant | None" = None
+    ) -> dict[str, Any]:
         """Unbatched scoring under the per-query deadline (a plain
         ``handle_query`` call when no deadline is configured — the
         zero-cost default path).
@@ -659,7 +895,7 @@ class EngineServer:
         response-freshness guarantee holds, only the early answer is
         lost)."""
         if self.query_deadline_s is None:
-            return self.handle_query(body)
+            return self.handle_query(body, variant)
         from concurrent.futures import Future
 
         fut: Future = Future()
@@ -670,7 +906,7 @@ class EngineServer:
             if handle is not None:
                 handle.cancel()
             t0 = time.monotonic()
-            result = self.handle_query(body)
+            result = self.handle_query(body, variant)
             if time.monotonic() - t0 > self.query_deadline_s:
                 self._count_deadline("unbatched")
                 raise QueryDeadlineExceeded(
@@ -680,7 +916,7 @@ class EngineServer:
 
         def run() -> None:
             try:
-                r = self.handle_query(body)
+                r = self.handle_query(body, variant)
             except BaseException as e:
                 if not fut.done():
                     try:
@@ -707,11 +943,14 @@ class EngineServer:
         finally:
             handle.cancel()
 
-    def handle_query(self, body: dict[str, Any]) -> dict[str, Any]:
+    def handle_query(
+        self, body: dict[str, Any], variant: "_Variant | None" = None
+    ) -> dict[str, Any]:
         faults.fault_point("serve.query")
+        v = variant if variant is not None else self._default_variant
         t0 = time.perf_counter()
         with self._lock:
-            algorithms, models, serving = self.algorithms, self.models, self.serving
+            algorithms, models, serving = v.algorithms, v.models, v.serving
         query, supplemented = self._parse_query(body, algorithms, serving)
         predictions = [
             a.predict(m, supplemented) for a, m in zip(algorithms, models)
@@ -729,7 +968,9 @@ class EngineServer:
                 rs = split.get("rescore", 0.0)
                 tr.add_span("dispatch.shortlist", t0, t0 + ss)
                 tr.add_span("dispatch.rescore", t0 + ss, t0 + ss + rs)
-        return self._finish_query(body, query, predictions, serving, t0)
+        return self._finish_query(
+            body, query, predictions, serving, t0, variant=v
+        )
 
     @staticmethod
     def _parse_query(body, algorithms, serving):
@@ -738,12 +979,13 @@ class EngineServer:
         return query, serving.supplement(query)
 
     def _finish_query(
-        self, body, query, predictions, serving, t0, trace=None
+        self, body, query, predictions, serving, t0, trace=None, variant=None
     ) -> dict[str, Any]:
         """Per-query tail shared by the per-request and micro-batched
         paths: serve, feedback, plugins, bookkeeping. ``trace`` is passed
         explicitly from the batch worker (whose thread-local is not the
         request thread's); the per-request path falls back to it."""
+        v = variant if variant is not None else self._default_variant
         if trace is None:
             trace = obs_trace.current_trace()
         result = serving.serve(query, predictions)
@@ -759,22 +1001,26 @@ class EngineServer:
         for p in self.plugins:
             if p.plugin_type == plugin_mod.OUTPUT_BLOCKER:
                 response = p.process(
-                    self.instance.engine_variant, body, response, self.plugin_context
+                    v.instance.engine_variant, body, response, self.plugin_context
                 )
             else:
                 p.process(
-                    self.instance.engine_variant, body, response, self.plugin_context
+                    v.instance.engine_variant, body, response, self.plugin_context
                 )
 
         t_end = time.perf_counter()
         dt = t_end - t0
         self._m_serving.observe(dt)
+        if v._m_serving_v is not None:
+            v._m_serving_v.observe(dt)
+        if v._m_requests_v is not None:
+            v._m_requests_v.inc()
         if trace is not None:
             trace.add_span("serve", t0, t_end)
         with self._lock:
-            self.request_count += 1
-            self.serving_seconds += dt
-            self.last_serving_sec = dt
+            v.request_count += 1
+            v.serving_seconds += dt
+            v.last_serving_sec = dt
         return response
 
     @staticmethod
@@ -792,18 +1038,35 @@ class EngineServer:
             pass
 
     def _handle_query_batch(self, items) -> None:
-        """Score one micro-batch: every algorithm runs ONE batch_predict
-        over the whole batch; serving/feedback/plugins run per query on
-        the REQUEST threads (the futures resolve to predictions, not
-        responses). A single-item batch — an idle server's lone query —
-        skips the padding/coalesce machinery and goes straight to
-        ``predict``. A failing batch retries its queries individually so
-        one bad request can't fail its batchmates."""
+        """Score one micro-batch, grouped by tenant mount: queries for
+        different variants never share a ``batch_predict`` call (their
+        models differ), but co-tenant queries still coalesce — and
+        because every group pads to the same pow2 buckets, the jitted
+        programs stay shared across tenants (compiles bounded by bucket
+        count, not tenant count)."""
+        groups: dict[int, list] = {}
+        by_id: dict[int, Any] = {}
+        for it in items:
+            v = it[4]
+            groups.setdefault(id(v), []).append(it)
+            by_id[id(v)] = v
+        for vid, group in groups.items():
+            self._score_batch_group(by_id[vid], group)
+
+    def _score_batch_group(self, variant: "_Variant", items) -> None:
+        """Score one tenant's micro-batch: every algorithm runs ONE
+        batch_predict over the whole batch; serving/feedback/plugins run
+        per query on the REQUEST threads (the futures resolve to
+        predictions, not responses). A single-item batch — an idle
+        server's lone query — skips the padding/coalesce machinery and
+        goes straight to ``predict``. A failing batch retries its
+        queries individually so one bad request can't fail its
+        batchmates."""
         with self._lock:
-            algorithms, models = self.algorithms, self.models
+            algorithms, models = variant.algorithms, variant.models
         batcher = self.batcher
         t_collect = time.perf_counter()
-        for fut, t0, tr, _ in items:
+        for fut, t0, tr, _, _ in items:
             if batcher is not None:
                 batcher._m_queue_wait.observe(t_collect - t0)
             if tr is not None:
@@ -811,7 +1074,7 @@ class EngineServer:
         if len(items) == 1:
             # FAST PATH: no padding, no index plumbing — lone-query
             # latency matches per-request serving
-            fut, _, _, sup = items[0]
+            fut, _, _, sup, _ = items[0]
             try:
                 predictions = [
                     a.predict(m, sup) for a, m in zip(algorithms, models)
@@ -824,7 +1087,7 @@ class EngineServer:
         per_algo: list[dict] | None
         try:
             indexed = [
-                (i, sup) for i, (_, _, _, sup) in enumerate(items)
+                (i, sup) for i, (_, _, _, sup, _) in enumerate(items)
             ]
             # pad to a power-of-two batch size with copies of the first
             # query (padding results are discarded): jitted batch
@@ -851,7 +1114,7 @@ class EngineServer:
             from predictionio_tpu.ops import retrieval as _retrieval
 
             split = _retrieval.take_stage_split()
-            for _, _, tr, _ in items:
+            for _, _, tr, _, _ in items:
                 if tr is not None:
                     tr.add_span(f"batch.dispatch[{n_real}]", t_d0, t_d1)
                     if split is not None:
@@ -864,7 +1127,7 @@ class EngineServer:
         except Exception:
             logger.exception("batched scoring failed; retrying per query")
             per_algo = None
-        for i, (fut, t0, tr, sup) in enumerate(items):
+        for i, (fut, t0, tr, sup, _) in enumerate(items):
             if per_algo is None:
                 try:
                     predictions = [
@@ -948,54 +1211,42 @@ class EngineServer:
         self._post_async(self.log_url, payload, "remote log")
 
     # -- control -----------------------------------------------------------
-    def reload(self) -> bool:
-        """Swap to the latest completed instance (reference /reload)."""
+    def reload(self, variant: "_Variant | None" = None) -> bool:
+        """Swap a mount to its latest completed instance (reference
+        /reload). Defaults to the default mount; the per-variant routes
+        pass their own. Other mounts' epochs and cache partitions are
+        untouched."""
+        v = variant if variant is not None else self._default_variant
         latest = self.storage.get_metadata_engine_instances().get_latest_completed(
-            self.instance.engine_id,
-            self.instance.engine_version,
-            self.instance.engine_variant,
+            v.instance.engine_id,
+            v.instance.engine_version,
+            v.instance.engine_variant,
         )
         if latest is None:
             return False
-        # the expensive prepare_deploy runs OFF the server lock and the
-        # swap itself is atomic (_load), so the OLD model keeps serving
-        # 200s for the whole reload — never 503 here; failing queries a
-        # working model could answer would be degradation, not grace
-        self._load(latest)
+        # prepare_deploy runs OFF the server lock; the swap is atomic —
+        # the old model keeps serving 200s through the whole reload. The
+        # default mount goes through the server-level _load hook (tests
+        # and plugins may wrap it); co-tenants load directly.
+        if v is self._default_variant:
+            self._load(latest)
+        else:
+            v._load(latest)
         return True
 
     # -- speed-layer hot patching -------------------------------------------
     def model_snapshot(self):
-        """(instance_id, models, epoch) under the lock — the fenced read
-        a fold-in starts from. Apply the patch back with the SAME epoch;
-        any intervening swap (reload or another patch) invalidates it."""
-        with self._lock:
-            return self.instance.id, self.models, self._epoch
+        """(instance_id, models, epoch) of the DEFAULT mount under the
+        lock — the fenced read a fold-in starts from (solo-deploy compat;
+        multi-tenant speed layers hold their _Variant directly)."""
+        return self._default_variant.model_snapshot()
 
     def apply_patch(self, models, expected_epoch: int) -> bool:
-        """Epoch-fenced swap of the model list (speed-layer hot patch).
-
-        Returns False without touching anything when the epoch moved
-        since the snapshot — the caller re-reads and re-folds. In-flight
-        queries are untouched either way: handle_query snapshots
-        (algorithms, models, serving) under the lock and scores from
-        its snapshot; the swap is a pointer flip."""
-        with self._lock:
-            if expected_epoch != self._epoch:
-                return False
-            self.models = models
-            self._epoch += 1
-            self._foldin_epoch += 1
-            epoch = self._epoch
-        obs_device.count_transfer(
-            "h2d", "serve.model_patch", _model_bytes(models)
-        )
-        # fold-in patches sweep cached results exactly like /reload:
-        # the bumped epoch already makes old entries unreachable, the
-        # sweep reclaims their bytes (off the server lock)
-        if self.query_cache is not None:
-            self.query_cache.sweep(epoch)
-        return True
+        """Epoch-fenced swap of the default mount's model list
+        (speed-layer hot patch). Returns False without touching anything
+        when the epoch moved since the snapshot — the caller re-reads
+        and re-folds."""
+        return self._default_variant.apply_patch(models, expected_epoch)
 
     def status(self) -> dict[str, Any]:
         with self._lock:
@@ -1076,6 +1327,11 @@ class EngineServer:
                 if cache is not None
                 else {"enabled": False}
             )
+            # per-mount rows: solo deploys get a one-entry block keyed by
+            # the default mount name, so dashboards render one code path
+            body["variants"] = {
+                name: v.stats() for name, v in server.variants.items()
+            }
             # additive: existing consumers keep their fields untouched
             body["obs"] = obs_metrics.stats_block()
             body["device"] = obs_device.device_block()
@@ -1088,8 +1344,16 @@ class EngineServer:
                 pass
             return Response.json(body)
 
-        @router.route("POST", "/queries.json")
-        def queries(request: Request) -> Response:
+        def _resolve_header_variant(request: Request) -> "_Variant | None":
+            """Mount for a BARE-path request: the ``X-PIO-Variant``
+            header when present (None for an unknown name -> 404), else
+            the default mount."""
+            name = request.headers.get("x-pio-variant")
+            if name is None:
+                return server._default_variant
+            return server.variants.get(name)
+
+        def _handle_queries(request: Request, v: "_Variant") -> Response:
             if server._swapping.is_set():
                 obs_metrics.counter(
                     "pio_query_unavailable_total",
@@ -1111,7 +1375,7 @@ class EngineServer:
             if not isinstance(body, dict):
                 return Response.error("request body must be a JSON object", 400)
             try:
-                return Response.json_bytes(server.serve_query_bytes(body))
+                return Response.json_bytes(server.serve_query_bytes(body, v))
             except QueryDeadlineExceeded as e:
                 obs_metrics.counter(
                     "pio_query_unavailable_total",
@@ -1146,14 +1410,33 @@ class EngineServer:
                 )
                 return Response.error(f"serving failed: {e}", 500)
 
-        @router.route("POST", "/reload")
-        def reload(request: Request) -> Response:
+        @router.route("POST", "/queries.json")
+        def queries(request: Request) -> Response:
+            v = _resolve_header_variant(request)
+            if v is None:
+                return Response.error(
+                    "unknown engine variant "
+                    f"{request.headers.get('x-pio-variant')!r}", 404
+                )
+            return _handle_queries(request, v)
+
+        def _handle_reload(request: Request, v: "_Variant") -> Response:
             if not server._auth_control(request):
                 return Response.error("Invalid accessKey.", 401)
-            ok = server.reload()
+            ok = server.reload(v)
             if not ok:
                 return Response.error("no completed engine instance found", 404)
             return Response.json({"message": "Reloading..."})
+
+        @router.route("POST", "/reload")
+        def reload(request: Request) -> Response:
+            v = _resolve_header_variant(request)
+            if v is None:
+                return Response.error(
+                    "unknown engine variant "
+                    f"{request.headers.get('x-pio-variant')!r}", 404
+                )
+            return _handle_reload(request, v)
 
         @router.route("POST", "/stop")
         def stop(request: Request) -> Response:
@@ -1186,6 +1469,30 @@ class EngineServer:
                     return Response.json(p.handle_rest(dict(request.query)))
             return Response.error("plugin not found", 404)
 
+        # path-prefix tenant routing: /<variant>/queries.json is the
+        # load-balancer-friendly form of the X-PIO-Variant header. The
+        # exact routes above win first (registration order), so a mount
+        # can never shadow /plugins.json or /stats.json.
+        @router.route("POST", "/<variant>/queries.json")
+        def variant_queries(request: Request) -> Response:
+            v = server.variants.get(request.path_params["variant"])
+            if v is None:
+                return Response.error(
+                    f"unknown engine variant "
+                    f"{request.path_params['variant']!r}", 404
+                )
+            return _handle_queries(request, v)
+
+        @router.route("POST", "/<variant>/reload")
+        def variant_reload(request: Request) -> Response:
+            v = server.variants.get(request.path_params["variant"])
+            if v is None:
+                return Response.error(
+                    f"unknown engine variant "
+                    f"{request.path_params['variant']!r}", 404
+                )
+            return _handle_reload(request, v)
+
         add_obs_routes(router)
         return router
 
@@ -1215,9 +1522,9 @@ class EngineServer:
         CPU, tens of seconds on TPU attachments). Queries come from each
         algorithm's ``warmup_query`` hook; failures are logged and
         swallowed — warmup must never block a deploy. Returns how many
-        algorithms were warmed."""
-        with self._lock:
-            algorithms, models = self.algorithms, self.models
+        algorithms were warmed. Multi-tenant mounts warm every variant
+        — co-tenants of one instance share the scoring-program cache, so
+        repeats hit compiled programs and cost one throwaway score."""
         warmed = 0
         # normally warmup runs before the port binds, but reuse_port
         # workers and late warmups can overlap live traffic — those
@@ -1225,39 +1532,45 @@ class EngineServer:
         # warm-up compile
         self._swapping.set()
         try:
-            for a, m in zip(algorithms, models):
-                try:
-                    q = a.warmup_query(m)
-                    if q is None:
-                        continue
-                    t0 = time.perf_counter()
-                    a.batch_predict(m, [(0, q)])
-                    logger.info(
-                        "warmup: %s compiled+scored in %.3fs",
-                        type(a).__name__, time.perf_counter() - t0,
-                    )
-                    warmed += 1
-                except Exception:
-                    logger.exception(
-                        "warmup predict failed for %s (serving unaffected)",
-                        type(a).__name__,
-                    )
+            for v in self.variants.values():
+                with self._lock:
+                    algorithms, models = v.algorithms, v.models
+                for a, m in zip(algorithms, models):
+                    try:
+                        q = a.warmup_query(m)
+                        if q is None:
+                            continue
+                        t0 = time.perf_counter()
+                        a.batch_predict(m, [(0, q)])
+                        logger.info(
+                            "warmup: %s compiled+scored in %.3fs",
+                            type(a).__name__, time.perf_counter() - t0,
+                        )
+                        warmed += 1
+                    except Exception:
+                        logger.exception(
+                            "warmup predict failed for %s (serving unaffected)",
+                            type(a).__name__,
+                        )
         finally:
             self._swapping.clear()
         return warmed
 
     def _ready_reason(self) -> str | None:
         """The engine half of ``/readyz`` (the HTTPApp adds the draining
-        check): warmup/model-swap fencing and a loaded model."""
+        check): warmup/model-swap fencing and a loaded model on every
+        mount."""
         if self._swapping.is_set():
             return "model swap/warmup in progress"
-        if not self.models:
-            return "no model loaded"
+        for v in self.variants.values():
+            if not v.models:
+                return f"no model loaded ({v.name})"
         return None
 
     def _drain_flush(self) -> None:
-        if self.speed_layer is not None:
-            self.speed_layer.stop()
+        for v in self.variants.values():
+            if v.speed_layer is not None:
+                v.speed_layer.stop()
         if self.batcher is not None:
             self.batcher.stop()
 
@@ -1272,8 +1585,9 @@ class EngineServer:
         self.app.drain()
 
     def stop(self) -> None:
-        if self.speed_layer is not None:
-            self.speed_layer.stop()
+        for v in self.variants.values():
+            if v.speed_layer is not None:
+                v.speed_layer.stop()
         if self.batcher is not None:
             self.batcher.stop()
         self.app.stop()

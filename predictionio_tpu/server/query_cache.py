@@ -101,17 +101,25 @@ class QueryCache:
                 s.bytes -= len(v) + len(k[1]) + _ENTRY_OVERHEAD
                 s.evictions += 1
 
-    def sweep(self, current_epoch: int) -> int:
+    def sweep(self, current_epoch: int, variant: str | None = None) -> int:
         """Drop every entry whose epoch != ``current_epoch``.
 
         Correctness never needs this — a bumped epoch makes old entries
         unreachable by key — but the bytes they hold would otherwise only
         leave via LRU pressure. Called on every model swap (reload or
-        fold-in patch). Returns how many entries were dropped."""
+        fold-in patch). Returns how many entries were dropped.
+
+        With ``variant`` set, only that tenant's partition is swept —
+        a multi-tenant server reloading tenant A must leave tenant B's
+        cached results (under B's own epoch) untouched."""
         dropped = 0
         for s in self._shards:
             with s.lock:
-                stale = [k for k in s.entries if k[2] != current_epoch]
+                stale = [
+                    k for k in s.entries
+                    if k[2] != current_epoch
+                    and (variant is None or k[0] == variant)
+                ]
                 for k in stale:
                     v = s.entries.pop(k)
                     s.bytes -= len(v) + len(k[1]) + _ENTRY_OVERHEAD

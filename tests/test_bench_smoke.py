@@ -64,6 +64,28 @@ def test_production_stack_smoke_gate():
     assert all(s == "ok" for s in block["slo_states"].values()), block
 
 
+def test_density_smoke_gate():
+    """Multi-tenant density: exit 0 means the zero-copy modelfile beat
+    pickle >= 20x on cold load, 8 tenants mounting one model stayed
+    within 1.35x the single-tenant RSS, and adding tenants added zero
+    jit compiles."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "density", "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(BENCH.parent),
+    )
+    assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-2000:])
+    lines = proc.stdout.strip().splitlines()
+    summary = json.loads(lines[-1])  # the tail-capture contract
+    block = summary["density"]
+    assert block["ok"] is True
+    assert block["mmap_cold_load_speedup"] >= 20
+    assert block["rss_ratio"] <= 1.35
+    assert block["jit_compiles_added"] == 0
+
+
 class TestBenchCompare:
     OLD = {
         "serving": {"qps": 1000.0, "p99_ms": 12.0},
@@ -123,6 +145,13 @@ class TestBenchCompare:
             == "higher"
         assert bench_compare.leaf_direction(
             "tail_object_events_per_s") == "higher"
+        # multi-tenant density leaves: load speedup up, RSS and compile
+        # count down, tenant count is config
+        assert bench_compare.leaf_direction(
+            "mmap_cold_load_speedup") == "higher"
+        assert bench_compare.leaf_direction("rss_ratio") == "lower"
+        assert bench_compare.leaf_direction("jit_compiles_added") == "lower"
+        assert bench_compare.leaf_direction("tenants") is None
 
     def test_columnar_tail_regression_flagged(self):
         old = {"realtime": {"tail_columnar": {

@@ -1,0 +1,180 @@
+"""Multi-tenant engine server: N variant mounts in one process must be
+indistinguishable — byte for byte — from N solo deploys, across every
+factor storage dtype; reloading one tenant must not move any other
+tenant's epoch or evict its query-cache partition; routing resolves by
+path prefix and by the X-PIO-Variant header, 404ing unknown names."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.cli import commands
+from predictionio_tpu.core import EngineParams
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.models import recommendation as rec
+from predictionio_tpu.server.engine_server import EngineServer
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST"
+    )
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _train(storage, app_name, engine_id, storage_dtype="float32"):
+    events = storage.get_events()
+    info = commands.app_new(app_name, storage=storage)
+    rng = np.random.default_rng(11)
+    for u in range(12):
+        for _ in range(6):
+            events.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{int(rng.integers(0, 8))}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                ),
+                info["id"],
+            )
+    engine = rec.engine()
+    ep = EngineParams(
+        datasource=("", rec.DataSourceParams(app_name=app_name)),
+        algorithms=[(
+            "als",
+            rec.ALSAlgorithmParams(
+                rank=4, num_iterations=3, storage_dtype=storage_dtype
+            ),
+        )],
+    )
+    run_train(engine, ep, engine_id=engine_id, storage=storage)
+    inst = storage.get_metadata_engine_instances().get_latest_completed(
+        engine_id, "0", "default"
+    )
+    return engine, ep, inst
+
+
+QUERIES = [{"user": f"u{u}", "num": 3} for u in range(12)] + [
+    {"user": "zz", "num": 2}
+]
+
+
+class TestByteIdenticalVsSolo:
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+    def test_variant_responses_match_solo(self, storage, dtype):
+        engine, _, inst = _train(storage, f"Par{dtype}", f"par-{dtype}",
+                                 storage_dtype=dtype)
+        solo = EngineServer(
+            engine, inst, storage=storage, host="127.0.0.1", port=0
+        )
+        multi = EngineServer(
+            rec.engine(), inst, storage=storage, host="127.0.0.1", port=0,
+            extra_variants=[
+                ("b", rec.engine(), inst), ("c", rec.engine(), inst),
+            ],
+        )
+        sp = solo.start()
+        mp = multi.start()
+        try:
+            for q in QUERIES:
+                _, want = _post(
+                    f"http://127.0.0.1:{sp}/queries.json", q
+                )
+                # bare path (default tenant), path prefix, and header
+                # routing must all return the solo bytes exactly
+                for url, headers in (
+                    (f"http://127.0.0.1:{mp}/queries.json", None),
+                    (f"http://127.0.0.1:{mp}/b/queries.json", None),
+                    (f"http://127.0.0.1:{mp}/queries.json",
+                     {"X-PIO-Variant": "c"}),
+                ):
+                    status, got = _post(url, q, headers)
+                    assert status == 200
+                    assert got == want, (dtype, q, url)
+        finally:
+            solo.stop()
+            multi.stop()
+
+
+@pytest.fixture()
+def multi_tenant(storage):
+    engine, _, inst = _train(storage, "Tenants", "tenants")
+    server = EngineServer(
+        engine, inst, storage=storage, host="127.0.0.1", port=0,
+        query_cache_mb=4.0,
+        extra_variants=[("b", rec.engine(), inst), ("c", rec.engine(), inst)],
+    )
+    port = server.start()
+    yield {"server": server, "base": f"http://127.0.0.1:{port}",
+           "storage": storage}
+    server.stop()
+
+
+class TestRoutingAndIsolation:
+    def test_unknown_variant_404s(self, multi_tenant):
+        base = multi_tenant["base"]
+        status, _ = _post(f"{base}/nope/queries.json", QUERIES[0])
+        assert status == 404
+        status, _ = _post(
+            f"{base}/queries.json", QUERIES[0], {"X-PIO-Variant": "nope"}
+        )
+        assert status == 404
+
+    def test_stats_has_per_variant_rows(self, multi_tenant):
+        base = multi_tenant["base"]
+        for q in QUERIES[:3]:
+            _post(f"{base}/b/queries.json", q)
+        with urllib.request.urlopen(f"{base}/stats.json", timeout=10) as r:
+            body = json.loads(r.read())
+        rows = body["variants"]
+        assert set(rows) >= {"default", "b", "c"}
+        assert rows["b"]["requestCount"] == 3
+        assert rows["c"]["requestCount"] == 0
+
+    def test_reload_of_one_tenant_leaves_others_untouched(
+        self, multi_tenant
+    ):
+        server = multi_tenant["server"]
+        base = multi_tenant["base"]
+        # warm every tenant's cache partition with the same query
+        for prefix in ("", "/b", "/c"):
+            status, _ = _post(f"{base}{prefix}/queries.json", QUERIES[0])
+            assert status == 200
+        epochs = {n: v._epoch for n, v in server.variants.items()}
+        entries_before = server.query_cache.gauges()["cache_entries"]
+        status, _ = _post(f"{base}/b/reload", {})
+        assert status == 200
+        assert server.variants["b"]._epoch == epochs["b"] + 1
+        assert server.variants["default"]._epoch == epochs["default"]
+        assert server.variants["c"]._epoch == epochs["c"]
+        # only b's partition was swept
+        assert (
+            server.query_cache.gauges()["cache_entries"]
+            == entries_before - 1
+        )
+        # default and c still answer from cache (hit count moves)
+        hits0 = server.query_cache.gauges()["cache_hits"]
+        status, _ = _post(f"{base}/queries.json", QUERIES[0])
+        assert status == 200
+        status, _ = _post(f"{base}/c/queries.json", QUERIES[0])
+        assert status == 200
+        assert server.query_cache.gauges()["cache_hits"] == hits0 + 2
+
+    def test_per_variant_latency_slos_installed(self, multi_tenant):
+        from predictionio_tpu.obs import slo as obs_slo
+
+        names = set(obs_slo.REGISTRY.names())
+        assert {"engine.latency[default]", "engine.latency[b]",
+                "engine.latency[c]"} <= names
