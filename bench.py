@@ -3034,7 +3034,26 @@ def _compact_summary(result: dict) -> dict:
             "rolling_restart_failed_requests": ps.get(
                 "rolling_restart_failed_requests"
             ),
+            "router_qps": ps.get("router", {}).get("qps"),
+            "router_retries": ps.get("router", {}).get("retries"),
             "ok": ps.get("ok"),
+        }
+    rt = result.get("routing")
+    if isinstance(rt, dict) and "error" not in rt:
+        sc = rt.get("scaling", {})
+        ch = rt.get("chaos", {})
+        hg = rt.get("hedging", {})
+        s["routing"] = {
+            "qps_1": sc.get("qps_1"),
+            "qps_4": sc.get("qps_4"),
+            "scaling_ratio": sc.get("scaling_ratio"),
+            "chaos_failed_requests": ch.get("failed_requests"),
+            "restarts": ch.get("restarts"),
+            "ejections": ch.get("ejections"),
+            "hedge_p99_off_ms": hg.get("p99_off_ms"),
+            "hedge_p99_on_ms": hg.get("p99_on_ms"),
+            "hedge_win_ratio": hg.get("hedge_win_ratio"),
+            "ok": rt.get("ok"),
         }
     dn = result.get("density")
     if isinstance(dn, dict) and "error" not in dn:
@@ -3914,6 +3933,36 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
         # recorded and byte-identical answers
         supervised = _prod_supervised_crash(tmp, smoke)
 
+        # router-tier phase: the scale-out front (server/router.py) goes
+        # in front of THIS engine on its live port and takes one full
+        # closed-loop round, chaos still armed. Its availability and
+        # latency SLOs were registered at construction, so the final
+        # no-violated gate below judges the router alongside everything
+        # else; the replica must end the round admitted. _load_gen
+        # asserts every status is 200, so a raise here IS the
+        # zero-failed-requests gate for the forwarded path.
+        from predictionio_tpu.server.router import RouterServer
+
+        router_server = RouterServer(
+            [("engine-0", "127.0.0.1", eport)],
+            host="127.0.0.1", port=0, probe_interval_s=0.2,
+        )
+        servers.append(router_server)
+        rport = router_server.start(background=True)
+        router_rung = _load_gen(
+            "127.0.0.1", rport, "/queries.json", bodies, conns,
+            5 if smoke else 15, n_procs=4,
+        )
+        rstats = router_server.stats()
+        router_block = {
+            **router_rung,
+            "forwarded": rstats["routing"]["requests"],
+            "retries": rstats["routing"]["retries"],
+            "replica_states": {
+                name: r["state"] for name, r in rstats["replicas"].items()
+            },
+        }
+
         fire_counts = {
             point: plan.fire_count(point) for point in chaos_points
         }
@@ -4033,6 +4082,7 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             },
             "rolling_restart_failed_requests": rolling_failed,
             "supervised": supervised,
+            "router": router_block,
             "restarts": supervised.get("restarts", 0),
             "chaos": {"plan": chaos, "fired": fire_counts},
             "slo": {"states": slo_states, "alerts": alerts},
@@ -4074,6 +4124,13 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
         assert supervised.get("byte_parity"), (
             f"restarted child served different bytes: {supervised}"
         )
+        assert router_block["replica_states"].get("engine-0") == "ready", (
+            f"router phase left the replica unadmitted: {router_block}"
+        )
+        assert router_block["forwarded"] >= router_rung["total_queries"], (
+            f"router forwarded fewer requests than it answered: "
+            f"{router_block}"
+        )
         assert sum(fire_counts.values()) > 0, "chaos plan never fired"
         assert incident_block.get("bundle"), (
             "armed chaos tripped no incident bundle"
@@ -4100,6 +4157,439 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             except Exception:
                 pass
         set_storage(None)
+
+
+def bench_routing(result: dict, smoke: bool = False) -> None:
+    """``bench.py routing [--smoke]``: the scale-out router tier
+    (server/router.py) over a real replica fleet, with its three
+    acceptance gates.
+
+    Supervised ``pio deploy`` replicas model a TPU-backed engine on this
+    one-core box: each child caps its handler pool at 4
+    (``PIO_HTTP_HANDLER_THREADS``) and sleeps 60 ms per query
+    (``PIO_FAULTS=serve.query:sleep=60``), so a single replica tops out
+    near slots/latency ~= 66 qps and extra throughput can only come
+    from MORE replicas — the concurrency model of a per-call device
+    dispatch, not of spare host cores. (The sleep must dominate the
+    per-query CPU cost: the fleet's aggregate python work still runs on
+    ONE core, and a 25 ms sleep left the 4-replica rung CPU-bound at
+    ~2.4x.) The spill threshold is pinned to the slot count so affinity
+    yields the moment a preferred replica's slots are full — work
+    conservation is what makes the aggregate scale. The gates:
+
+      scaling — the same closed-loop load through the router with one
+          replica admitted, then with all four; aggregate qps must reach
+          3x the single-replica rung.
+      chaos — kill -9 one replica mid-load; the supervisor restarts it,
+          the router ejects it and re-admits the NEW instance, and the
+          clients see ZERO failed requests.
+      hedging — a fifth replica is a probabilistic straggler (5% of its
+          queries sleep 300 ms); the same load through a two-replica
+          router with hedging off then on must cut p99 to <= 0.75x,
+          with hedges fired and at least one hedge win counted.
+    """
+    import http.client
+    import signal
+    import socket
+    import subprocess
+    import sys as _sys
+
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import App, Storage
+    from predictionio_tpu.models import recommendation
+    from predictionio_tpu.server import supervisor as sup_mod
+    from predictionio_tpu.server.router import RouterServer
+
+    tmp = tempfile.mkdtemp(dir=os.environ["BENCH_TMPDIR"])
+    # zero-config storage (sqlite + localfs under PIO_FS_BASEDIR): ONE
+    # env knob every replica child resolves the same repositories from
+    storage = Storage(env={"PIO_FS_BASEDIR": tmp})
+    app_id = storage.get_metadata_apps().insert(App(0, "RouteFleet"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(SEED + 2)
+    n = 600 if smoke else 2000
+    events.batch_insert(
+        [
+            Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties={"rating": float(r)},
+            )
+            for u, i, r in zip(
+                rng.integers(0, 50, n),
+                rng.integers(0, 30, n),
+                rng.integers(1, 6, n),
+            )
+        ],
+        app_id,
+    )
+    engine = recommendation.engine()
+    variant = {
+        "id": "route-fleet",
+        "engineFactory": "predictionio_tpu.models.recommendation.engine",
+        "datasource": {"params": {"app_name": "RouteFleet"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 4, "num_iterations": 2}}],
+    }
+    vfile = os.path.join(tmp, "variant.json")
+    with open(vfile, "w") as f:
+        json.dump(variant, f)
+    prev_storage = storage_mod._instance
+    storage_mod.set_storage(storage)
+    try:
+        run_train(
+            engine, engine.params_from_variant(variant),
+            engine_id="route-fleet",
+            engine_variant=os.path.basename(vfile),
+            engine_factory=variant["engineFactory"],
+            workflow_params=WorkflowParams(batch="bench"),
+            storage=storage,
+        )
+    finally:
+        storage_mod.set_storage(prev_storage)
+
+    # per-query dispatch model (see docstring). The probabilistic
+    # straggler rule must come FIRST in its plan: the first matching
+    # rule that trips wins, so the order "5% sleep 300; always sleep
+    # 25" gives 5% long calls and 95% normal ones.
+    dispatch_plan = "serve.query:sleep=60"
+    straggler_plan = "serve.query:p=0.05,seed=3:sleep=300;" + dispatch_plan
+    # spill the moment a preferred replica's 4 slots are busy (see
+    # docstring); operator env wins
+    os.environ.setdefault("PIO_ROUTER_SATURATION", "4")
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base_env = dict(os.environ)
+    base_env.pop("PIO_FAULTS", None)
+    base_env["PIO_FS_BASEDIR"] = tmp
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["PYTHONPATH"] = (
+        repo + os.pathsep + base_env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    # ONE shared compile cache: replica-0 pays the XLA compiles, the
+    # rest boot warm
+    base_env.setdefault(
+        "PIO_COMPILATION_CACHE_DIR", os.path.join(tmp, "jit_cache")
+    )
+    base_env["PIO_HTTP_HANDLER_THREADS"] = "4"
+
+    # 4 homogeneous replicas + 1 straggler; all ports picked up front
+    names = ["engine-0", "engine-1", "engine-2", "engine-3", "straggler"]
+    socks = [socket.socket() for _ in names]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = dict(zip(names, (s.getsockname()[1] for s in socks)))
+    for s in socks:
+        s.close()
+
+    def _spawn(name: str):
+        env = dict(base_env)
+        env["PIO_FAULTS"] = (
+            straggler_plan if name == "straggler" else dispatch_plan
+        )
+
+        def spawn():
+            log = open(os.path.join(tmp, f"{name}.log"), "ab")
+            try:
+                return subprocess.Popen(
+                    [_sys.executable, "-m", "predictionio_tpu.cli.main",
+                     "deploy", "--variant", vfile, "--ip", "127.0.0.1",
+                     "--port", str(ports[name]), "--reuse-port"],
+                    stdout=log, stderr=subprocess.STDOUT,
+                    stdin=subprocess.DEVNULL, start_new_session=True,
+                    env=env,
+                )
+            finally:
+                log.close()
+
+        return spawn
+
+    def _sup(members: list) -> sup_mod.Supervisor:
+        return sup_mod.Supervisor(
+            [
+                sup_mod.ServiceSpec(
+                    name=m, port=ports[m], spawn=_spawn(m),
+                    boot_timeout_s=300.0,
+                )
+                for m in members
+            ],
+            poll_interval=0.1, base_backoff_s=0.3, max_backoff_s=3.0,
+            flap_max=10, seed=5,
+        )
+
+    # more conns than the whole fleet has slots: a single replica is
+    # queue-bound (its ceiling shows), four replicas stay busy
+    conns = 24
+    per_conn = 25 if smoke else 60
+    bodies = [
+        json.dumps({"user": f"u{u}", "num": int(nq)})
+        for u, nq in zip(rng.integers(0, 50, 32), rng.choice([3, 4], 32))
+    ]
+
+    sup0 = _sup(["engine-0"])  # first up alone: pays the compiles
+    sup_rest = None
+    routers: list = []
+    block: dict = {"smoke": smoke, "replicas": 4}
+    result["routing"] = block
+    try:
+        sup0.start_all(wait_healthy_s=300.0)
+
+        # router A fronts the full 4-replica set from the start; the
+        # three unstarted members fail their probes and sit ejected
+        # until they boot — exactly the degraded-fleet admission path.
+        # Hedging stays off here so the scaling rungs measure replica
+        # capacity, not duplicated load.
+        router = RouterServer(
+            [(m, "127.0.0.1", ports[m]) for m in names[:4]],
+            host="127.0.0.1", port=0, probe_interval_s=0.2, hedge=False,
+        )
+        routers.append(router)
+        rport = router.start(background=True)
+
+        def _wait_admitted(rt, want: set, timeout_s: float = 120.0):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                ready = {
+                    nm for nm, st in rt.stats()["replicas"].items()
+                    if st["state"] == "ready"
+                }
+                if want <= ready:
+                    return
+                time.sleep(0.1)
+            raise RuntimeError(
+                f"replicas never admitted: want {sorted(want)}, "
+                f"have {rt.stats()['replicas']}"
+            )
+
+        _wait_admitted(router, {"engine-0"})
+        _load_gen("127.0.0.1", rport, "/queries.json", bodies, 8, 4,
+                  n_procs=4)  # warm jit shape buckets off the clock
+        rung1 = _load_gen(
+            "127.0.0.1", rport, "/queries.json", bodies, conns, per_conn,
+            n_procs=4,
+        )
+
+        # scale out: the remaining replicas (and the hedge phase's
+        # straggler) boot off the warm compile cache, the router's
+        # probe loop re-admits each as it turns ready
+        sup_rest = _sup(names[1:])
+        sup_rest.start_all(wait_healthy_s=300.0)
+        _wait_admitted(router, set(names[:4]))
+        _load_gen("127.0.0.1", rport, "/queries.json", bodies, conns, 4,
+                  n_procs=4)  # warm the new replicas off the clock
+        rung4 = _load_gen(
+            "127.0.0.1", rport, "/queries.json", bodies, conns, per_conn,
+            n_procs=4,
+        )
+        scaling_ratio = round(rung4["qps"] / rung1["qps"], 2)
+        block["scaling"] = {
+            "conns": conns,
+            "qps_1": rung1["qps"],
+            "qps_4": rung4["qps"],
+            "scaling_ratio": scaling_ratio,
+            "p99_ms_1": rung1["p99_ms"],
+            "p99_ms_4": rung4["p99_ms"],
+        }
+
+        # chaos: kill -9 engine-1 under load. The router must absorb
+        # the loss (passive ejection + retry on another replica), the
+        # supervisor must restart it, and the probe loop must admit the
+        # NEW instance — all with zero client-visible failures.
+        victim = next(
+            c for c in sup_rest._children if c.spec.name == "engine-1"
+        )
+        instance_before = victim.instance
+        chaos_rounds: list = []
+        chaos_errors: list = []
+        stop_chaos = threading.Event()
+
+        def _chaos_loop():
+            while not stop_chaos.is_set():
+                try:
+                    chaos_rounds.append(_load_gen(
+                        "127.0.0.1", rport, "/queries.json", bodies,
+                        conns, 15, n_procs=4,
+                    ))
+                except Exception as e:
+                    chaos_errors.append(f"{type(e).__name__}: {e}")
+                    return
+
+        chaos_t = threading.Thread(target=_chaos_loop, daemon=True)
+        chaos_t.start()
+        time.sleep(1.0)  # let at least part of a round land pre-kill
+        os.kill(victim.pid, signal.SIGKILL)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            sup_rest.step()
+            if (
+                victim.state == sup_mod.UP
+                and victim.restarts == 1
+                and victim.instance != instance_before
+            ):
+                break
+            time.sleep(0.1)
+        assert victim.state == sup_mod.UP and victim.restarts == 1, (
+            f"kill -9'd replica not restarted: state={victim.state} "
+            f"restarts={victim.restarts} last_exit={victim.last_exit}"
+        )
+        _wait_admitted(router, set(names[:4]))
+        rounds_at_readmit = len(chaos_rounds)
+        deadline = time.time() + 120
+        while time.time() < deadline:  # a full round past re-admission
+            if len(chaos_rounds) > rounds_at_readmit + 1 or chaos_errors:
+                break
+            time.sleep(0.1)
+        stop_chaos.set()
+        chaos_t.join(timeout=120)
+        replica_stats = router.stats()["replicas"]
+        block["chaos"] = {
+            "rounds": len(chaos_rounds),
+            "total_queries": sum(
+                r["total_queries"] for r in chaos_rounds
+            ),
+            "failed_requests": len(chaos_errors),
+            "errors": chaos_errors,
+            "restarts": victim.restarts,
+            "ejections": replica_stats["engine-1"]["ejections"],
+            "readmitted_new_instance": (
+                replica_stats["engine-1"]["instance"] == victim.instance
+                and victim.instance != instance_before
+            ),
+        }
+
+        # hedging A/B: a two-replica router over the healthy engine-0
+        # and the straggler, same load with hedging off then on. The
+        # off rung also fills the latency window the adaptive delay is
+        # computed from, so the on rung hedges at a meaningful p95.
+        # Fewer conns than the pair has slots: queueing must NOT bury
+        # the straggler's tail, or the adaptive delay (an observed
+        # quantile) climbs past the point where hedging can win.
+        hedge_conns = 8
+        hedge_router = RouterServer(
+            [("engine-0", "127.0.0.1", ports["engine-0"]),
+             ("straggler", "127.0.0.1", ports["straggler"])],
+            host="127.0.0.1", port=0, probe_interval_s=0.2, hedge=False,
+        )
+        routers.append(hedge_router)
+        hport = hedge_router.start(background=True)
+        _wait_admitted(hedge_router, {"engine-0", "straggler"})
+        _load_gen("127.0.0.1", hport, "/queries.json", bodies, 8, 4,
+                  n_procs=4)  # warm the straggler off the clock
+        hedge_per_conn = 120 if smoke else 240
+        off = _load_gen(
+            "127.0.0.1", hport, "/queries.json", bodies, hedge_conns,
+            hedge_per_conn, n_procs=4,
+        )
+        # the pio_router_* counters are process-global (shared by every
+        # router in this bench) — account for the on rung by delta
+        hedges0 = hedge_router._m_hedges.value()
+        wins0 = hedge_router._m_hedge_wins.value()
+        hedge_router.hedge_enabled = True
+        on = _load_gen(
+            "127.0.0.1", hport, "/queries.json", bodies, hedge_conns,
+            hedge_per_conn, n_procs=4,
+        )
+        hedges = hedge_router._m_hedges.value() - hedges0
+        hedge_wins = hedge_router._m_hedge_wins.value() - wins0
+        block["hedging"] = {
+            "delay_ms": round(hedge_router.hedge_delay_s() * 1e3, 1),
+            "p99_off_ms": off["p99_ms"],
+            "p99_on_ms": on["p99_ms"],
+            "p99_improvement": round(off["p99_ms"] / on["p99_ms"], 2)
+            if on["p99_ms"] else None,
+            "hedges": hedges,
+            "hedge_wins": hedge_wins,
+            "hedge_win_ratio": round(hedge_wins / hedges, 3)
+            if hedges else 0.0,
+        }
+        block["ok"] = False
+
+        # THE GATES
+        assert scaling_ratio >= 3.0, (
+            f"router did not scale: 1 replica {rung1['qps']} qps, "
+            f"4 replicas {rung4['qps']} qps (ratio {scaling_ratio})"
+        )
+        assert not chaos_errors, (
+            f"kill -9 leaked failures to clients: {chaos_errors}"
+        )
+        assert len(chaos_rounds) > rounds_at_readmit, (
+            "no closed-loop round crossed the re-admission"
+        )
+        assert block["chaos"]["ejections"] >= 1, (
+            f"router never ejected the killed replica: {replica_stats}"
+        )
+        assert block["chaos"]["readmitted_new_instance"], (
+            f"restarted replica not re-admitted as a new member: "
+            f"{block['chaos']}"
+        )
+        assert hedges > 0 and hedge_wins > 0, (
+            f"hedging never engaged: {block['hedging']}"
+        )
+        assert on["p99_ms"] <= 0.75 * off["p99_ms"], (
+            f"hedging did not cut the straggler tail: "
+            f"off p99 {off['p99_ms']}ms, on p99 {on['p99_ms']}ms"
+        )
+        block["ok"] = True
+    finally:
+        for rt in routers:
+            try:
+                rt.stop()
+            except Exception:
+                pass
+        if sup_rest is not None:
+            sup_rest.stop()
+        sup0.stop()
+
+
+def routing_main(smoke: bool) -> None:
+    """``bench.py routing [--smoke]``: the scale-out router scenario on
+    its own — replica-scaling, kill -9 absorption, and hedging gates.
+    Prints the full-detail line plus the compact summary line; exits
+    non-zero unless every gate passed."""
+    import atexit
+    import shutil
+    import sys as _sys
+
+    if smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    # the scenario drives its own load; no background SLO cadence
+    os.environ.setdefault("PIO_SLO_TICK", "0")
+    from predictionio_tpu.utils import apply_platform_env
+
+    apply_platform_env()
+    tmpdir = tempfile.mkdtemp(prefix="pio_bench_route_")
+    atexit.register(shutil.rmtree, tmpdir, ignore_errors=True)
+    os.environ["BENCH_TMPDIR"] = tmpdir
+    # the supervisor records child pid/port files under the run dir —
+    # keep the bench fleet out of any real deployment's state
+    os.environ["PIO_RUN_DIR"] = os.path.join(tmpdir, "run")
+    result: dict = {
+        "metric": "bench_routing",
+        "value": None,
+        "unit": "s",
+        "device": "cpu (smoke)" if smoke else "default",
+        "smoke": smoke,
+    }
+    t0 = time.perf_counter()
+    try:
+        bench_routing(result, smoke=smoke)
+    except Exception as e:
+        block = result.get("routing")
+        err = f"{type(e).__name__}: {e}"
+        if isinstance(block, dict):
+            block["error"] = err
+        else:
+            result["routing"] = {"error": err}
+    result["value"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(result))
+    print(json.dumps(_compact_summary(result)))
+    rt = result.get("routing", {})
+    ok = rt.get("ok") is True and "error" not in rt
+    _sys.exit(0 if ok else 1)
 
 
 # out-of-process tailer for the wire-speed ingest ladder: attaches to
@@ -4729,6 +5219,9 @@ def main() -> None:
 
     if "production_stack" in sys.argv:
         production_stack_main(smoke="--smoke" in sys.argv)
+        return
+    if "routing" in sys.argv:
+        routing_main(smoke="--smoke" in sys.argv)
         return
     if "ingest" in sys.argv:
         ingest_main(smoke="--smoke" in sys.argv)

@@ -26,17 +26,18 @@ __all__ = ["compare", "leaf_direction", "format_report", "main"]
 _LOWER_BETTER = (
     "_ms", "_s", "_us", "_ns", "_seconds", "p50", "p99", "p90",
     "latency", "behind", "rss", "overhead", "cost", "lost", "rmse",
-    "compiles", "_pct", "failed", "restarts",
+    "compiles", "_pct", "failed", "restarts", "retries", "ejections",
 )
 _HIGHER_BETTER = (
     "per_s", "qps", "speedup", "events", "throughput", "hit_rate",
-    "ratio_ok", "recall",
+    "ratio_ok", "recall", "win_ratio", "scaling_ratio",
 )
 # keys that are config/identity, not measurements
 _SKIP = (
     "value", "conns", "clients", "workers", "batch_size", "cores",
     "acked", "n", "count", "rounds", "budget", "objective", "seed",
     "port", "pid", "capacity", "scale", "tenants", "variants",
+    "replicas", "hedges",
 )
 
 

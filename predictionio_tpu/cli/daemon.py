@@ -27,13 +27,29 @@ import time
 from pathlib import Path
 
 # service name -> default port (matching the reference's defaults:
-# event server :7070, dashboard :9000, admin :7071; engine :8000)
+# event server :7070, dashboard :9000, admin :7071; engine :8000; the
+# router tier fronts engine replicas on :8100)
 DEFAULT_PORTS = {
     "eventserver": 7070,
     "dashboard": 9000,
     "adminserver": 7071,
     "engine": 8000,
+    "router": 8100,
 }
+
+
+def service_port(name: str) -> int:
+    """The port a named service actually listens on: its service record
+    (written at start) wins — replica-set members (``engine-0``,
+    ``engine-1``, ...) have no DEFAULT_PORTS entry — falling back to the
+    static default, then 0 for the unknown."""
+    rec = read_service_record(name)
+    if rec is not None:
+        try:
+            return int(rec.get("port") or 0)
+        except (TypeError, ValueError):
+            pass
+    return DEFAULT_PORTS.get(name, 0)
 
 
 def run_dir() -> Path:

@@ -131,6 +131,8 @@ def cmd_status(args) -> int:
         print(line)
     for line in _variant_lines():
         print(line)
+    for line in _replica_lines():
+        print(line)
     return 0
 
 
@@ -147,7 +149,7 @@ def _variant_lines() -> list[str]:
     for name in daemon.known_services():
         if daemon.read_pid(name) is None:
             continue
-        port = daemon.DEFAULT_PORTS.get(name, 0)
+        port = daemon.service_port(name)
         try:
             with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/stats.json", timeout=2.0
@@ -170,6 +172,46 @@ def _variant_lines() -> list[str]:
             if v.get("modelAgeSec") is not None:
                 parts.append(f"model age {v['modelAgeSec']}s")
             lines.append(f"variant[{name}/{vname}]: {', '.join(parts)}")
+    return lines
+
+
+def _replica_lines() -> list[str]:
+    """Human per-replica lines for ``pio status`` when a router tier is
+    up: one row per pool member off its /stats.json ``replicas`` block,
+    e.g. ``replica[router/engine-0]: ready, 2 inflight, p99 31.0ms,
+    124 reqs`` — ejected members lead with their state upper-cased."""
+    import urllib.request
+
+    from predictionio_tpu.cli import daemon
+
+    lines: list[str] = []
+    for name in daemon.known_services():
+        if daemon.read_pid(name) is None:
+            continue
+        port = daemon.service_port(name)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats.json", timeout=2.0
+            ) as r:
+                stats = json.loads(r.read())
+        except Exception:
+            continue
+        replicas = (
+            stats.get("replicas") if isinstance(stats, dict) else None
+        ) or {}
+        for rname, rr in replicas.items():
+            state = str(rr.get("state", "?"))
+            mark = state if state == "ready" else state.upper()
+            parts = [
+                f"{rr.get('inflight', 0)} inflight",
+                f"p99 {rr.get('p99Ms', 0)}ms",
+                f"{rr.get('requests', 0)} reqs",
+            ]
+            if rr.get("ejections"):
+                parts.append(f"{rr['ejections']} ejections")
+            lines.append(
+                f"replica[{name}/{rname}]: {mark}, {', '.join(parts)}"
+            )
     return lines
 
 
@@ -235,7 +277,7 @@ def _fetch_slo_docs() -> dict[str, dict]:
     for name in daemon.known_services():
         if daemon.read_pid(name) is None:
             continue
-        port = daemon.DEFAULT_PORTS.get(name, 0)
+        port = daemon.service_port(name)
         try:
             with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/slo.json", timeout=2.0
@@ -432,7 +474,7 @@ def _top_targets(urls: list[str] | None) -> list[tuple[str, str]]:
     for name in daemon.known_services():
         if daemon.read_pid(name) is None:
             continue
-        port = daemon.DEFAULT_PORTS.get(name, 0)
+        port = daemon.service_port(name)
         out.append((name, f"http://127.0.0.1:{port}"))
     return out
 
@@ -508,6 +550,20 @@ def _top_row(name: str, base: str) -> dict:
                 }
                 for vname, v in variants.items()
             }
+        # router tier: one sub-row per backend replica (/stats.json
+        # "replicas" block), mirroring the variant sub-row convention
+        replicas = stats.get("replicas") or {}
+        if replicas:
+            row["replicas"] = {
+                rname: {
+                    "state": r.get("state"),
+                    "inflight": r.get("inflight"),
+                    "p99_ms": r.get("p99Ms"),
+                    "requests": r.get("requests"),
+                    "ejections": r.get("ejections"),
+                }
+                for rname, r in replicas.items()
+            }
     except Exception:
         pass
     return row
@@ -555,6 +611,15 @@ def cmd_top(args) -> int:
                     f"{v.get('seconds_behind') if v.get('seconds_behind') is not None else '-':>9} "
                     f"{'':>7} epoch:{v.get('epoch', '-')}"
                 )
+            for rname, rr in (row.get("replicas") or {}).items():
+                req = rr.get("requests")
+                print(
+                    f"  ↳{rname:<12} {req if req is not None else '-':>9} "
+                    f"{rr.get('p99_ms') if rr.get('p99_ms') is not None else '-':>9} "
+                    f"{'':>9} {'':>7} "
+                    f"{rr.get('state', '?')} inflight:{rr.get('inflight', 0)} "
+                    f"ejections:{rr.get('ejections', 0)}"
+                )
         if not rows:
             print("no live daemons (and no --url given)")
         if once:
@@ -587,7 +652,7 @@ def _status_json() -> int:
         pid = daemon.read_pid(name)
         if pid is None:
             continue
-        port = daemon.DEFAULT_PORTS.get(name, 0)
+        port = daemon.service_port(name)
         entry: dict = {"pid": pid, "port": port}
         base = f"http://127.0.0.1:{port}"
         raw = fetch(f"{base}/metrics")
@@ -1169,6 +1234,45 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_route(args) -> int:
+    """``pio route``: the scale-out router tier — one front port
+    spreading /queries.json across a replica set of engine servers with
+    consistent-hash affinity, health-aware ejection, and hedged
+    requests (server/router.py; docs/operations.md "Scale-out
+    serving")."""
+    from predictionio_tpu.server.router import RouterServer, parse_replica_spec
+
+    replicas: list[tuple[str, str, int]] = []
+    for i, spec in enumerate(args.replica or []):
+        try:
+            replicas.append(parse_replica_spec(spec, i))
+        except ValueError as e:
+            print(f"route: {e}", file=sys.stderr)
+            return 1
+    if args.replicas:
+        host = args.engine_host
+        base = args.engine_port
+        replicas.extend(
+            (f"engine-{i}", host, base + i) for i in range(args.replicas)
+        )
+    if not replicas:
+        print(
+            "route: name at least one backend (--replica HOST:PORT or "
+            "--replicas N)", file=sys.stderr,
+        )
+        return 1
+    server = RouterServer(
+        replicas,
+        host=args.ip,
+        port=args.port,
+        reuse_port=args.reuse_port,
+        probe_interval_s=args.probe_interval or None,
+        hedge=False if args.no_hedge else None,
+    )
+    server.start(background=False)
+    return 0
+
+
 def cmd_export(args) -> int:
     from predictionio_tpu.cli import commands
     from predictionio_tpu.data.store import EventStoreError
@@ -1280,8 +1384,7 @@ def cmd_start_all(args) -> int:
         # beyond the reference's script: also deploy the latest trained
         # engine so one verb yields a fully queryable stack. Paths go
         # absolute — the daemon child's cwd is not this shell's.
-        deploy = ["deploy", "--ip", args.ip, "--port", str(args.engine_port),
-                  "--reuse-port"]
+        deploy = ["deploy", "--ip", args.ip, "--reuse-port"]
         if args.variant:
             deploy += ["--variant", os.path.abspath(args.variant)]
         if args.engine_factory:
@@ -1297,7 +1400,28 @@ def cmd_start_all(args) -> int:
                     if p.strip()
                 ),
             ]
-        plan.append(("engine", deploy, args.engine_port))
+        replicas = int(getattr(args, "replicas", 0) or 0)
+        if replicas > 0:
+            # scale-out: N engine replicas on consecutive ports, each a
+            # first-class supervised service (engine-0..engine-N-1),
+            # fronted by the router tier on --router-port
+            router_host = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+            route = ["route", "--ip", args.ip,
+                     "--port", str(args.router_port), "--reuse-port"]
+            for i in range(replicas):
+                port = args.engine_port + i
+                plan.append((
+                    f"engine-{i}",
+                    deploy + ["--port", str(port)],
+                    port,
+                ))
+                route += ["--replica", f"engine-{i}={router_host}:{port}"]
+            plan.append(("router", route, args.router_port))
+        else:
+            plan.append(
+                ("engine", deploy + ["--port", str(args.engine_port)],
+                 args.engine_port)
+            )
 
     if getattr(args, "supervise", False):
         return _run_supervised(args, plan)
@@ -1372,19 +1496,40 @@ def _run_supervised(args, plan) -> int:
 def cmd_rolling_restart(args) -> int:
     """``pio rolling-restart <service>``: zero-downtime replacement of a
     recorded daemon — new instance overlaps on the same port via
-    SO_REUSEPORT, must pass /readyz, then the old one drains out."""
+    SO_REUSEPORT, must pass /readyz, then the old one drains out.
+
+    ``pio rolling-restart engineserver`` walks the whole engine replica
+    set (``engine`` and every ``engine-<i>``) ONE replica at a time: a
+    router tier in front keeps serving off the others while each rolls,
+    so the fleet upgrades with zero failed requests."""
+    import re
+
     from predictionio_tpu.cli import daemon
 
-    try:
-        info = daemon.rolling_restart(args.service, wait=args.wait)
-    except RuntimeError as e:
-        print(f"rolling-restart: {e}", file=sys.stderr)
-        return 1
-    print(
-        f"{info['service']}: rolled pid {info['old_pid']} -> "
-        f"{info['new_pid']} on port {info['port']} "
-        f"(instance {info['instance']})"
-    )
+    if args.service in ("engineserver", "engines"):
+        names = [
+            n for n in daemon.known_services()
+            if n == "engine" or re.fullmatch(r"engine-\d+", n)
+        ]
+        if not names:
+            print(
+                "rolling-restart: no running engine replicas recorded",
+                file=sys.stderr,
+            )
+            return 1
+    else:
+        names = [args.service]
+    for name in names:
+        try:
+            info = daemon.rolling_restart(name, wait=args.wait)
+        except RuntimeError as e:
+            print(f"rolling-restart: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"{info['service']}: rolled pid {info['old_pid']} -> "
+            f"{info['new_pid']} on port {info['port']} "
+            f"(instance {info['instance']})"
+        )
     return 0
 
 
@@ -1733,6 +1878,36 @@ def build_parser() -> argparse.ArgumentParser:
     db.add_argument("--server-config", help="server.conf path (key auth / SSL)")
     db.set_defaults(fn=cmd_dashboard)
 
+    rt = sub.add_parser(
+        "route",
+        help="scale-out router tier over a set of engine replicas",
+    )
+    rt.add_argument("--ip", default="0.0.0.0")
+    rt.add_argument("--port", type=int, default=8100)
+    rt.add_argument(
+        "--replica", action="append", metavar="[NAME=]HOST:PORT",
+        help="one backend engine replica (repeatable)",
+    )
+    rt.add_argument(
+        "--replicas", type=int, default=0, metavar="N",
+        help="route to N replicas on consecutive ports starting at "
+        "--engine-port (names engine-0..engine-N-1, matching what "
+        "`pio start-all --replicas N` spawns)",
+    )
+    rt.add_argument("--engine-host", default="127.0.0.1")
+    rt.add_argument("--engine-port", type=int, default=8000)
+    rt.add_argument(
+        "--probe-interval", type=float, default=0.0, metavar="SECONDS",
+        help="replica /readyz probe interval (default "
+        "PIO_ROUTER_PROBE_INTERVAL_S or 1.0)",
+    )
+    rt.add_argument(
+        "--no-hedge", action="store_true",
+        help="disable hedged requests (equivalent to PIO_ROUTER_HEDGE=0)",
+    )
+    rt.add_argument("--reuse-port", action="store_true")
+    rt.set_defaults(fn=cmd_route)
+
     ex = sub.add_parser("export")
     ex.add_argument("--appid-or-name", required=True)
     ex.add_argument("--output", required=True)
@@ -1803,6 +1978,17 @@ def build_parser() -> argparse.ArgumentParser:
             "--supervise-port", type=int, default=0,
             help="with --supervise: serve supervisor /stats.json and "
             "/metrics on this port",
+        )
+        parser.add_argument(
+            "--replicas", type=int, default=0, metavar="N",
+            help="deploy the engine as N replicas on consecutive ports "
+            "starting at --engine-port, fronted by the `pio route` "
+            "router tier on --router-port (see docs/operations.md "
+            "\"Scale-out serving\")",
+        )
+        parser.add_argument(
+            "--router-port", type=int, default=8100,
+            help="router-tier port used with --replicas",
         )
 
     sa = sub.add_parser("start-all")

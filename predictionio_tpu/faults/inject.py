@@ -90,6 +90,13 @@ KNOWN_POINTS: dict[str, str] = {
                         "(models/modelfile.py; a raise falls the load "
                         "back to a plain byte read, counted in "
                         "pio_model_mmap_fallback_total)",
+    "router.forward": "router-tier forward of one query attempt to one "
+                      "replica (server/router.py; a raise ejects the "
+                      "replica and retries on another, counted in "
+                      "pio_router_retries_total)",
+    "router.probe": "router-tier /readyz probe of one replica "
+                    "(server/router.py; a raise ejects the replica "
+                    "until a later probe round re-admits it)",
 }
 
 _EXCEPTIONS: dict[str, type[BaseException]] = {

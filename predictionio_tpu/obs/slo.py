@@ -672,6 +672,40 @@ def install_variant_slos(variant) -> list[Slo]:
     return [register(s) for s in slos]
 
 
+def install_router_slos(router_server) -> list[Slo]:
+    """Router-tier defaults: non-5xx availability and end-to-end p99 on
+    the router's own HTTP histogram. The latency budget defaults to the
+    serving budget (the router should be invisible); ``PIO_SLO_ROUTER_MS``
+    overrides it when hedging headroom is wanted."""
+    reg = _metrics.REGISTRY
+    requests = reg.counter(
+        "pio_http_requests_total", "Requests handled", server="router"
+    )
+    errors = reg.counter(
+        "pio_http_errors_total", "Requests answered with 5xx", server="router"
+    )
+    slos = [
+        AvailabilitySlo(
+            "router.availability",
+            total=requests,
+            bad=errors,
+            objective=_env_float("PIO_SLO_ROUTER_AVAILABILITY", 0.999),
+            description="Non-5xx fraction of router-tier requests",
+        ),
+        LatencySlo(
+            "router.latency",
+            router_server.app._m_request,
+            threshold_s=_env_float(
+                "PIO_SLO_ROUTER_MS", _env_float("PIO_SLO_SERVING_MS", 250.0)
+            ) / 1e3,
+            objective=_env_float("PIO_SLO_ROUTER_OBJECTIVE", 0.99),
+            description="Routed queries under the latency budget "
+                        "(hedging absorbs stragglers)",
+        ),
+    ]
+    return [register(s) for s in slos]
+
+
 def install_event_server_slos(server) -> list[Slo]:
     """Event server defaults: ingest availability + group-commit
     latency."""

@@ -432,6 +432,8 @@ class Dashboard:
             # sparklines — ?src= aims them at a live serving process
             src = request.query.get("src")
             variants = {}
+            replicas = {}
+            routing = {}
             if src and src.startswith(("http://", "https://")):
                 slo_doc = _fetch_src_json(src, "/slo.json") or {}
                 hist = _fetch_src_json(src, "/history.json") or {}
@@ -441,6 +443,12 @@ class Dashboard:
                 v = stats.get("variants")
                 if isinstance(v, dict) and len(v) > 1:
                     variants = v
+                # router tier (?src= at a `pio route` process): one row
+                # per pool replica plus the hedging counters
+                r = stats.get("replicas")
+                if isinstance(r, dict) and r:
+                    replicas = r
+                    routing = stats.get("routing") or {}
                 ops_source = src
             else:
                 slo_doc = obs_slo.document()
@@ -468,6 +476,33 @@ class Dashboard:
                     "<th>Behind s</th><th>Model age s</th></tr>"
                     f"{vrows}</table>"
                 )
+            replicas_html = ""
+            if replicas:
+                rrows = "".join(
+                    "<tr>"
+                    f"<td>{html.escape(str(name))}</td>"
+                    f"<td>{html.escape(str(r.get('state', '?')))}</td>"
+                    f"<td>{r.get('inflight', 0)}</td>"
+                    f"<td>{r.get('p99Ms', '-')}</td>"
+                    f"<td>{r.get('requests', 0)}</td>"
+                    f"<td>{r.get('ejections', 0)}</td>"
+                    f"<td>{html.escape(str(r.get('instance') or '-'))}</td>"
+                    "</tr>"
+                    for name, r in replicas.items()
+                )
+                replicas_html = (
+                    "<h3>Router replicas</h3>"
+                    "<table border='1'><tr><th>Replica</th><th>State</th>"
+                    "<th>Inflight</th><th>p99 ms</th><th>Requests</th>"
+                    "<th>Ejections</th><th>Instance</th></tr>"
+                    f"{rrows}</table>"
+                    "<p>routing: "
+                    f"{routing.get('requests', 0)} requests, "
+                    f"{routing.get('retries', 0)} retries, "
+                    f"{routing.get('hedges', 0)} hedges "
+                    f"({routing.get('hedge_win_ratio', 0)} win ratio, "
+                    f"delay {routing.get('hedge_delay_ms', '-')} ms)</p>"
+                )
             ops = (
                 f"<h2>Operations <small>({html.escape(ops_source)})</small>"
                 "</h2>"
@@ -478,6 +513,7 @@ class Dashboard:
                 "<h3>Recent SLO alerts</h3>"
                 + render_alerts_table(slo_doc.get("alerts", []))
                 + variants_html
+                + replicas_html
                 + (
                     "<h3>Request rate (per history step)</h3>" + spark
                     if spark

@@ -1085,7 +1085,7 @@ class HTTPApp:
         read_timeout: float = 120.0,
         recv_buffer: bool = True,
         name: str = "server",
-        handler_threads: int = 16,
+        handler_threads: int | None = None,
         ready_check: "Callable[[], str | None] | None" = None,
     ):
         self.router = router
@@ -1132,6 +1132,17 @@ class HTTPApp:
         # worker-pinned for their whole life: the BufferedReader may
         # hold pipelined bytes the selector cannot see.
         self.recv_buffer = recv_buffer
+        # default 16, overridable per-process via PIO_HTTP_HANDLER_THREADS:
+        # the per-replica concurrency cap a scale-out fleet tunes so one
+        # replica's slot count — not the host's core count — bounds how
+        # many dispatch-bound queries it serves at once
+        if handler_threads is None:
+            try:
+                handler_threads = int(
+                    os.environ.get("PIO_HTTP_HANDLER_THREADS", "") or 16
+                )
+            except ValueError:
+                handler_threads = 16
         self.handler_threads = max(1, int(handler_threads))
         self._loop: _EventLoop | None = None
         self._pool = None

@@ -1,0 +1,385 @@
+"""Scale-out router tier (server/router.py): ring affinity must be
+deterministic and stable across replica restarts, spill must be
+work-conserving, ejection/re-admission must follow the breaker backoff
+with instance-aware membership, the ``router.forward``/``router.probe``
+fault points must drive retry and ejection exactly as documented, and a
+multi-tenant replica set behind the router must stay byte-identical to
+hitting the replica directly — including 404 pass-through for unknown
+tenants."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu import faults
+from predictionio_tpu.server import router as router_mod
+from predictionio_tpu.server.http import HTTPApp, Response, Router
+from predictionio_tpu.server.router import (
+    Replica,
+    ReplicaPool,
+    RouterServer,
+    parse_replica_spec,
+)
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST"
+    )
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _mk_pool(n=3, **kw):
+    reps = [Replica(f"r{i}", "127.0.0.1", 10000 + i) for i in range(n)]
+    pool = ReplicaPool(reps, seed=7, **kw)
+    for r in reps:
+        r.state = router_mod.READY
+        r.instance = f"boot-{r.name}"
+    return pool, reps
+
+
+class TestReplicaPool:
+    def test_affinity_is_deterministic_and_spreads(self):
+        pool, _ = _mk_pool()
+        keys = [f"query-{i}".encode() for i in range(64)]
+        first = {k: pool.pick(k).name for k in keys}
+        for _ in range(5):
+            assert {k: pool.pick(k).name for k in keys} == first
+        assert len(set(first.values())) > 1  # the ring actually spreads
+
+    def test_ring_is_stable_across_pool_rebuild(self):
+        """The ring is keyed on replica NAME: a rebuilt pool (the
+        restarted-router case) sends every key to the same replica."""
+        pool_a, _ = _mk_pool()
+        pool_b, _ = _mk_pool()
+        for i in range(64):
+            k = f"query-{i}".encode()
+            assert pool_a.pick(k).name == pool_b.pick(k).name
+
+    def test_saturated_preferred_spills_to_least_inflight(self):
+        pool, reps = _mk_pool(saturation=2)
+        key = b"sticky"
+        preferred = pool.pick(key)
+        preferred.inflight = 2  # slots full
+        others = [r for r in reps if r is not preferred]
+        others[0].inflight = 1
+        for _ in range(10):
+            assert pool.pick(key) is not preferred
+
+    def test_ejected_preferred_is_skipped_and_exclude_honored(self):
+        pool, reps = _mk_pool()
+        key = b"sticky"
+        preferred = pool.pick(key)
+        preferred.state = router_mod.EJECTED
+        assert pool.pick(key) is not preferred
+        assert pool.pick(key, exclude={r.name for r in reps}) is None
+        only = pool.pick_other(
+            exclude={r.name for r in reps if r is not reps[0]}
+        )
+        assert only is reps[0]
+
+    def test_failure_ejects_with_backoff_and_probe_readmits(self):
+        t = [100.0]
+        pool, reps = _mk_pool(
+            eject_base_s=1.0, eject_max_s=8.0, clock=lambda: t[0]
+        )
+        r0 = reps[0]
+        pool.begin(r0)
+        pool.record_failure(r0, "connect refused")
+        assert r0.state == router_mod.EJECTED
+        assert r0.ejections == 1 and r0.retry_at > t[0]
+
+        def probe(host, port, timeout=0):
+            return {"ready": True, "instance": r0.instance}
+
+        # same instance, backoff not served: the ready probe is ignored
+        pool.probe_one(r0, probe=probe)
+        assert r0.state == router_mod.EJECTED
+        # backoff expired: the same ready probe re-admits
+        t[0] = r0.retry_at + 0.01
+        pool.probe_one(r0, probe=probe)
+        assert r0.state == router_mod.READY
+
+    def test_repeat_failures_while_ejected_do_not_escalate(self):
+        t = [100.0]
+        pool, reps = _mk_pool(
+            eject_base_s=1.0, eject_max_s=8.0, clock=lambda: t[0]
+        )
+        r0 = reps[0]
+        pool.begin(r0)
+        pool.record_failure(r0, "boom")
+        retry_at = r0.retry_at
+        pool.probe_one(r0, probe=lambda *a, **k: None)  # failing probe
+        assert (r0.ejections, r0.eject_attempt) == (1, 1)
+        assert r0.retry_at == retry_at  # backoff not re-armed per probe
+
+    def test_new_instance_bypasses_backoff(self):
+        """A restarted replica is a NEW member: a ready probe with a
+        different instance id admits it immediately, fresh stats."""
+        t = [100.0]
+        pool, reps = _mk_pool(
+            eject_base_s=1000.0, eject_max_s=2000.0, clock=lambda: t[0]
+        )
+        r0 = reps[0]
+        r0.latencies.append(0.5)
+        pool.begin(r0)
+        pool.record_failure(r0, "kill -9")
+        assert r0.retry_at > t[0] + 500  # effectively forever
+
+        pool.probe_one(
+            r0, probe=lambda *a, **k: {"ready": True, "instance": "resp-2"}
+        )
+        assert r0.state == router_mod.READY
+        assert r0.instance == "resp-2"
+        assert r0.eject_attempt == 0 and not r0.latencies
+
+    def test_success_resets_breaker_escalation(self):
+        pool, reps = _mk_pool()
+        r0 = reps[0]
+        r0.eject_attempt = 3
+        pool.begin(r0)
+        pool.record_success(r0, 0.01)
+        assert r0.eject_attempt == 0
+
+
+class TestParseReplicaSpec:
+    def test_forms(self):
+        assert parse_replica_spec("127.0.0.1:8000", 2) \
+            == ("engine-2", "127.0.0.1", 8000)
+        assert parse_replica_spec("web=10.0.0.5:9001", 0) \
+            == ("web", "10.0.0.5", 9001)
+
+    @pytest.mark.parametrize("bad", ["8000", "host:", ":9", "host:abc"])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_replica_spec(bad, 0)
+
+
+def _fake_engine(tag: str, delay_s: float = 0.0):
+    """A minimal replica: answers /queries.json (tagged, echoing the
+    body) and exposes the HTTPApp's built-in /readyz with its per-boot
+    instance id — everything the router's probe and forward need."""
+    r = Router()
+
+    def q(req):
+        if delay_s:
+            time.sleep(delay_s)
+        return Response.json({"who": tag, "echo": req.json()})
+
+    r.add("POST", "/queries.json", q)
+    app = HTTPApp(r, host="127.0.0.1", port=0, name=f"fake-{tag}")
+    port = app.start(background=True)
+    return app, port
+
+
+@pytest.fixture()
+def fake_pair():
+    a, ap = _fake_engine("a")
+    b, bp = _fake_engine("b")
+    made = []
+    try:
+        yield {"a": ("engine-0", "127.0.0.1", ap),
+               "b": ("engine-1", "127.0.0.1", bp), "made": made}
+    finally:
+        for srv in made:
+            srv.stop()
+        a.stop()
+        b.stop()
+
+
+def _router(fake_pair, **kw):
+    kw.setdefault("probe_interval_s", 5.0)
+    kw.setdefault("hedge", False)
+    server = RouterServer(
+        [fake_pair["a"], fake_pair["b"]], host="127.0.0.1", port=0, **kw
+    )
+    fake_pair["made"].append(server)
+    port = server.start(background=True)
+    return server, port
+
+
+class TestFaultPoints:
+    def test_router_points_are_documented(self):
+        from predictionio_tpu.faults.inject import KNOWN_POINTS
+
+        assert "router.forward" in KNOWN_POINTS
+        assert "router.probe" in KNOWN_POINTS
+
+    def test_forward_fault_retries_on_another_replica(self, fake_pair):
+        server, port = _router(fake_pair)
+        retries0 = server._m_retries.value()
+        with faults.injected("router.forward:times=1:raise"):
+            status, body = _post(
+                f"http://127.0.0.1:{port}/queries.json", {"user": "u1"}
+            )
+        assert status == 200  # the client never saw the fault
+        assert json.loads(body)["echo"] == {"user": "u1"}
+        assert server._m_retries.value() - retries0 == 1
+        stats = server.stats()["replicas"]
+        assert sum(s["ejections"] for s in stats.values()) == 1
+        assert sum(1 for s in stats.values() if s["state"] == "ready") == 1
+
+    def test_probe_fault_ejects_until_probe_recovers(self, fake_pair):
+        server, port = _router(fake_pair, probe_interval_s=0.05)
+        with faults.injected("router.probe:times=20:raise"):
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                states = {
+                    s["state"] for s in server.stats()["replicas"].values()
+                }
+                if states == {"ejected"}:
+                    break
+                time.sleep(0.02)
+            assert states == {"ejected"}
+            # nothing admitted: the router itself reports not-ready
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=5
+                )
+            assert ei.value.code == 503
+        # the plan is spent: probes succeed, backoff expires, both
+        # replicas get re-admitted and traffic flows again
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            states = {
+                s["state"] for s in server.stats()["replicas"].values()
+            }
+            if states == {"ready"}:
+                break
+            time.sleep(0.05)
+        assert states == {"ready"}
+        status, _ = _post(
+            f"http://127.0.0.1:{port}/queries.json", {"user": "u2"}
+        )
+        assert status == 200
+
+
+class TestHedging:
+    def test_hedge_beats_the_straggler(self, fake_pair, monkeypatch):
+        """With one straggling replica, hedged requests finish near the
+        healthy replica's latency: the duplicate fires after the
+        (blind, clamped-to-max) delay and the first response wins."""
+        monkeypatch.setenv("PIO_ROUTER_HEDGE_MIN_MS", "5")
+        monkeypatch.setenv("PIO_ROUTER_HEDGE_MAX_MS", "60")
+        slow, sp = _fake_engine("slow", delay_s=0.5)
+        try:
+            fake_pair["b"] = ("engine-1", "127.0.0.1", sp)
+            server, port = _router(fake_pair, hedge=True)
+            hedges0 = server._m_hedges.value()
+            wins0 = server._m_hedge_wins.value()
+            for i in range(12):
+                t0 = time.perf_counter()
+                status, _ = _post(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    {"user": f"u{i}"},
+                )
+                elapsed = time.perf_counter() - t0
+                assert status == 200
+                assert elapsed < 0.45, (
+                    f"query u{i} waited out the straggler: {elapsed:.3f}s"
+                )
+            assert server._m_hedges.value() > hedges0
+            assert server._m_hedge_wins.value() > wins0
+        finally:
+            slow.stop()
+
+
+class TestMultiTenantThroughRouter:
+    """Satellite of the multi-tenant engine: every routing form must be
+    byte-identical through the router, including the 404 for an unknown
+    tenant (a replica 4xx is the CLIENT's answer, not a router
+    failure)."""
+
+    QUERIES = [{"user": f"u{u}", "num": 3} for u in range(4)]
+
+    def _train(self, storage):
+        from predictionio_tpu.cli import commands
+        from predictionio_tpu.core import EngineParams
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.models import recommendation as rec
+
+        events = storage.get_events()
+        info = commands.app_new("RouteTenants", storage=storage)
+        rng = np.random.default_rng(13)
+        for u in range(10):
+            for _ in range(5):
+                events.insert(
+                    Event(
+                        event="rate", entity_type="user",
+                        entity_id=f"u{u}", target_entity_type="item",
+                        target_entity_id=f"i{int(rng.integers(0, 8))}",
+                        properties={"rating": float(rng.integers(1, 6))},
+                    ),
+                    info["id"],
+                )
+        engine = rec.engine()
+        ep = EngineParams(
+            datasource=("", rec.DataSourceParams(app_name="RouteTenants")),
+            algorithms=[(
+                "als", rec.ALSAlgorithmParams(rank=4, num_iterations=2),
+            )],
+        )
+        run_train(engine, ep, engine_id="route-tenants", storage=storage)
+        inst = storage.get_metadata_engine_instances() \
+            .get_latest_completed("route-tenants", "0", "default")
+        return engine, inst
+
+    def test_byte_identity_and_404_passthrough(self, storage):
+        from predictionio_tpu.models import recommendation as rec
+        from predictionio_tpu.server.engine_server import EngineServer
+
+        engine, inst = self._train(storage)
+        multi = EngineServer(
+            engine, inst, storage=storage, host="127.0.0.1", port=0,
+            extra_variants=[("b", rec.engine(), inst)],
+        )
+        mp = multi.start()
+        server = RouterServer(
+            [("engine-0", "127.0.0.1", mp)], host="127.0.0.1", port=0,
+            probe_interval_s=5.0, hedge=False,
+        )
+        rp = server.start(background=True)
+        try:
+            forms = [
+                ("/queries.json", None),
+                ("/b/queries.json", None),
+                ("/queries.json", {"X-PIO-Variant": "b"}),
+                # unknown tenant: the replica's 404 message passes
+                # through byte-identical, both route forms
+                ("/nope/queries.json", None),
+                ("/queries.json", {"X-PIO-Variant": "nope"}),
+            ]
+            for q in self.QUERIES:
+                for path, headers in forms:
+                    sd, direct = _post(
+                        f"http://127.0.0.1:{mp}{path}", q, headers
+                    )
+                    sr, routed = _post(
+                        f"http://127.0.0.1:{rp}{path}", q, headers
+                    )
+                    assert (sr, routed) == (sd, direct), (path, headers)
+            # the stats surface pio status/top/dashboard render from
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{rp}/stats.json", timeout=10
+            ) as r:
+                doc = json.loads(r.read())
+            assert doc["server"] == "router"
+            assert doc["replicas"]["engine-0"]["state"] == "ready"
+            assert doc["replicas"]["engine-0"]["requests"] > 0
+            assert doc["routing"]["hedge_enabled"] is False
+        finally:
+            server.stop()
+            multi.stop()
