@@ -27,17 +27,18 @@ _LOWER_BETTER = (
     "_ms", "_s", "_us", "_ns", "_seconds", "p50", "p99", "p90",
     "latency", "behind", "rss", "overhead", "cost", "lost", "rmse",
     "compiles", "_pct", "failed", "restarts", "retries", "ejections",
+    "wall_ratio",
 )
 _HIGHER_BETTER = (
     "per_s", "qps", "speedup", "events", "throughput", "hit_rate",
-    "ratio_ok", "recall", "win_ratio", "scaling_ratio",
+    "ratio_ok", "recall", "win_ratio", "scaling_ratio", "saved",
 )
 # keys that are config/identity, not measurements
 _SKIP = (
     "value", "conns", "clients", "workers", "batch_size", "cores",
     "acked", "n", "count", "rounds", "budget", "objective", "seed",
     "port", "pid", "capacity", "scale", "tenants", "variants",
-    "replicas", "hedges",
+    "replicas", "hedges", "iterations",
 )
 
 

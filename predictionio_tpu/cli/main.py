@@ -828,6 +828,14 @@ def cmd_train(args) -> int:
         os.environ["PIO_RESUME"] = "1"
     if getattr(args, "checkpoint_dir", None):
         os.environ["PIO_CHECKPOINT_DIR"] = args.checkpoint_dir
+    if getattr(args, "warm_start", False):
+        os.environ["PIO_WARM_START"] = "1"
+    if getattr(args, "tol", None) is not None:
+        os.environ["PIO_TOL"] = str(args.tol)
+    if getattr(args, "no_prep_cache", False):
+        os.environ["PIO_PREP_CACHE"] = "0"
+    if getattr(args, "prep_cache_dir", None):
+        os.environ["PIO_PREP_CACHE_DIR"] = args.prep_cache_dir
     if getattr(args, "multihost", False):
         # join the global mesh BEFORE anything touches JAX: afterwards
         # jax.devices() is the pod-wide set and --mesh axes span hosts
@@ -1763,6 +1771,32 @@ def build_parser() -> argparse.ArgumentParser:
         "--checkpoint-dir", metavar="DIR",
         help="where checkpoints live (sets PIO_CHECKPOINT_DIR; "
         "default ~/.pio_tpu/checkpoints)",
+    )
+    t.add_argument(
+        "--warm-start", action="store_true",
+        help="seed the solve from the latest COMPLETED instance's "
+        "model instead of random factors (sets PIO_WARM_START=1; an "
+        "incompatible previous model — changed rank or storage dtype — "
+        "falls back to cold start with a warning; docs/operations.md "
+        "hot-retrain runbook)",
+    )
+    t.add_argument(
+        "--tol", type=float, metavar="T",
+        help="stop iterating when the per-segment train RMSE improves "
+        "by less than T (sets PIO_TOL; rides the checkpoint-segmented "
+        "dispatch, so combine with --warm-start to turn a good starting "
+        "point into fewer iterations)",
+    )
+    t.add_argument(
+        "--no-prep-cache", action="store_true",
+        help="skip the packed-prep cache and rebuild the training "
+        "representation from the event log (sets PIO_PREP_CACHE=0; "
+        "docs/storage.md \"Packed-prep cache\")",
+    )
+    t.add_argument(
+        "--prep-cache-dir", metavar="DIR",
+        help="where packed-prep cache entries live (sets "
+        "PIO_PREP_CACHE_DIR; default ~/.pio_tpu/prep_cache)",
     )
     t.set_defaults(fn=cmd_train)
 

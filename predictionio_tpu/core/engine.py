@@ -147,7 +147,19 @@ class Engine(Generic[TD, PD, Q, P, A]):
         if wp.stop_after_prepare:
             raise StopAfterPrepareInterruption()
 
-        models = [algo.train(ctx, pd) for algo in algorithms]
+        # warm starts ride runtime_conf: the workflow driver resolves the
+        # previous instance's models into "warm_start_models" (aligned
+        # with the algorithms list) and each algorithm sees only its own
+        # slot — algorithms that don't understand warm starts ignore it
+        warm = ctx.runtime_conf.get("warm_start_models")
+        models = []
+        for i, algo in enumerate(algorithms):
+            if warm is not None:
+                ctx.runtime_conf["warm_start_model"] = (
+                    warm[i] if i < len(warm) else None
+                )
+            models.append(algo.train(ctx, pd))
+        ctx.runtime_conf.pop("warm_start_model", None)
         for i, m in enumerate(models):
             _sanity(m, f"Model {i}", wp.skip_sanity_check)
         return models

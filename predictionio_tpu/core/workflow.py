@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import traceback
 from datetime import datetime, timezone
 from typing import Any, Mapping
@@ -111,6 +112,12 @@ def run_train(
 
     try:
         algorithms = engine.make_algorithms(engine_params)
+        if _warm_start_requested(wp):
+            prev = _previous_models(
+                storage, algorithms, engine_id, engine_version, engine_variant
+            )
+            if prev is not None:
+                ctx.runtime_conf["warm_start_models"] = prev
         if wp.profile_dir:
             import jax.profiler
 
@@ -144,6 +151,71 @@ def run_train(
             "engine instance %s FAILED:\n%s", instance_id, traceback.format_exc()
         )
         raise
+
+
+def _warm_start_requested(wp: WorkflowParams) -> bool:
+    """``pio train --warm-start`` sets PIO_WARM_START=1 (works across the
+    CLI's subprocess boundary); in-process callers can set
+    ``runtime_conf["warm_start"]`` instead."""
+    if wp.runtime_conf.get("warm_start"):
+        return True
+    env = os.environ.get("PIO_WARM_START", "").strip().lower()
+    return env not in ("", "0", "false", "no", "off")
+
+
+def _previous_models(
+    storage: Storage,
+    algorithms: list[Any],
+    engine_id: str,
+    engine_version: str,
+    engine_variant: str,
+) -> list[Any] | None:
+    """Models of the latest COMPLETED instance of this engine identity,
+    aligned with ``algorithms``, for warm-start carries. Any failure —
+    no previous instance, no persisted blob, undeserializable model —
+    degrades to a cold start with a named warning; per-algorithm
+    compatibility (rank/dtype) is checked by the algorithm itself."""
+    try:
+        instance = storage.get_metadata_engine_instances().get_latest_completed(
+            engine_id, engine_version, engine_variant
+        )
+        if instance is None:
+            logger.warning(
+                "warm-start: no completed instance for engine %s/%s/%s; "
+                "cold start", engine_id, engine_version, engine_variant,
+            )
+            return None
+        model_store = storage.get_model_data_models()
+        models = None
+        local = model_store.local_path(instance.id)
+        if local is not None:
+            # zero-copy path: flat model-file entries mmap in place, so
+            # the warm carry costs page faults, not a deserialize
+            models = persistence.deserialize_model_path(
+                local, algorithms, instance.id
+            )
+        if models is None:
+            blob = model_store.get(instance.id)
+            if blob is None:
+                logger.warning(
+                    "warm-start: instance %s has no persisted model; "
+                    "cold start", instance.id,
+                )
+                return None
+            models = persistence.deserialize_models(
+                blob.models, algorithms, instance.id
+            )
+        models = [
+            None if m is persistence.RETRAIN else m for m in models
+        ]
+        logger.info(
+            "warm-start: carrying models from instance %s", instance.id
+        )
+        return models
+    except Exception as e:
+        logger.warning("warm-start: previous model unavailable (%s); "
+                       "cold start", e)
+        return None
 
 
 def prepare_deploy(

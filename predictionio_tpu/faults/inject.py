@@ -97,6 +97,10 @@ KNOWN_POINTS: dict[str, str] = {
     "router.probe": "router-tier /readyz probe of one replica "
                     "(server/router.py; a raise ejects the replica "
                     "until a later probe round re-admits it)",
+    "train.prep_cache": "packed-prep cache publish (core/prep_cache.py "
+                        "store; a raise skips the publish — training is "
+                        "unaffected and the next train falls back to a "
+                        "clean rebuild)",
 }
 
 _EXCEPTIONS: dict[str, type[BaseException]] = {

@@ -21,6 +21,7 @@ Queries/results use the same JSON shape as the reference template:
 from __future__ import annotations
 
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Sequence
@@ -96,6 +97,12 @@ class TrainingData(SanityCheck):
     rows: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
     cols: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
     ratings: np.ndarray = field(default_factory=lambda: np.empty(0, np.float32))
+    # packed-prep cache handle riding alongside the data (core/prep_cache
+    # PrepHandle): lets Algorithm.train reuse/splice the cached bucketed
+    # pack and publish the fresh one after training. None for synthetic
+    # TrainingData (eval folds, tests) — everything downstream must
+    # getattr-gate on it.
+    prep: object = field(default=None, repr=False, compare=False)
 
     def sanity_check(self) -> None:
         if len(self.ratings) == 0:
@@ -117,17 +124,34 @@ class RecommendationDataSource(DataSource):
         # timing log below is the input-pipeline number to watch when a
         # train looks slow.
         t0 = time.perf_counter()
-        batch = store.find_ratings(
-            app_name=self.params.app_name,
+        from predictionio_tpu.core import prep_cache
+
+        handle = prep_cache.probe(
+            self.params.app_name,
             entity_type="user",
             event_names=list(self.params.event_names),
             target_entity_type="item",
             rating_key="rating",
+            default_ratings=None,
             override_ratings={"buy": self.params.buy_rating},
         )
+        if handle.status in ("hit", "splice"):
+            # warm retrain: the full scan is skipped — an exact hit is an
+            # mmap of the previous packed prep, a splice decoded only the
+            # appended tail bytes (docs/storage.md "Packed-prep cache")
+            batch = handle.batch
+        else:
+            batch = store.find_ratings(
+                app_name=self.params.app_name,
+                entity_type="user",
+                event_names=list(self.params.event_names),
+                target_entity_type="item",
+                rating_key="rating",
+                override_ratings={"buy": self.params.buy_rating},
+            )
         logger.info(
-            "read_training: %d rating rows in %.3fs",
-            len(batch.vals), time.perf_counter() - t0,
+            "read_training: %d rating rows in %.3fs (prep cache: %s)",
+            len(batch.vals), time.perf_counter() - t0, handle.status,
         )
         return TrainingData(
             user_ids=batch.entity_ids,
@@ -135,6 +159,7 @@ class RecommendationDataSource(DataSource):
             rows=batch.rows,
             cols=batch.cols,
             ratings=batch.vals,
+            prep=handle,
         )
 
     def read_eval(self, ctx: WorkflowContext):
@@ -322,14 +347,34 @@ class ALSAlgorithm(Algorithm):
         item_index = BiMap.from_dense(td.item_ids)
         rows, cols = td.rows, td.cols
         vals = np.asarray(td.ratings, dtype=np.float32)
-        data = als_ops.build_ratings_data(
-            rows,
-            cols,
-            vals,
-            len(user_index),
-            len(item_index),
-            bucket_widths=tuple(self.params.bucket_widths),
+        prep = getattr(td, "prep", None)
+        widths = tuple(self.params.bucket_widths)
+        packed = (
+            prep.packed_buckets(widths)
+            if prep is not None and prep.active else None
         )
+        if packed is not None:
+            # hot retrain: buckets come out of the prep cache (mmap'd on
+            # an exact hit, surgically spliced on an appended tail) —
+            # bit-identical to a fresh build_padded_buckets by contract
+            data = als_ops.RatingsData(
+                rows=np.asarray(rows, np.int32),
+                cols=np.asarray(cols, np.int32),
+                vals=vals,
+                num_rows=len(user_index),
+                num_cols=len(item_index),
+                row_buckets=packed[0],
+                col_buckets=packed[1],
+            )
+        else:
+            data = als_ops.build_ratings_data(
+                rows,
+                cols,
+                vals,
+                len(user_index),
+                len(item_index),
+                bucket_widths=widths,
+            )
         params = als_ops.ALSParams(
             rank=self.params.rank,
             iterations=self.params.num_iterations,
@@ -341,13 +386,44 @@ class ALSAlgorithm(Algorithm):
         )
         from predictionio_tpu.parallel.als_sharded import train_for_context
 
+        warm = self._resolve_warm_start(ctx, td)
+        try:
+            tol = float(os.environ.get("PIO_TOL", "") or (
+                ctx.runtime_conf.get("tol", 0.0) if ctx is not None else 0.0
+            ) or 0.0)
+        except ValueError:
+            tol = 0.0
+        prepacked = None
+        pub_sharded = None
+        if self.params.sharded_train and ctx is not None:
+            prepacked, pub_sharded = self._sharded_prepack(ctx, prep, data, params)
         U, V = train_for_context(
             data,
             params,
             ctx,
             sharded=self.params.sharded_train,
             mode=self.params.sharded_mode,
+            warm_start=warm,
+            tol=tol,
+            prepacked=prepacked,
+            progress_extra=(
+                {"prep_cache": prep.status} if prep is not None else None
+            ),
         )
+        if prep is not None and prep.active and prep.status != "hit":
+            from predictionio_tpu.data.storage import base as storage_base
+
+            prep.publish(
+                storage_base.RatingsBatch(
+                    entity_ids=td.user_ids, target_ids=td.item_ids,
+                    rows=data.rows, cols=data.cols, vals=data.vals,
+                ),
+                data=data,
+                bucket_widths=widths,
+                sharded=pub_sharded,
+                params=params,
+                sharded_requested=self.params.sharded_mode,
+            )
         logger.info(
             "ALS trained: %d users x %d items, rank %d, train RMSE %.4f",
             len(user_index),
@@ -365,6 +441,100 @@ class ALSAlgorithm(Algorithm):
             user_scales=us,
             item_scales=vs,
         )
+
+    def _resolve_warm_start(self, ctx, td):
+        """Previous model -> iteration-0 factor carry, or None for cold.
+
+        The model arrives via ``ctx.runtime_conf["warm_start_model"]``
+        (core/workflow.py resolves ``--warm-start`` to the latest
+        COMPLETED instance's persisted model). Incompatible models —
+        wrong type, changed rank, changed storage dtype — fall back to
+        cold start with a named warning, never a crash: factor shapes are
+        baked into the compiled trainers, so feeding them mismatched
+        carries would be a silent re-trace at best. Rows are re-aligned
+        id-by-id; entities unknown to the previous model keep NaN, which
+        the trainer's warm-init merge replaces with the cold random draw.
+        """
+        prev = ctx.runtime_conf.get("warm_start_model") if ctx is not None else None
+        if prev is None:
+            return None
+        if not isinstance(prev, ALSModel):
+            logger.warning(
+                "warm-start: previous model is %s, not ALSModel; cold start",
+                type(prev).__name__,
+            )
+            return None
+        prev_rank = int(prev.user_factors.shape[1])
+        if prev_rank != int(self.params.rank):
+            logger.warning(
+                "warm-start: rank mismatch (previous model %d, params %d); "
+                "cold start", prev_rank, self.params.rank,
+            )
+            return None
+        prev_dtype = (
+            "int8" if prev.user_scales is not None
+            else str(prev.user_factors.dtype)
+        )
+        if prev_dtype != self.params.storage_dtype:
+            logger.warning(
+                "warm-start: storage dtype mismatch (previous model %s, "
+                "params %s); cold start", prev_dtype, self.params.storage_dtype,
+            )
+            return None
+
+        def align(ids, index, take):
+            out = np.full((len(ids), prev_rank), np.nan, np.float32)
+            ix = np.fromiter(
+                (index.get(i, -1) for i in ids), np.int64, len(ids)
+            )
+            m = ix >= 0
+            if m.any():
+                out[np.flatnonzero(m)] = take(ix[m])
+            return out
+
+        U0 = align(td.user_ids, prev.user_index, prev.user_rows)
+        V0 = align(
+            td.item_ids, prev.item_index,
+            lambda ixs: (
+                prev.item_factors[ixs].astype(np.float32)
+                * prev.item_scales[ixs][:, None]
+                if prev.item_scales is not None
+                else np.asarray(prev.item_factors[ixs], np.float32)
+            ),
+        )
+        logger.info(
+            "warm-start: carrying %d/%d user and %d/%d item factor rows "
+            "from previous model",
+            int(np.isfinite(U0[:, 0]).sum()), len(td.user_ids),
+            int(np.isfinite(V0[:, 0]).sum()), len(td.item_ids),
+        )
+        return U0, V0
+
+    def _sharded_prepack(self, ctx, prep, data, params):
+        """(prepacked, publishable) for the sharded trainer: the cached
+        layouts+superstructures on an exact prep-cache hit, else a fresh
+        ``prepare_sharded_pack`` built here so it can be published after
+        training. Returns (None, None) when the mesh axis can't be
+        resolved — train_for_context then packs internally and raises its
+        own (better) error."""
+        from predictionio_tpu.parallel import als_sharded
+
+        mesh = ctx.mesh
+        if "data" in mesh.shape:
+            axis = "data"
+        elif len(mesh.axis_names) == 1:
+            axis = mesh.axis_names[0]
+        else:
+            return None, None
+        shards = int(mesh.shape[axis])
+        if prep is not None and prep.active:
+            cached = prep.sharded_pack(params, shards, self.params.sharded_mode)
+            if cached is not None:
+                return cached, None
+        fresh = als_sharded.prepare_sharded_pack(
+            data, params, shards, self.params.sharded_mode
+        )
+        return fresh, fresh
 
     def train_sweep(
         self, ctx: WorkflowContext, td: TrainingData, params_list

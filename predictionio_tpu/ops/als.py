@@ -147,42 +147,167 @@ def build_padded_buckets(
         idx = np.nonzero(sel)[0]
         if len(idx) == 0:
             continue
-        R = len(idx)
-        # per selected row: number of width-sized segments (1 unless hot)
-        nseg = (
-            np.maximum(1, -(-counts[idx] // width)) if last else np.ones(R, np.int64)
-        )
-        seg_base = np.concatenate([[0], np.cumsum(nseg)])
-        B = int(seg_base[-1])
-
-        # entry -> (segment table row, within-segment position)
-        rowpos = np.full(len(uniq), -1, np.int64)
-        rowpos[idx] = np.arange(R)
-        pos = rowpos[inv]
-        m = pos >= 0
-        seg_of_entry = seg_base[pos[m]] + rank[m] // width
-        within = rank[m] % width
-
-        col_ids = np.zeros((B, width), dtype=np.int32)
-        ratings = np.zeros((B, width), dtype=np.float32)
-        mask = np.zeros((B, width), dtype=np.float32)
-        col_ids[seg_of_entry, within] = cols_s[m]
-        ratings[seg_of_entry, within] = vals_s[m]
-        mask[seg_of_entry, within] = 1.0
-
-        seg_row = None
-        if last and B > R:
-            seg_row = np.repeat(np.arange(R, dtype=np.int32), nseg)
         buckets.append(
-            PaddedBucket(
-                row_ids=uniq[idx].astype(np.int32),
-                col_ids=col_ids,
-                ratings=ratings,
-                mask=mask,
-                seg_row=seg_row,
+            _fill_bucket_class(
+                width, last, counts, uniq, idx, rank, inv, cols_s, vals_s
             )
         )
     return buckets
+
+
+def _fill_bucket_class(
+    width: int,
+    last: bool,
+    counts: np.ndarray,
+    uniq: np.ndarray,
+    idx: np.ndarray,
+    rank: np.ndarray,
+    inv: np.ndarray,
+    cols_s: np.ndarray,
+    vals_s: np.ndarray,
+) -> PaddedBucket:
+    """Materialize ONE width class from row-sorted entry arrays. Shared
+    by the full build and :func:`splice_padded_buckets` — the splice
+    rebuilds affected classes through this exact fill, which is what
+    makes spliced buckets bit-identical to a fresh pack by construction.
+
+    ``counts``/``uniq`` describe the distinct rows of the entry set;
+    ``idx`` selects this class's rows within ``uniq``; ``rank`` is each
+    entry's within-row rank and ``inv`` its ``uniq`` index; ``cols_s``/
+    ``vals_s`` are the entries sorted stably by row.
+    """
+    R = len(idx)
+    # per selected row: number of width-sized segments (1 unless hot)
+    nseg = (
+        np.maximum(1, -(-counts[idx] // width)) if last else np.ones(R, np.int64)
+    )
+    seg_base = np.concatenate([[0], np.cumsum(nseg)])
+    B = int(seg_base[-1])
+
+    # entry -> (segment table row, within-segment position)
+    rowpos = np.full(len(uniq), -1, np.int64)
+    rowpos[idx] = np.arange(R)
+    pos = rowpos[inv]
+    m = pos >= 0
+    seg_of_entry = seg_base[pos[m]] + rank[m] // width
+    within = rank[m] % width
+
+    col_ids = np.zeros((B, width), dtype=np.int32)
+    ratings = np.zeros((B, width), dtype=np.float32)
+    mask = np.zeros((B, width), dtype=np.float32)
+    col_ids[seg_of_entry, within] = cols_s[m]
+    ratings[seg_of_entry, within] = vals_s[m]
+    mask[seg_of_entry, within] = 1.0
+
+    seg_row = None
+    if last and B > R:
+        seg_row = np.repeat(np.arange(R, dtype=np.int32), nseg)
+    return PaddedBucket(
+        row_ids=uniq[idx].astype(np.int32),
+        col_ids=col_ids,
+        ratings=ratings,
+        mask=mask,
+        seg_row=seg_row,
+    )
+
+
+def splice_padded_buckets(
+    old_buckets: Sequence[PaddedBucket],
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    delta_rows: np.ndarray,
+    bucket_widths: Sequence[int] = DEFAULT_BUCKETS,
+) -> list[PaddedBucket]:
+    """Incrementally rebuild padded buckets after a splice.
+
+    ``rows``/``cols``/``vals`` are the FULL post-splice COO arrays (old
+    entries in their original stream order with the delta entries
+    spliced in); ``delta_rows`` are the row indices of just the delta
+    entries; ``old_buckets`` is the pack of the pre-splice arrays built
+    with the same ``bucket_widths``.
+
+    Only width classes whose membership or contents could have changed —
+    the current and previous classes of every delta-touched row — are
+    rebuilt (from the full arrays, restricted to member rows, through
+    the same :func:`_fill_bucket_class` fill as a fresh build); untouched
+    classes reuse the old bucket arrays verbatim. Correct because a
+    class's arrays depend only on its member rows' entry sequences, and
+    an untouched row's entries (and their relative order under the
+    stable row sort) are unchanged by the splice. Requires a stable id
+    space: delta entries may only reference existing row indices or new
+    indices past the old maximum (the appended-ids invariant of the
+    prep cache's splice path). ``segment=True`` semantics only.
+    """
+    if len(rows) == 0:
+        return []
+    if len(delta_rows) == 0 and old_buckets:
+        return list(old_buckets)
+    widths = sorted(set(int(w) for w in bucket_widths))
+    n_w = len(widths)
+    warr = np.asarray(widths)
+    bc = np.bincount(rows)
+    uniq_all = np.flatnonzero(bc)
+    counts_all = bc[uniq_all]
+    # width class of every present row: first width >= count, clamped to
+    # the (segmenting) last class — matches the (lo, width] selection of
+    # the full build exactly
+    cls = np.minimum(
+        np.searchsorted(warr, counts_all, side="left"), n_w - 1
+    )
+
+    touched = np.unique(delta_rows)
+    pos_t = np.searchsorted(uniq_all, touched)
+    affected = set(int(c) for c in cls[pos_t])
+    old_counts_t = counts_all[pos_t] - np.bincount(
+        delta_rows, minlength=int(bc.shape[0])
+    )[touched]
+    existed = old_counts_t > 0
+    if existed.any():
+        affected |= set(
+            int(c)
+            for c in np.minimum(
+                np.searchsorted(warr, old_counts_t[existed], side="left"),
+                n_w - 1,
+            )
+        )
+
+    old_by_width = {b.width: b for b in old_buckets}
+    out: list[PaddedBucket] = []
+    for wi, width in enumerate(widths):
+        sel = cls == wi
+        if not sel.any():
+            continue
+        if wi not in affected and width in old_by_width:
+            out.append(old_by_width[width])
+            continue
+        member = np.zeros(bc.shape[0], dtype=bool)
+        member[uniq_all[sel]] = True
+        m_ent = member[rows]
+        sub_rows = rows[m_ent]
+        order = np.argsort(sub_rows, kind="stable")
+        rows_s = sub_rows[order]
+        cols_s = cols[m_ent][order]
+        vals_s = vals[m_ent][order]
+        uniq, starts, counts = np.unique(
+            rows_s, return_index=True, return_counts=True
+        )
+        rank = np.arange(len(rows_s)) - np.repeat(starts, counts)
+        inv = np.repeat(np.arange(len(uniq)), counts)
+        out.append(
+            _fill_bucket_class(
+                width,
+                wi == n_w - 1,
+                counts,
+                uniq,
+                np.arange(len(uniq)),
+                rank,
+                inv,
+                cols_s,
+                vals_s,
+            )
+        )
+    return out
 
 
 def build_ratings_data(
@@ -783,7 +908,32 @@ def _device_bucket_arrays(buckets: Sequence[PaddedBucket]):
     )
 
 
-def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
+# Diagnostics of the most recent als_train / sharded_als_train run in
+# this process: {"iterations_run", "early_stopped", "final_rmse",
+# "warm_start"}. A test/bench hook, not an API — read it right after the
+# call that produced it.
+LAST_TRAIN_INFO: dict = {}
+
+
+def _warm_init(cold, warm) -> jnp.ndarray:
+    """Merge a warm-start factor table into the cold init: ``warm`` is a
+    full-size float32 array with NaN rows marking "no prior factors —
+    keep the cold draw", so rows absent from the previous model train
+    from exactly the factors a cold run would have given them."""
+    if warm is None:
+        return cold
+    warm = jnp.asarray(np.asarray(warm, dtype=np.float32))
+    return jnp.where(jnp.isnan(warm), cold, warm)
+
+
+def als_train(
+    data: RatingsData,
+    params: ALSParams,
+    checkpoint_cfg=None,
+    warm_start=None,
+    tol: float = 0.0,
+    progress_extra: dict | None = None,
+):
     """Run ALS; returns (user_factors, item_factors) as jax arrays.
 
     The full iteration loop runs as a single fused device program (one
@@ -796,13 +946,26 @@ def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
     to one full-length dispatch, zero recompiles — with an atomic
     snapshot of the carry persisted at each segment boundary. ``resume``
     restores the latest fingerprint-matched snapshot and continues.
+
+    ``warm_start`` feeds a previous model in as the iteration-0 carry:
+    an optional ``(U0, V0)`` pair of full-size float32 arrays (NaN rows
+    fall back to the cold init — see :func:`_warm_init`) that rides the
+    same donated-carry dispatch as a checkpoint resume. ``tol`` > 0
+    enables RMSE-plateau early stop: the run is dispatched in segments
+    (of the checkpoint cadence, else one iteration) and stops when the
+    per-segment RMSE improvement drops below ``tol`` — what converts a
+    warm start into fewer iterations instead of just a better curve.
     """
     from predictionio_tpu import faults
     from predictionio_tpu.core import checkpoint as ckpt
 
     key_u, key_v = jax.random.split(jax.random.PRNGKey(params.seed))
-    U = to_storage(init_factors(data.num_rows, params.rank, key_u), params.storage_dtype)
-    V = to_storage(init_factors(data.num_cols, params.rank, key_v), params.storage_dtype)
+    U0 = _warm_init(init_factors(data.num_rows, params.rank, key_u),
+                    warm_start[0] if warm_start is not None else None)
+    V0 = _warm_init(init_factors(data.num_cols, params.rank, key_v),
+                    warm_start[1] if warm_start is not None else None)
+    U = to_storage(U0, params.storage_dtype)
+    V = to_storage(V0, params.storage_dtype)
     # iterations rides as a dynamic loop bound; normalize it out of the
     # static params key so runs differing only in iteration count share
     # one compiled program
@@ -829,10 +992,13 @@ def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
 
     nnz = len(data.vals)
     prog = obs_progress.ProgressPublisher(
-        params.iterations, mesh="single", trainer="single"
+        params.iterations, mesh="single", trainer="single",
+        warm_start=warm_start is not None, **(progress_extra or {}),
     )
     t0 = _time.perf_counter()
-    if cfg is None or cfg.every <= 0:
+    final_rmse = None
+    it = params.iterations
+    if tol <= 0.0 and (cfg is None or cfg.every <= 0):
         prog.publish(start_iter)
         faults.fault_point("device.dispatch")
         out = _train_fused(
@@ -840,19 +1006,26 @@ def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
             params.iterations - start_iter,
         )
     else:
+        # segmented dispatch: the checkpoint cadence, or — when only the
+        # tol early stop asks for segments — every iteration, so the
+        # plateau check rides the same per-segment RMSE trajectory the
+        # progress file publishes
+        ckpt_every = cfg.every if (cfg is not None and cfg.every > 0) else 0
+        every = ckpt_every if ckpt_every > 0 else 1
         prog.publish(start_iter)
         out = (U, V)
         it = start_iter
         epochs = 0
+        prev_rmse = None
         while it < params.iterations:
-            seg = min(cfg.every, params.iterations - it)
+            seg = min(every, params.iterations - it)
             faults.fault_point("device.dispatch")
             t_seg = _time.perf_counter()
             out = _train_fused(
                 out[0], out[1], row_arrays, col_arrays, static_params, seg
             )
             it += seg
-            if it < params.iterations:
+            if ckpt_every > 0 and it < params.iterations:
                 jax.block_until_ready(out)
                 ckpt.save_checkpoint(
                     cfg, fingerprint, out[0], out[1], it, params.seed,
@@ -860,19 +1033,38 @@ def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
                 )
                 epochs += 1
             seg_wall = _time.perf_counter() - t_seg
+            seg_rmse = (
+                rmse(out[0], out[1], data.rows, data.cols, data.vals)
+                if (tol > 0.0 or prog.enabled)
+                else None
+            )
+            if seg_rmse is not None:
+                final_rmse = float(seg_rmse)
             prog.publish(
                 it,
-                rmse=(
-                    rmse(out[0], out[1], data.rows, data.cols, data.vals)
-                    if prog.enabled
-                    else None
-                ),
+                rmse=seg_rmse,
                 events_per_s=nnz * seg / seg_wall if seg_wall > 0 else None,
                 segment_wall_s=seg_wall,
                 checkpoint_epoch=epochs,
             )
+            if tol > 0.0 and final_rmse is not None:
+                if prev_rmse is not None and abs(prev_rmse - final_rmse) < tol:
+                    logger.info(
+                        "ALS early stop at iteration %d/%d: RMSE plateau "
+                        "|%.6f - %.6f| < tol=%g",
+                        it, params.iterations, prev_rmse, final_rmse, tol,
+                    )
+                    break
+                prev_rmse = final_rmse
     jax.block_until_ready(out)
-    prog.done(params.iterations)
+    prog.done(it)
+    LAST_TRAIN_INFO.clear()
+    LAST_TRAIN_INFO.update(
+        iterations_run=it - start_iter,
+        early_stopped=it < params.iterations,
+        final_rmse=final_rmse,
+        warm_start=warm_start is not None,
+    )
     total = _time.perf_counter() - t0
     from predictionio_tpu.obs import metrics as obs_metrics
 
@@ -881,13 +1073,13 @@ def als_train(data: RatingsData, params: ALSParams, checkpoint_cfg=None):
         "Whole-run ALS training time",
         path="single",
     ).observe(total)
-    if params.iterations > start_iter:
+    if it > start_iter:
         # one fused fori_loop program — per-half-step is derived
         obs_metrics.histogram(
             "pio_als_halfstep_seconds",
             "Derived per-half-step time of the fused sharded ALS loop",
             mode="single",
-        ).observe(total / (2 * (params.iterations - start_iter)))
+        ).observe(total / (2 * (it - start_iter)))
     return out
 
 

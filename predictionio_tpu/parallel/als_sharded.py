@@ -443,6 +443,7 @@ def init_sharded_factors(
     axis: str = "data",
     row_layout: SideLayout | None = None,
     col_layout: SideLayout | None = None,
+    warm_start=None,
 ) -> ShardedALSState:
     shards = mesh.shape[axis]
     if row_layout is None:
@@ -456,12 +457,22 @@ def init_sharded_factors(
     # dummies) stay zero and contribute nothing to the psum'd Gramian
     U = np.zeros((row_layout.table_len, params.rank), np.float32)
     V = np.zeros((col_layout.table_len, params.rank), np.float32)
-    U[row_layout.positions] = np.asarray(
+    U_true = np.asarray(
         als_ops.init_factors(data.num_rows, params.rank, key_u)
     )
-    V[col_layout.positions] = np.asarray(
+    V_true = np.asarray(
         als_ops.init_factors(data.num_cols, params.rank, key_v)
     )
+    if warm_start is not None:
+        # warm factors ride in true row order (NaN rows keep the cold
+        # draw — same merge rule as single-chip als_train) and are
+        # re-permuted through the SideLayout with everything else
+        w_u = np.asarray(warm_start[0], dtype=np.float32)
+        w_v = np.asarray(warm_start[1], dtype=np.float32)
+        U_true = np.where(np.isnan(w_u), U_true, w_u)
+        V_true = np.where(np.isnan(w_v), V_true, w_v)
+    U[row_layout.positions] = U_true
+    V[col_layout.positions] = V_true
     sharding = factor_sharding(mesh, axis)
     # factors persist (and all_gather/ppermute) in storage_dtype: bf16
     # halves the per-half-iteration ICI traffic and the gathered working
@@ -734,6 +745,34 @@ def sharded_memory_estimate(
     }
 
 
+def prepare_sharded_pack(
+    data: als_ops.RatingsData,
+    params: als_ops.ALSParams,
+    shards: int,
+    mode: str = "auto",
+):
+    """Build the host-side sharded prep — resolved mode, both
+    :class:`SideLayout`\\ s, and both :class:`PackedSide`\\ s — WITHOUT
+    training. This is the scan+pack work :func:`sharded_als_train`
+    normally does inline; split out so the packed-prep cache
+    (core/prep_cache.py) can persist and restore it, handing the result
+    back via ``prepacked=``. Returns ``(mode, row_layout, col_layout,
+    row_ps, col_ps)``."""
+    if mode == "auto":
+        mode = choose_sharded_mode(data, params, shards)
+    elif mode not in ("gather", "ring"):
+        raise ValueError(f"mode must be auto|gather|ring, got {mode!r}")
+    row_layout = build_side_layout(data.rows, data.num_rows, shards)
+    col_layout = build_side_layout(data.cols, data.num_cols, shards)
+    row_ps = pack_sharded_side(
+        data.rows, data.cols, data.vals, row_layout, col_layout, shards, mode
+    )
+    col_ps = pack_sharded_side(
+        data.cols, data.rows, data.vals, col_layout, row_layout, shards, mode
+    )
+    return mode, row_layout, col_layout, row_ps, col_ps
+
+
 def sharded_als_train(
     data: als_ops.RatingsData,
     params: als_ops.ALSParams,
@@ -741,6 +780,10 @@ def sharded_als_train(
     axis: str = "data",
     mode: str = "auto",
     checkpoint_cfg=None,
+    warm_start=None,
+    tol: float = 0.0,
+    prepacked=None,
+    progress_extra: dict | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Full multi-chip ALS with mesh-resident factors.
 
@@ -766,18 +809,22 @@ def sharded_als_train(
             f"(e.g. --mesh {axis}=N) or pass axis="
         )
     shards = mesh.shape[axis]
-    if mode == "auto":
-        mode = choose_sharded_mode(data, params, shards)
-    elif mode not in ("gather", "ring"):
-        raise ValueError(f"mode must be auto|gather|ring, got {mode!r}")
-    row_layout = build_side_layout(data.rows, data.num_rows, shards)
-    col_layout = build_side_layout(data.cols, data.num_cols, shards)
-    state = init_sharded_factors(data, params, mesh, axis, row_layout, col_layout)
-    row_ps = pack_sharded_side(
-        data.rows, data.cols, data.vals, row_layout, col_layout, shards, mode
-    )
-    col_ps = pack_sharded_side(
-        data.cols, data.rows, data.vals, col_layout, row_layout, shards, mode
+    if tol > 0.0:
+        logger.warning(
+            "RMSE-plateau early stop (tol=%g) is unavailable on the "
+            "sharded trainer: mid-run tables are in SideLayout order, so "
+            "no per-segment RMSE exists to ride; running the configured "
+            "%d iterations", tol, params.iterations,
+        )
+    if prepacked is not None:
+        mode, row_layout, col_layout, row_ps, col_ps = prepacked
+    else:
+        mode, row_layout, col_layout, row_ps, col_ps = prepare_sharded_pack(
+            data, params, shards, mode
+        )
+    state = init_sharded_factors(
+        data, params, mesh, axis, row_layout, col_layout,
+        warm_start=warm_start,
     )
     if mode == "ring":
         _check_ring_layout(row_ps, col_ps, params, shards)
@@ -821,7 +868,8 @@ def sharded_als_train(
     # SideLayout (degree-balanced) order, so scoring them against the
     # original-order (rows, cols) pairs would be wrong
     prog = obs_progress.ProgressPublisher(
-        params.iterations, mesh=mesh_desc, trainer="sharded"
+        params.iterations, mesh=mesh_desc, trainer="sharded",
+        warm_start=warm_start is not None, **(progress_extra or {}),
     )
     # multi-host: every host runs this loop; one writer is enough
     prog.enabled = prog.enabled and jax.process_index() == 0
@@ -862,6 +910,13 @@ def sharded_als_train(
             )
     jax.block_until_ready((U, V))
     prog.done(params.iterations)
+    als_ops.LAST_TRAIN_INFO.clear()
+    als_ops.LAST_TRAIN_INFO.update(
+        iterations_run=params.iterations - start_iter,
+        early_stopped=False,
+        final_rmse=None,
+        warm_start=warm_start is not None,
+    )
     total = _time.perf_counter() - t0
     # the whole loop is ONE scan-fused jit program, so per-half-step
     # timing is derived: total / (2 * iterations). First-call totals
@@ -892,6 +947,10 @@ def train_for_context(
     ctx=None,
     sharded: bool = False,
     mode: str = "auto",
+    warm_start=None,
+    tol: float = 0.0,
+    prepacked=None,
+    progress_extra: dict | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Framework dispatch point: the engine-param ``shardedTrain`` knob.
 
@@ -905,7 +964,10 @@ def train_for_context(
     auto|gather|ring).
     """
     if not sharded or ctx is None:
-        return als_ops.als_train(data, params)
+        return als_ops.als_train(
+            data, params, warm_start=warm_start, tol=tol,
+            progress_extra=progress_extra,
+        )
     mesh = ctx.mesh
     # shard over "data" when present; a 1-D mesh shards over its only axis
     if "data" in mesh.shape:
@@ -917,7 +979,10 @@ def train_for_context(
             f"shardedTrain needs a 'data' axis on the mesh; got axes "
             f"{tuple(mesh.axis_names)}"
         )
-    U, V = sharded_als_train(data, params, mesh, axis, mode=mode)
+    U, V = sharded_als_train(
+        data, params, mesh, axis, mode=mode, warm_start=warm_start,
+        tol=tol, prepacked=prepacked, progress_extra=progress_extra,
+    )
     if jax.process_count() > 1:
         # multi-host: shards live on other hosts' devices; templates
         # np.asarray the factors for persistence, so gather them to

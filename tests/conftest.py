@@ -28,6 +28,15 @@ import pytest  # noqa: E402
 from predictionio_tpu.data.storage import set_storage, test_storage  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _prep_cache_dir(tmp_path_factory):
+    """Keep packed-prep cache writes out of ~/.pio_tpu during tests.
+    setdefault so an explicit operator/test override still wins."""
+    os.environ.setdefault(
+        "PIO_PREP_CACHE_DIR", str(tmp_path_factory.mktemp("prep_cache"))
+    )
+
+
 @pytest.fixture()
 def storage():
     """Fresh in-memory storage installed as the process singleton."""

@@ -99,6 +99,35 @@ def test_density_smoke_gate():
 
 
 @pytest.mark.slow
+def test_retrain_smoke_gate():
+    """Hot retrain: exit 0 means the prep-cache probe spliced (not a
+    silent rebuild), hot scan+pack beat the cold one >= 5x, the warm
+    start early-stopped strictly below the cold iteration count, and the
+    hot model matched the cold one's RMSE and top-k ranking.
+
+    slow: trains six ALS runs and gates on wall-clock ratios — bench
+    lane like the other scenario smokes."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "retrain", "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(BENCH.parent),
+    )
+    assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-2000:])
+    lines = proc.stdout.strip().splitlines()
+    summary = json.loads(lines[-2])  # full-detail line; compact is last
+    block = summary["retrain"]
+    assert block["ok"] is True
+    assert block["hot_prep_status"] == "splice"
+    assert block["hot_prep_speedup"] >= 5.0
+    assert block["hot_cold_wall_ratio"] <= 0.6
+    assert block["warm_iterations_saved"] > 0
+    assert block["hot_warm_start"] is True
+    assert block["rmse_hot"] <= block["rmse_cold"] + 1e-3
+
+
+@pytest.mark.slow
 def test_routing_smoke_gate():
     """Scale-out router tier: exit 0 means aggregate qps scaled >= 3x
     from one replica to four, a kill -9'd replica was restarted and
@@ -205,6 +234,34 @@ class TestBenchCompare:
             "chaos_failed_requests") == "lower"
         assert bench_compare.leaf_direction("replicas") is None
         assert bench_compare.leaf_direction("hedges") is None
+        # hot-retrain leaves: prep speedup and iterations-saved up,
+        # walls and the hot/cold wall ratio down; raw iteration counts
+        # are config-scale volume, not quality
+        assert bench_compare.leaf_direction("hot_prep_speedup") == "higher"
+        assert bench_compare.leaf_direction(
+            "warm_iterations_saved") == "higher"
+        assert bench_compare.leaf_direction("hot_retrain_wall_s") == "lower"
+        assert bench_compare.leaf_direction("cold_retrain_wall_s") == "lower"
+        assert bench_compare.leaf_direction("hot_cold_wall_ratio") == "lower"
+        assert bench_compare.leaf_direction("hot_prep_s") == "lower"
+        assert bench_compare.leaf_direction("hot_iterations") is None
+        assert bench_compare.leaf_direction("cold_iterations") is None
+
+    def test_retrain_regression_flagged(self):
+        old = {"retrain": {
+            "hot_prep_speedup": 8.0, "hot_cold_wall_ratio": 0.1,
+            "warm_iterations_saved": 8, "hot_iterations": 2,
+        }}
+        new = {"retrain": {
+            "hot_prep_speedup": 3.0, "hot_cold_wall_ratio": 0.7,
+            "warm_iterations_saved": 0, "hot_iterations": 10,
+        }}
+        report = bench_compare.compare(old, new)
+        paths = [r["path"] for r in report["regressions"]]
+        assert "retrain.hot_prep_speedup" in paths
+        assert "retrain.hot_cold_wall_ratio" in paths
+        assert "retrain.warm_iterations_saved" in paths
+        assert "retrain.hot_iterations" not in paths  # config/volume
 
     def test_columnar_tail_regression_flagged(self):
         old = {"realtime": {"tail_columnar": {

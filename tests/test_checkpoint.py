@@ -255,11 +255,20 @@ class TestTrainCLIPlumbing:
                 "--checkpoint-dir", str(tmp_path),
             ]
         )
-        with pytest.raises(SystemExit):
-            args.fn(args)
         import os
 
-        assert os.environ["PIO_CHECKPOINT_EVERY"] == "5"
-        assert os.environ["PIO_RESUME"] == "1"
-        assert os.environ["PIO_CHECKPOINT_DIR"] == str(tmp_path)
-        assert captured == {}
+        try:
+            with pytest.raises(SystemExit):
+                args.fn(args)
+
+            assert os.environ["PIO_CHECKPOINT_EVERY"] == "5"
+            assert os.environ["PIO_RESUME"] == "1"
+            assert os.environ["PIO_CHECKPOINT_DIR"] == str(tmp_path)
+            assert captured == {}
+        finally:
+            # the CLI wrote these into os.environ directly; monkeypatch's
+            # delenv of an absent key records nothing, so without this the
+            # vars leak into every later als_train (ckpt.from_env) in the
+            # suite
+            for k in ("PIO_CHECKPOINT_EVERY", "PIO_RESUME", "PIO_CHECKPOINT_DIR"):
+                os.environ.pop(k, None)
