@@ -4043,6 +4043,124 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
         worst_p99 = max((r["p99_ms"] for r in serving_rounds), default=None)
         total_q = sum(r["total_queries"] for r in serving_rounds)
 
+        # retrain-scheduler drill (ISSUE 20): burn the freshness SLO
+        # with stale commit observations, hand the REAL RetrainScheduler
+        # the real SLO registry, and watch the control loop close —
+        # the interval halves toward the floor, a warm retrain fires
+        # through the injected spawn (the same _train(warm=True) hot
+        # path) plus a real POST /reload, and once the post-retrain
+        # commits dilute the window the state recovers and forced idle
+        # ticks exercise the watermark-unmoved skip. Serving load stays
+        # on throughout; _load_gen asserts every status is 200, so it IS
+        # the zero-failed-requests gate. Runs after the freshness-p99 /
+        # SLO-state snapshots above so the injected staleness judges
+        # only the drill, not the scenario's own budgets.
+        from predictionio_tpu.server.supervisor import RetrainScheduler
+
+        _, _, f_n_now = obs_freshness.HISTOGRAM.merged()
+        n_bad = max(120, int(0.10 * f_n_now))
+        drill_errors: list = []
+        stop_drill_load = threading.Event()
+
+        def _drill_serve():
+            while not stop_drill_load.is_set():
+                try:
+                    _load_gen("127.0.0.1", eport, "/queries.json", bodies,
+                              8, 5, n_procs=2)
+                except Exception as e:
+                    drill_errors.append(f"{type(e).__name__}: {e}")
+                    return
+
+        class _DrillTrain:
+            """Popen-shaped in-process warm retrain (the drill's
+            injected spawn)."""
+
+            def __init__(self):
+                self.rc: int | None = None
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                try:
+                    _train(warm=True)
+                    self.rc = 0
+                except Exception:
+                    self.rc = 1
+
+            def poll(self):
+                return self.rc
+
+        def _drill_reload() -> int:
+            try:
+                _post_json(
+                    f"http://127.0.0.1:{eport}/reload", {}, timeout=60
+                )
+                return 1
+            except Exception:
+                return 0
+
+        def _fresh_state():
+            doc = obs_slo.REGISTRY.evaluate_all()
+            return {d["name"]: d["state"] for d in doc["slos"]}.get(
+                "serving.freshness"
+            )
+
+        sched = RetrainScheduler(
+            2.5, train_argv=["train"], slo_driven=True, floor_s=0.3,
+            spawn=_DrillTrain,
+            fetch_slo=lambda: obs_slo.REGISTRY.evaluate_all(),
+            fetch_stats=lambda: {"realtime": {
+                "events_folded": layer.events_folded,
+                "events_behind": layer.tailer.events_behind() or 0,
+            }},
+            post_reload=_drill_reload,
+        )
+        obs_freshness.observe_commit(
+            [time.time() - 4.0 * freshness_budget_s] * n_bad, "patch"
+        )
+        burn_state = _fresh_state()
+        drill_load_t = threading.Thread(target=_drill_serve, daemon=True)
+        drill_load_t.start()
+        interval_min = sched.interval_s
+        flooded = False
+        end_state = burn_state
+        deadline = time.time() + (35 if smoke else 60)
+        while time.time() < deadline:
+            sched.tick()
+            interval_min = min(interval_min, sched.interval_s)
+            if not flooded and sched.runs >= 1:
+                # the retrain + reload made the ingested backlog
+                # servable: the commits the window sees now are fresh
+                obs_freshness.observe_commit(
+                    [time.time() - 0.05] * (15 * n_bad), "reload"
+                )
+                flooded = True
+            if flooded and sched._proc is None:
+                end_state = _fresh_state()
+                if end_state == "ok":
+                    break
+            time.sleep(0.05)
+        # idle ticks after recovery: the ok state decays the interval
+        # back toward base and the unmoved watermark skips the retrain
+        for _ in range(3):
+            sched._next_slo_check = 0.0
+            sched.tick()
+        stop_drill_load.set()
+        drill_load_t.join(timeout=120)
+        drill_block = {
+            "burn_state": burn_state,
+            "end_state": end_state,
+            "base_interval_s": sched.base_interval_s,
+            "interval_min_s": interval_min,
+            "interval_end_s": sched.interval_s,
+            "fired": sched.runs,
+            "skips": sched.skips,
+            "failures": sched.failures,
+            "stale_observations": n_bad,
+            "failed_requests": len(drill_errors),
+            "errors": drill_errors,
+            "doc": sched.doc(),
+        }
+
         block = {
             "smoke": smoke,
             "run_s": round(run_s, 2),
@@ -4096,6 +4214,7 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
             "chaos": {"plan": chaos, "fired": fire_counts},
             "slo": {"states": slo_states, "alerts": alerts},
             "incidents": incident_block,
+            "retrain_scheduler": drill_block,
             "ok": False,
         }
         result["production_stack"] = block
@@ -4146,6 +4265,27 @@ def bench_production_stack(result: dict, smoke: bool = False) -> None:
         )
         assert incident_block["validated"], (
             f"incident bundle incomplete: {incident_block}"
+        )
+        assert drill_block["burn_state"] in ("burning", "violated"), (
+            f"stale commits never burned the freshness SLO: {drill_block}"
+        )
+        assert drill_block["fired"] >= 1, (
+            f"scheduler never fired under SLO burn: {drill_block}"
+        )
+        assert drill_block["failures"] == 0, (
+            f"scheduled retrain failed: {drill_block}"
+        )
+        assert drill_block["interval_min_s"] < drill_block["base_interval_s"], (
+            f"burning SLO never tightened the cadence: {drill_block}"
+        )
+        assert drill_block["end_state"] == "ok", (
+            f"freshness never recovered after the retrain: {drill_block}"
+        )
+        assert drill_block["skips"] >= 1, (
+            f"unmoved watermark never skipped a tick: {drill_block}"
+        )
+        assert drill_block["failed_requests"] == 0, (
+            f"serving dropped requests during the drill: {drill_errors}"
         )
         block["ok"] = True
     finally:
@@ -5199,8 +5339,233 @@ def bench_retrain(result: dict, smoke: bool = False) -> None:
         # worse than seed-to-seed variation", not identity
         "topk_parity": out["topk_overlap"] >= 0.35,
     }
+
+    # ---- sharded rung: layout-stable warm retrain on the virtual
+    # 8-device mesh, in a child that owns the device count (XLA_FLAGS
+    # must be set before jax initializes) and whose jit counters span
+    # both the cold and the warm solve
+    try:
+        import subprocess
+        import sys as _sys
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        proc = subprocess.run(
+            [_sys.executable, os.path.abspath(__file__),
+             "--retrain-sharded-child"] + (["--smoke"] if smoke else []),
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"sharded retrain child failed: "
+                f"{proc.stderr.strip()[-400:]}"
+            )
+        out["sharded"] = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        out["sharded"] = {"error": f"{type(e).__name__}: {e}", "ok": False}
+    gates["sharded_ok"] = out["sharded"].get("ok") is True
+
     out["gates"] = gates
     out["ok"] = all(gates.values())
+
+
+def retrain_sharded_child() -> None:
+    """``bench.py --retrain-sharded-child [--smoke]``: the
+    zero-recompile warm sharded retrain rung (ISSUE 20). Seed-trains the
+    sharded engine on the virtual 8-device mesh (publishing the
+    stable-shape packed prep), appends a small delta, runs the cold
+    fresh-layout baseline and then the warm retrain, and asserts the
+    warm solve re-entered the SAME compiled fused trainer: sharded jit
+    compiles added == 0, the cached SideLayout was reused (counter), hot
+    wall <= 0.6x cold, and the spliced-pack solve matches a fresh-layout
+    solve to 1e-6. Prints one JSON doc."""
+    import sys as _sys
+
+    from predictionio_tpu.core import prep_cache
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data import store as pio_store
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import App, Storage, set_storage
+    from predictionio_tpu.models import recommendation
+    from predictionio_tpu.obs import metrics as obs_metrics
+    from predictionio_tpu.ops import als as als_ops
+    from predictionio_tpu.parallel import als_sharded
+
+    smoke = "--smoke" in _sys.argv
+    if smoke:
+        n_seed, n_users, n_items = 60_000, 1_500, 400
+        rank, iterations, tol = 8, 6, 3e-3
+    else:
+        n_seed, n_users, n_items = 400_000, 8_000, 1_000
+        rank, iterations, tol = 16, 8, 2e-3
+    n_delta = max(200, n_seed // 100)
+    n_new_users = max(2, n_users // 100)  # ~1% new rows, under the 5% frac
+
+    tmp = tempfile.mkdtemp(prefix="pio_bench_retrain_sharded_")
+    os.environ["PIO_PREP_CACHE_DIR"] = os.path.join(tmp, "prep")
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_DB_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_LOG_TYPE": "jsonl",
+        "PIO_STORAGE_SOURCES_LOG_PATH": tmp,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+    })
+    set_storage(storage)
+    apps = storage.get_metadata_apps()
+    events = storage.get_events()
+    app_id = apps.insert(App(0, "RetrainSharded"))
+    events.init(app_id)
+    rng = np.random.default_rng(SEED)
+
+    def _put(users, items, ratings):
+        for s in range(0, len(users), 100_000):
+            sl = slice(s, s + 100_000)
+            events.batch_insert(
+                [
+                    Event(
+                        event="rate", entity_type="user",
+                        entity_id=f"u{u}", target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties={"rating": float(r)},
+                    )
+                    for u, i, r in zip(users[sl], items[sl], ratings[sl])
+                ],
+                app_id,
+            )
+
+    _put(rng.integers(0, n_users, n_seed), rng.integers(0, n_items, n_seed),
+         rng.integers(1, 6, n_seed))
+    engine = recommendation.engine()
+    variant = {
+        "id": "retrain-sharded",
+        "engineFactory": "predictionio_tpu.models.recommendation.engine",
+        "datasource": {"params": {"app_name": "RetrainSharded"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": rank, "num_iterations": iterations,
+            "sharded_train": True}}],
+    }
+    engine_params = engine.params_from_variant(variant)
+    filters = dict(
+        event_names=["rate", "buy"], entity_type="user",
+        target_entity_type="item", rating_key="rating",
+        default_ratings=None, override_ratings={"buy": 4.0},
+    )
+
+    def _train(engine_id, warm=False, tol_v=0.0):
+        if tol_v > 0:
+            os.environ["PIO_TOL"] = str(tol_v)
+        try:
+            t0 = time.perf_counter()
+            run_train(
+                engine, engine_params, engine_id=engine_id,
+                engine_factory=variant["engineFactory"],
+                workflow_params=WorkflowParams(
+                    batch="bench",
+                    runtime_conf={"warm_start": True} if warm else {},
+                ),
+                storage=storage,
+            )
+            return time.perf_counter() - t0
+        finally:
+            os.environ.pop("PIO_TOL", None)
+
+    def _c(name, **labels):
+        return float(obs_metrics.counter(name, "", **labels).value())
+
+    def _sharded_compiles():
+        return sum(
+            _c("pio_jit_compiles_total", fn=f"sharded.train.{m}")
+            for m in ("gather", "ring")
+        )
+
+    out: dict = {"n_seed": n_seed, "n_delta": n_delta,
+                 "n_new_users": n_new_users, "shards": 8, "rank": rank}
+
+    # ---- seed train: compiles the enveloped fused trainer, publishes
+    # the stable-shape sharded pack
+    out["seed_wall_s"] = round(_train("retrain-sharded"), 3)
+    out["seed_compiles"] = _sharded_compiles()
+
+    # ---- small appended delta: ~1% new entries, ~1% brand-new users
+    du = np.concatenate([
+        rng.integers(0, n_users, n_delta - n_new_users),
+        n_users + np.arange(n_new_users),
+    ])
+    _put(du, rng.integers(0, n_items, len(du)), rng.integers(1, 6, len(du)))
+
+    # ---- cold baseline: fresh scan, fresh layout, fresh compile
+    os.environ["PIO_PREP_CACHE"] = "0"
+    try:
+        cold_wall = _train("retrain-sharded-cold")
+    finally:
+        os.environ.pop("PIO_PREP_CACHE", None)
+    out["cold_retrain_wall_s"] = round(cold_wall, 3)
+
+    # ---- warm retrain: splice probe -> layout reuse -> same program
+    compiles0 = _sharded_compiles()
+    splices0 = _c("pio_prep_cache_splices_total")
+    reuse0 = _c("pio_prep_cache_layout_reuse_total")
+    drift0 = _c("pio_prep_cache_rebuilds_total", reason="layout_drift")
+    hot_wall = _train("retrain-sharded", warm=True, tol_v=tol)
+    out["hot_retrain_wall_s"] = round(hot_wall, 3)
+    out["compiles_added"] = _sharded_compiles() - compiles0
+    out["spliced"] = _c("pio_prep_cache_splices_total") - splices0
+    out["layout_reuse"] = _c("pio_prep_cache_layout_reuse_total") - reuse0
+    out["layout_rebuilds"] = (
+        _c("pio_prep_cache_rebuilds_total", reason="layout_drift") - drift0
+    )
+    out["hot_cold_wall_ratio"] = round(hot_wall / max(cold_wall, 1e-9), 3)
+
+    # ---- factor parity: the spliced pack must solve to the same
+    # factors as a fresh-layout pack of the identical post-delta data
+    # (same seed, cold init, no tol) — both come back in original row
+    # order, so the comparison is layout-independent
+    os.environ["PIO_PREP_CACHE"] = "0"
+    try:
+        batch = pio_store.find_ratings("RetrainSharded", storage=storage,
+                                       **filters)
+    finally:
+        os.environ.pop("PIO_PREP_CACHE", None)
+    data = als_ops.build_ratings_data(
+        batch.rows, batch.cols, batch.vals,
+        len(batch.entity_ids), len(batch.target_ids),
+    )
+    params = als_ops.ALSParams(rank=rank, iterations=3)
+    handle = prep_cache.probe("RetrainSharded", storage=storage, **filters)
+    out["parity_probe_status"] = handle.status  # exact hit post-republish
+    spliced_pack = handle.sharded_pack(params, 8, "auto")
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    fresh_pack = als_sharded.prepare_sharded_pack(data, params, 8, "auto")
+    pU, pV = (np.asarray(a) for a in als_sharded.sharded_als_train(
+        data, params, mesh, mode="auto", prepacked=spliced_pack))
+    fU, fV = (np.asarray(a) for a in als_sharded.sharded_als_train(
+        data, params, mesh, mode="auto", prepacked=fresh_pack))
+    out["factor_parity"] = float(max(
+        np.abs(pU - fU).max(), np.abs(pV - fV).max()
+    ))
+
+    gates = {
+        "zero_compiles_added": out["compiles_added"] == 0,
+        "spliced": out["spliced"] >= 1,
+        "layout_reused": out["layout_reuse"] >= 1,
+        "no_layout_drift": out["layout_rebuilds"] == 0,
+        "wall_ratio_0p6": out["hot_cold_wall_ratio"] <= 0.6,
+        "parity_1e6": (
+            spliced_pack is not None and out["factor_parity"] <= 1e-6
+        ),
+    }
+    out["gates"] = gates
+    out["ok"] = all(gates.values())
+    print(json.dumps(out))
 
 
 def retrain_main(smoke: bool) -> None:
@@ -5519,6 +5884,12 @@ def main() -> None:
         return
     if "retrieval" in sys.argv:
         retrieval_main(smoke="--smoke" in sys.argv)
+        return
+    if "--retrain-sharded-child" in sys.argv:
+        from predictionio_tpu.utils import apply_platform_env
+
+        apply_platform_env()
+        retrain_sharded_child()
         return
     if "retrain" in sys.argv:
         retrain_main(smoke="--smoke" in sys.argv)

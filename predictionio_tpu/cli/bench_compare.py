@@ -27,11 +27,12 @@ _LOWER_BETTER = (
     "_ms", "_s", "_us", "_ns", "_seconds", "p50", "p99", "p90",
     "latency", "behind", "rss", "overhead", "cost", "lost", "rmse",
     "compiles", "_pct", "failed", "restarts", "retries", "ejections",
-    "wall_ratio",
+    "wall_ratio", "rebuilds", "failures", "evictions",
 )
 _HIGHER_BETTER = (
     "per_s", "qps", "speedup", "events", "throughput", "hit_rate",
     "ratio_ok", "recall", "win_ratio", "scaling_ratio", "saved",
+    "reuse",
 )
 # keys that are config/identity, not measurements
 _SKIP = (

@@ -238,6 +238,27 @@ def _supervisor_lines() -> list[str]:
             f"supervisor[{name}]: {s.get('state', '?')} "
             f"({', '.join(parts)}){stale}"
         )
+    rt = doc.get("retrain")
+    if isinstance(rt, dict):
+        parts = [
+            f"every {rt.get('interval_s')}s"
+            + (" (slo)" if rt.get("slo_driven") else ""),
+            f"runs {rt.get('runs', 0)}",
+            f"skips {rt.get('skips', 0)}",
+            f"failures {rt.get('failures', 0)}",
+        ]
+        last = rt.get("last_run") or {}
+        if last:
+            parts.append(
+                "last ok" if last.get("ok") else
+                f"last failed ({last.get('exit')})"
+            )
+        if rt.get("next_in_s") is not None:
+            parts.append(f"next in {rt['next_in_s']}s")
+        lines.append(
+            f"supervisor[retrain]: {rt.get('state', '?')} "
+            f"({', '.join(parts)}){stale}"
+        )
     return lines
 
 
@@ -255,9 +276,12 @@ def _training_line() -> str | None:
     doc = _training_progress()
     if doc is None:
         return None
-    parts = [f"iter {doc.get('iteration')}/{doc.get('total_iterations')}"]
+    # under --tol the iteration count is an upper bound (the solve may
+    # plateau out early), so render "iter 7/<=20, ETA <=41s"
+    bound = "<=" if doc.get("eta_is_bound") else ""
+    parts = [f"iter {doc.get('iteration')}/{bound}{doc.get('total_iterations')}"]
     if doc.get("eta_s") is not None:
-        parts.append(f"ETA {round(doc['eta_s'])}s")
+        parts.append(f"ETA {bound}{round(doc['eta_s'])}s")
     rmse = doc.get("rmse")
     if rmse:
         parts.append(f"RMSE {rmse[-1]:.4f}")
@@ -1354,6 +1378,68 @@ def cmd_run(args) -> int:
     return int(result) if isinstance(result, int) else 0
 
 
+def cmd_cache(args) -> int:
+    """``pio cache list|evict|prune``: packed-prep cache lifecycle
+    (core/prep_cache.py). Entries are derived data — evicting one only
+    costs the next train a full scan+pack."""
+    from predictionio_tpu.core import prep_cache
+
+    verb = getattr(args, "cache_verb", None) or "list"
+    if verb == "list":
+        entries = prep_cache.cache_entries(detail=True)
+        total = sum(e["bytes"] for e in entries)
+        cap = prep_cache.max_bytes()
+        if getattr(args, "json", False):
+            print(json.dumps({
+                "dir": str(prep_cache.cache_dir()),
+                "total_bytes": total,
+                "max_bytes": cap,
+                "entries": entries,
+            }, indent=2))
+            return 0
+        print(f"Prep cache: {prep_cache.cache_dir()}")
+        if not entries:
+            print("  (empty)")
+            return 0
+        for e in entries:
+            packs = []
+            if e.get("single_pack"):
+                packs.append("single")
+            if e.get("sharded_pack"):
+                packs.append("sharded")
+            age = time.time() - e["atime"]
+            print(
+                f"  {e['name']}: {e['bytes'] / 1e6:.1f} MB, "
+                f"{e.get('n', 0):,} events, "
+                f"packs [{', '.join(packs) or 'none'}], "
+                f"last used {age:.0f}s ago"
+            )
+        cap_s = f" / cap {cap / 1e6:.1f} MB" if cap else ""
+        print(f"  total {total / 1e6:.1f} MB{cap_s}")
+        return 0
+    if verb == "evict":
+        if prep_cache.evict(args.entry):
+            print(f"evicted {args.entry}")
+            return 0
+        print(f"cache: no such entry {args.entry!r}", file=sys.stderr)
+        return 1
+    if verb == "prune":
+        limit = None
+        if getattr(args, "max_mb", None) is not None:
+            limit = int(float(args.max_mb) * 1024 * 1024)
+        out = prep_cache.prune(limit=limit)
+        if getattr(args, "json", False):
+            print(json.dumps(out, indent=2))
+        else:
+            print(
+                f"pruned: {len(out['husks'])} husk(s), "
+                f"{len(out['evicted'])} entry(ies) evicted"
+            )
+        return 0
+    print(f"cache: unknown verb {verb!r}", file=sys.stderr)
+    return 1
+
+
 def cmd_start_all(args) -> int:
     """Bring up the service fleet as detached daemons (reference
     bin/pio-start-all; see cli/daemon.py for the process model).
@@ -1450,6 +1536,59 @@ def cmd_start_all(args) -> int:
     return 0
 
 
+def _parse_duration(value: str) -> float:
+    """``300`` / ``300s`` / ``15m`` / ``1h`` -> seconds."""
+    s = str(value).strip().lower()
+    mult = 1.0
+    if s.endswith(("s", "m", "h")):
+        mult = {"s": 1.0, "m": 60.0, "h": 3600.0}[s[-1]]
+        s = s[:-1]
+    try:
+        out = float(s) * mult
+    except ValueError:
+        raise ValueError(f"bad duration {value!r} (want e.g. 300s, 15m, 1h)")
+    if out <= 0:
+        raise ValueError(f"duration must be positive, got {value!r}")
+    return out
+
+
+def _retrain_scheduler(args, plan, host):
+    """Build the RetrainScheduler for ``--retrain-every``, or None."""
+    from predictionio_tpu.server import supervisor as sup_mod
+
+    raw = getattr(args, "retrain_every", None)
+    if not raw:
+        return None
+    interval = _parse_duration(raw)
+    engine_ports = [
+        port for name, _argv, port in plan
+        if name == "engine" or name.startswith("engine-")
+    ]
+    if not engine_ports:
+        raise ValueError(
+            "--retrain-every needs a deployed engine "
+            "(--variant/--engine-factory/--engine-dir)"
+        )
+    train_argv = ["train", "--warm-start"]
+    if args.variant:
+        train_argv += ["--variant", os.path.abspath(args.variant)]
+    if args.engine_factory:
+        train_argv += ["--engine-factory", args.engine_factory]
+    if args.engine_dir:
+        train_argv += ["--engine-dir", os.path.abspath(args.engine_dir)]
+    if getattr(args, "retrain_tol", None):
+        train_argv += ["--tol", str(args.retrain_tol)]
+    floor = getattr(args, "retrain_floor", None)
+    return sup_mod.RetrainScheduler(
+        interval,
+        train_argv=train_argv,
+        engine_ports=engine_ports,
+        host=host,
+        slo_driven=bool(getattr(args, "retrain_slo", False)),
+        floor_s=_parse_duration(floor) if floor else None,
+    )
+
+
 def _run_supervised(args, plan) -> int:
     """``pio start-all --supervise`` / ``pio supervise``: run the fleet
     under the self-healing supervisor in the FOREGROUND (the supervisor
@@ -1466,7 +1605,12 @@ def _run_supervised(args, plan) -> int:
         sup_mod.ServiceSpec(name=name, argv=argv, host=host, port=port)
         for name, argv, port in plan
     ]
-    sup = sup_mod.Supervisor(specs)
+    try:
+        retrain = _retrain_scheduler(args, plan, host)
+    except ValueError as e:
+        print(f"supervise: {e}", file=sys.stderr)
+        return 1
+    sup = sup_mod.Supervisor(specs, retrain=retrain)
 
     def _request_stop(signum, _frame):
         sup.request_stop()
@@ -1491,6 +1635,12 @@ def _run_supervised(args, plan) -> int:
     for name, doc in sup.services().items():
         print(
             f"{name}: {doc['state']} on port {doc['port']} (pid {doc['pid']})"
+        )
+    if retrain is not None:
+        mode = "SLO-adaptive" if retrain.slo_driven else "fixed"
+        print(
+            f"retrain: every {retrain.base_interval_s:.0f}s ({mode}) -> "
+            f"{len(retrain.engine_ports)} engine(s)"
         )
     print(f"Run dir: {daemon.run_dir()} (supervised; ^C or SIGTERM to stop)")
     try:
@@ -2024,6 +2174,27 @@ def build_parser() -> argparse.ArgumentParser:
             "--router-port", type=int, default=8100,
             help="router-tier port used with --replicas",
         )
+        parser.add_argument(
+            "--retrain-every", metavar="DUR", default=None,
+            help="with --supervise: run a warm `pio train` + engine "
+            "/reload on this cadence (e.g. 300s, 15m, 1h; see "
+            "docs/operations.md \"Continuous retraining\")",
+        )
+        parser.add_argument(
+            "--retrain-slo", action="store_true",
+            help="adapt the retrain cadence to the serving.freshness "
+            "SLO burn rate (halve while burning, decay back when ok)",
+        )
+        parser.add_argument(
+            "--retrain-floor", metavar="DUR", default=None,
+            help="shortest adaptive retrain interval "
+            "(default: --retrain-every / 8)",
+        )
+        parser.add_argument(
+            "--retrain-tol", type=float, default=None, metavar="T",
+            help="pass --tol T to the scheduled warm trains "
+            "(early-stop on an RMSE plateau)",
+        )
 
     sa = sub.add_parser("start-all")
     _fleet_args(sa)
@@ -2050,6 +2221,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds to wait for the replacement's /readyz (default 90)",
     )
     rr.set_defaults(fn=cmd_rolling_restart)
+
+    ca = sub.add_parser(
+        "cache", help="packed-prep cache lifecycle (list / evict / prune)"
+    )
+    casub = ca.add_subparsers(dest="cache_verb")
+    cl = casub.add_parser("list", help="entries, LRU order, sizes")
+    cl.add_argument("--json", action="store_true")
+    ce = casub.add_parser("evict", help="drop one entry by name")
+    ce.add_argument("entry", help="entry name from `pio cache list`")
+    cp = casub.add_parser(
+        "prune", help="sweep tmp husks + enforce the size budget"
+    )
+    cp.add_argument(
+        "--max-mb", type=float, default=None,
+        help="override PIO_PREP_CACHE_MAX_MB for this prune",
+    )
+    cp.add_argument("--json", action="store_true")
+    ca.set_defaults(fn=cmd_cache)
 
     sub.add_parser("stop-all").set_defaults(fn=cmd_stop_all)
 

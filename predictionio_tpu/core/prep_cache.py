@@ -91,6 +91,29 @@ def cache_dir() -> Path:
     return Path(d) if d else _DEFAULT_DIR
 
 
+def layout_reuse_frac() -> float:
+    """``PIO_LAYOUT_REUSE_FRAC``: largest delta (new rows on a side, or
+    new entries overall) relative to the cached size for which a warm
+    sharded retrain reuses the cached SideLayout verbatim. Past it the
+    layout is rebuilt fresh (counted ``reason=layout_drift``)."""
+    try:
+        return float(os.environ.get("PIO_LAYOUT_REUSE_FRAC", "") or 0.05)
+    except ValueError:
+        return 0.05
+
+
+def max_bytes() -> int | None:
+    """``PIO_PREP_CACHE_MAX_MB`` size cap in bytes, or None (unbounded)."""
+    raw = os.environ.get("PIO_PREP_CACHE_MAX_MB", "").strip()
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        return None
+    return int(mb * 1024 * 1024) if mb > 0 else None
+
+
 def _counter(name: str, help_: str, **labels):
     from predictionio_tpu.obs import metrics as obs_metrics
 
@@ -444,6 +467,7 @@ class _Splice:
     surgical: bool          # id codes stable -> bucket-level splice valid
     delta_rows: np.ndarray  # row codes of just the delta entries
     delta_cols: np.ndarray
+    delta_vals: np.ndarray  # ratings of just the delta entries
     files: list[dict]       # updated segment records
     token: object
     eid_hash: np.ndarray
@@ -505,10 +529,17 @@ class PrepHandle:
         return None
 
     def sharded_pack(self, params, shards: int, mode: str):
-        """The cached sharded pack (exact hits only: layouts derive from
-        global degrees, which any splice changes)."""
+        """The cached sharded pack. Exact hits return it verbatim; a
+        surgical splice whose delta stays under ``layout_reuse_frac`` of
+        the cached sizes keeps the cached :class:`SideLayout` — new ids
+        append least-loaded into free envelope slots and the packed
+        ``[S,B,K]`` tables are extended in place — so factor placement
+        AND the packed shapes survive the retrain and the compiled fused
+        trainer is re-entered with zero new compiles. Past the threshold
+        (or when the envelope has no room) falls back to a fresh layout,
+        counted ``reason=layout_drift``."""
         entry = self.entry
-        if self.status != "hit" or entry is None:
+        if self.status not in ("hit", "splice") or entry is None:
             return None
         pack = entry.header.get("sharded_pack")
         if pack is None or pack["key"] != sharded_pack_key(
@@ -516,10 +547,74 @@ class PrepHandle:
         ):
             return None
         try:
-            return entry.sharded()
+            cached = entry.sharded()
         except Exception as e:
             logger.warning("prep cache sharded pack unreadable: %s", e)
             return None
+        if self.status == "hit":
+            return cached
+        t0 = time.perf_counter()
+        spliced = self._splice_sharded(cached, params, shards)
+        if spliced is None:
+            _rebuild("layout_drift")
+            return None
+        _observe_stage("sharded_splice", time.perf_counter() - t0)
+        _counter(
+            "pio_prep_cache_layout_reuse_total",
+            "Warm sharded retrains that reused the cached SideLayout",
+        ).inc()
+        return spliced
+
+    def _splice_sharded(self, cached, params, shards: int):
+        """Extend the cached layouts+packs by the splice delta, or None
+        when the delta is too large / doesn't fit the shape envelope."""
+        from predictionio_tpu.parallel import als_sharded
+
+        sp = self.splice
+        if sp is None or not sp.surgical:
+            return None
+        mode, row_layout, col_layout, row_ps, col_ps = cached
+        if row_layout.shards != shards:
+            return None
+        b = sp.batch
+        old_u = len(row_layout.assign)
+        old_i = len(col_layout.assign)
+        n_users = len(b.entity_ids)
+        n_items = len(b.target_ids)
+        nd = len(sp.delta_rows)
+        frac = layout_reuse_frac()
+        if (n_users - old_u > max(1, int(frac * old_u))
+                or n_items - old_i > max(1, int(frac * old_i))
+                or nd > max(1, int(frac * max(1, self.entry.n)))):
+            return None
+        if nd == 0 and n_users == old_u and n_items == old_i:
+            return cached
+        rl = als_sharded.extend_side_layout(
+            row_layout, n_users, sp.delta_rows,
+            shard_loads=row_ps.mask.reshape(shards, -1).sum(axis=1),
+        )
+        cl = als_sharded.extend_side_layout(
+            col_layout, n_items, sp.delta_cols,
+            shard_loads=col_ps.mask.reshape(shards, -1).sum(axis=1),
+        )
+        if rl is None or cl is None:
+            return None
+        rp = als_sharded.splice_packed_side(
+            row_ps, rl, cl, sp.delta_rows, sp.delta_cols, sp.delta_vals
+        )
+        if rp is None:
+            return None
+        cp = als_sharded.splice_packed_side(
+            col_ps, cl, rl, sp.delta_cols, sp.delta_rows, sp.delta_vals
+        )
+        if cp is None:
+            return None
+        if mode == "ring":
+            try:
+                als_sharded._check_ring_layout(rp, cp, params, shards)
+            except ValueError:
+                return None
+        return mode, rl, cl, rp, cp
 
     # -- publish ----------------------------------------------------------
 
@@ -694,7 +789,13 @@ class PrepHandle:
                 arrays.update(arrs)
             header["sharded_pack"] = sh_meta
 
-        return store(self.path, header, arrays)
+        ok = store(self.path, header, arrays)
+        if ok:
+            try:
+                enforce_budget()
+            except Exception:
+                logger.warning("prep cache budget sweep failed", exc_info=True)
+        return ok
 
     def _filters_spliceable(self) -> bool:
         """Tail splices re-apply the scan filters through the colspans
@@ -827,6 +928,7 @@ def probe(
         handle.status = "hit"
         handle.entry = entry
         handle.batch = entry.batch()
+        _touch(path)
         _observe_stage("probe", time.perf_counter() - t0)
         return handle
     sp, reason = _try_splice(handle, entry)
@@ -843,6 +945,7 @@ def probe(
     handle.splice = sp
     handle.batch = sp.batch
     handle.token = sp.token
+    _touch(path)
     _observe_stage("probe", time.perf_counter() - t0)
     return handle
 
@@ -908,6 +1011,7 @@ def _try_splice(handle: PrepHandle, entry: PrepEntry):
             batch=entry.batch(), surgical=True,
             delta_rows=np.zeros(0, np.int32),
             delta_cols=np.zeros(0, np.int32),
+            delta_vals=np.zeros(0, np.float32),
             files=new_files, token=tok1, eid_hash=entry.eid_hash(),
         ), ""
 
@@ -1001,6 +1105,9 @@ def _try_splice(handle: PrepHandle, entry: PrepEntry):
     delta_cols = np.concatenate(
         [tail_codes[i][1] for i, _, _ in decoded]
     ).astype(np.int32) if surgical else np.zeros(0, np.int32)
+    delta_vals = np.concatenate(
+        [t.ratings for _, t, _ in decoded]
+    ).astype(np.float32) if surgical else np.zeros(0, np.float32)
 
     batch = storage_base.RatingsBatch(
         entity_ids=users,
@@ -1013,6 +1120,7 @@ def _try_splice(handle: PrepHandle, entry: PrepEntry):
     return _Splice(
         batch=batch, surgical=surgical,
         delta_rows=delta_rows, delta_cols=delta_cols,
+        delta_vals=delta_vals,
         files=new_files, token=tok1, eid_hash=eid,
     ), ""
 
@@ -1026,3 +1134,145 @@ def _first_appearance(codes: np.ndarray, ids: list[str]):
     rank = np.empty(len(uniq), np.int64)
     rank[uniq[order]] = np.arange(len(uniq))
     return rank[codes].astype(np.int32), [ids[c] for c in uniq[order]]
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: list / evict / prune (entries are derived data — always safe
+# to drop; a dropped entry just costs the next train one full scan+pack)
+# ---------------------------------------------------------------------------
+
+
+def _touch(path: Path) -> None:
+    """Explicitly bump atime on a hit/splice (relatime would otherwise
+    defer it up to a day, starving the LRU ordering of signal)."""
+    try:
+        st = os.stat(path)
+        os.utime(path, (time.time(), st.st_mtime))
+    except OSError:
+        pass
+
+
+def _read_header(path: Path) -> dict | None:
+    """Header-only read (no mmap, no block validation) for listings."""
+    try:
+        with open(path, "rb") as f:
+            magic = f.read(len(MAGIC) + 8)
+            if magic[: len(MAGIC)] != MAGIC:
+                return None
+            hlen = int.from_bytes(magic[len(MAGIC):], "little")
+            if hlen <= 0 or hlen > 64 * 1024 * 1024:
+                return None
+            return json.loads(f.read(hlen))
+    except (OSError, ValueError):
+        return None
+
+
+def cache_entries(detail: bool = False) -> list[dict]:
+    """Every entry in :func:`cache_dir`, oldest-atime first (LRU order).
+    ``detail`` adds header-derived fields (n, spliceable, packs)."""
+    out = []
+    try:
+        paths = sorted(cache_dir().glob(f"*{SUFFIX}"))
+    except OSError:
+        return out
+    for p in paths:
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        rec = {
+            "name": p.name,
+            "path": str(p),
+            "bytes": int(st.st_size),
+            "atime": float(st.st_atime),
+            "mtime": float(st.st_mtime),
+        }
+        if detail:
+            h = _read_header(p) or {}
+            rec.update(
+                n=int(h.get("n", 0)),
+                spliceable=bool(h.get("spliceable")),
+                created_s=h.get("created_s"),
+                single_pack="single_pack" in h,
+                sharded_pack="sharded_pack" in h,
+            )
+        out.append(rec)
+    out.sort(key=lambda r: r["atime"])
+    return out
+
+
+def _update_bytes_gauge(total: int) -> None:
+    try:
+        from predictionio_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.gauge(
+            "pio_prep_cache_bytes", "Total bytes of prep-cache entries"
+        ).set(float(total))
+    except Exception:
+        pass
+
+
+def evict(name: str) -> bool:
+    """Unlink one entry by name (or path). Concurrent readers holding
+    the mmap keep working — the mapping outlives the directory entry —
+    and the next probe rebuilds with ``reason=miss``."""
+    p = Path(name)
+    if p.parent == Path("."):
+        p = cache_dir() / name
+    if p.suffix != SUFFIX:
+        return False
+    try:
+        p.unlink()
+    except OSError:
+        return False
+    _counter(
+        "pio_prep_cache_evictions_total",
+        "Prep-cache entries dropped by eviction/prune",
+    ).inc()
+    _update_bytes_gauge(sum(e["bytes"] for e in cache_entries()))
+    return True
+
+
+def enforce_budget(limit: int | None = None) -> list[str]:
+    """Drop oldest-atime entries until the cache fits ``limit`` bytes
+    (default :func:`max_bytes`); returns the evicted names. No-op when
+    unbounded."""
+    limit = max_bytes() if limit is None else limit
+    entries = cache_entries()
+    total = sum(e["bytes"] for e in entries)
+    evicted: list[str] = []
+    if limit is not None:
+        for e in entries:
+            if total <= limit:
+                break
+            try:
+                os.unlink(e["path"])
+            except OSError:
+                continue
+            total -= e["bytes"]
+            evicted.append(e["name"])
+            _counter(
+                "pio_prep_cache_evictions_total",
+                "Prep-cache entries dropped by eviction/prune",
+            ).inc()
+    _update_bytes_gauge(total)
+    return evicted
+
+
+def prune(max_age_s: float = 600.0, limit: int | None = None) -> dict:
+    """Sweep abandoned ``*.tmp.<pid>`` husks (older than ``max_age_s`` —
+    left by a writer killed between tmp-write and rename) then enforce
+    the size budget. Returns {"husks": [...], "evicted": [...]}."""
+    husks: list[str] = []
+    now = time.time()
+    try:
+        for p in cache_dir().glob("*.tmp.*"):
+            try:
+                if now - os.stat(p).st_mtime >= max_age_s:
+                    p.unlink()
+                    husks.append(p.name)
+            except OSError:
+                continue
+    except OSError:
+        pass
+    return {"husks": husks, "evicted": enforce_budget(limit)}

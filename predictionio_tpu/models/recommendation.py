@@ -530,9 +530,16 @@ class ALSAlgorithm(Algorithm):
         if prep is not None and prep.active:
             cached = prep.sharded_pack(params, shards, self.params.sharded_mode)
             if cached is not None:
-                return cached, None
+                if prep.status == "hit":
+                    return cached, None
+                # splice-grade layout reuse: republish the extended pack
+                # so the next probe is an exact hit
+                return cached, cached
+        # shape-stable (pow2-envelope) packing whenever the prep cache is
+        # live, so a later small splice keeps these compiled shapes
         fresh = als_sharded.prepare_sharded_pack(
-            data, params, shards, self.params.sharded_mode
+            data, params, shards, self.params.sharded_mode,
+            stable_shapes=prep is not None and prep.active,
         )
         return fresh, fresh
 

@@ -66,10 +66,14 @@ class ProgressPublisher:
         self,
         total_iterations: int,
         path: str | None = None,
+        tol: float = 0.0,
         **static,
     ) -> None:
         self.path = progress_path(path)
         self.total_iterations = int(total_iterations)
+        self.configured_iterations = int(total_iterations)
+        self.tol = float(tol or 0.0)
+        self.early_stopped = False
         self.started_at = time.time()
         self.rmse_trajectory: list[float] = []
         self._static = static
@@ -103,6 +107,14 @@ class ProgressPublisher:
             "updated_at": round(now, 3),
             "iteration": int(iteration),
             "total_iterations": self.total_iterations,
+            "configured_iterations": self.configured_iterations,
+            # under --tol the run may plateau out before the configured
+            # count, so total/eta are upper bounds, not predictions
+            "tol": self.tol or None,
+            "eta_is_bound": bool(
+                self.tol > 0 and state == "running" and eta_s is not None
+            ),
+            "early_stopped": self.early_stopped,
             "rmse": self.rmse_trajectory or None,
             "events_per_s": (
                 round(float(events_per_s), 1) if events_per_s else None
@@ -121,7 +133,16 @@ class ProgressPublisher:
         except OSError:
             logger.debug("progress publish failed", exc_info=True)
 
-    def done(self, iteration: int | None = None) -> None:
+    def done(
+        self, iteration: int | None = None, early_stopped: bool = False
+    ) -> None:
+        """Terminal publish. ``early_stopped`` (a --tol plateau) pins
+        ``total_iterations`` to the iteration actually reached, so the
+        final document reports the true count instead of the stale
+        configured one."""
+        if early_stopped and iteration is not None:
+            self.early_stopped = True
+            self.total_iterations = int(iteration)
         self.publish(
             iteration if iteration is not None else self.total_iterations,
             state="done",

@@ -992,7 +992,7 @@ def als_train(
 
     nnz = len(data.vals)
     prog = obs_progress.ProgressPublisher(
-        params.iterations, mesh="single", trainer="single",
+        params.iterations, tol=tol, mesh="single", trainer="single",
         warm_start=warm_start is not None, **(progress_extra or {}),
     )
     t0 = _time.perf_counter()
@@ -1057,7 +1057,7 @@ def als_train(
                     break
                 prev_rmse = final_rmse
     jax.block_until_ready(out)
-    prog.done(it)
+    prog.done(it, early_stopped=it < params.iterations)
     LAST_TRAIN_INFO.clear()
     LAST_TRAIN_INFO.update(
         iterations_run=it - start_iter,

@@ -131,7 +131,23 @@ class SideLayout:
         return shard * self.rows_per_shard + self.rows_per_shard - 1
 
 
-def build_side_layout(ids: np.ndarray, num_rows: int, shards: int) -> SideLayout:
+def _envelope(n: int) -> int:
+    """Smallest power of two >= ``n`` that still leaves ~12.5% free
+    headroom past it. The layout-stable warm-retrain path (prep cache)
+    sizes every packed dimension to this envelope so a small delta
+    splices into the FREE slots without changing any array shape — the
+    shape stability that lets a warm solve re-enter the already-compiled
+    fused trainer."""
+    n = max(1, int(n))
+    e = 1 << max(3, (n - 1).bit_length())
+    if e - n < max(1, n // 8):
+        e *= 2
+    return e
+
+
+def build_side_layout(
+    ids: np.ndarray, num_rows: int, shards: int, stable_shapes: bool = False
+) -> SideLayout:
     """Lay one side's ``num_rows`` factor rows out over ``shards``.
 
     ``ids`` are that side's COO ids (degree = occurrence count; rows
@@ -139,6 +155,11 @@ def build_side_layout(ids: np.ndarray, num_rows: int, shards: int) -> SideLayout
     serpentine over descending degree balances per-shard entry load to
     within one row's degree; within a shard, slots follow ascending row
     id — a stable layout independent of degree ties.
+
+    ``stable_shapes`` pads ``rows_per_shard`` to the pow2
+    :func:`_envelope` so later small deltas can append rows per shard
+    without resizing the factor tables (extra slots stay zero and never
+    scatter — correctness-free headroom, like the per-shard dummy).
     """
     deg = np.bincount(np.asarray(ids, dtype=np.int64), minlength=num_rows)
     order = np.argsort(-deg, kind="stable")
@@ -152,9 +173,67 @@ def build_side_layout(ids: np.ndarray, num_rows: int, shards: int) -> SideLayout
         js = np.nonzero(assign == s)[0]  # ascending row id
         loc[js] = np.arange(len(js))
         max_count = max(max_count, len(js))
+    R = _envelope(max_count + 1) if stable_shapes else max_count + 1
     return SideLayout(
-        assign=assign, loc=loc, rows_per_shard=max_count + 1, shards=shards
+        assign=assign, loc=loc, rows_per_shard=R, shards=shards
     )
+
+
+def extend_side_layout(
+    layout: SideLayout,
+    new_num_rows: int,
+    delta_ids: np.ndarray,
+    shard_loads=None,
+) -> SideLayout | None:
+    """Append ids ``[len(assign), new_num_rows)`` into an existing layout
+    WITHOUT moving any placed row (the layout-reuse half of the warm
+    sharded retrain: factor placement — and with it the compiled fused
+    program — survives the delta).
+
+    New ids are assigned least-loaded-first (``shard_loads`` seeds the
+    heap with the cached pack's per-shard entry counts; each new id adds
+    its delta degree), filling each shard's existing order at
+    ``loc = current row count``. Returns ``None`` when any shard would
+    lose its guaranteed-free trailing slot — the caller falls back to a
+    fresh layout (counted as ``layout_drift``)."""
+    old_n = len(layout.assign)
+    if new_num_rows < old_n:
+        return None
+    if new_num_rows == old_n:
+        return layout
+    S, R = layout.shards, layout.rows_per_shard
+    row_counts = np.bincount(layout.assign, minlength=S)
+    deg = np.bincount(
+        np.asarray(delta_ids, dtype=np.int64), minlength=new_num_rows
+    )[old_n:]
+    loads = (
+        np.asarray(shard_loads, dtype=np.float64)
+        if shard_loads is not None
+        else row_counts.astype(np.float64)
+    )
+    assign = np.concatenate(
+        [layout.assign, np.zeros(new_num_rows - old_n, np.int64)]
+    )
+    loc = np.concatenate(
+        [layout.loc, np.zeros(new_num_rows - old_n, np.int64)]
+    )
+    heap = [
+        (float(loads[s]), int(row_counts[s]), s)
+        for s in range(S)
+        if row_counts[s] < R - 1  # keep the dummy slot free
+    ]
+    heapq.heapify(heap)
+    for j in np.argsort(-deg, kind="stable"):
+        if not heap:
+            return None
+        load, cnt, s = heapq.heappop(heap)
+        u = old_n + int(j)
+        assign[u] = s
+        loc[u] = cnt
+        cnt += 1
+        if cnt < R - 1:
+            heapq.heappush(heap, (load + float(deg[j]), cnt, s))
+    return SideLayout(assign=assign, loc=loc, rows_per_shard=R, shards=S)
 
 
 @dataclass
@@ -221,6 +300,7 @@ def pack_sharded_side(
     o_layout: SideLayout,
     shards: int,
     mode: str,
+    stable_shapes: bool = False,
 ) -> PackedSide:
     """Build one side's :class:`PackedSide` from raw COO entries.
 
@@ -231,6 +311,12 @@ def pack_sharded_side(
     packed group (stable packing), which keeps the accumulation order —
     and thus the float32 trajectory — aligned with single-chip
     ``als_train``.
+
+    ``stable_shapes`` pads the packed-row count ``B`` (and ring's
+    rotation-cell width ``E``) to the pow2 :func:`_envelope`, leaving
+    free all-zero rows/slots a later :func:`splice_packed_side` fills in
+    place. Padding rows carry ``mask=0`` and scatter zeros, so the
+    float32 trajectory is unchanged (exact zeros add exactly).
     """
     t_ids = np.asarray(t_ids, dtype=np.int64)
     o_ids = np.asarray(o_ids, dtype=np.int64)
@@ -263,6 +349,8 @@ def pack_sharded_side(
             if n_rows
             else 1
         )
+        if stable_shapes:
+            B = _envelope(B)
         row_pos = _group_positions(row_shard)
         col_ids = np.zeros((shards, B, K), np.int32)
         ratings = np.zeros((shards, B, K), np.float32)
@@ -292,6 +380,8 @@ def pack_sharded_side(
             if n_rows
             else 1
         )
+        if stable_shapes:
+            B = _envelope(B)
         row_pos = _group_positions(row_shard)
         ratings = np.zeros((shards, B, K), np.float32)
         mask = np.zeros((shards, B, K), np.float32)
@@ -304,6 +394,8 @@ def pack_sharded_side(
             if n_rows
             else 1
         )
+        if stable_shapes:
+            E = _envelope(E)
         e_pos = _group_positions(cell)
         # routing: [S, T, E] slab-local col ids read per rotation step
         # (padding rereads slab row 0 — discarded by the gather map).
@@ -336,6 +428,105 @@ def pack_sharded_side(
         rows_per_shard=R,
         pack_width=K,
         packed_rows=n_rows,
+    )
+
+
+def splice_packed_side(
+    ps: PackedSide,
+    t_layout: SideLayout,
+    o_layout: SideLayout,
+    delta_t: np.ndarray,
+    delta_o: np.ndarray,
+    delta_vals: np.ndarray,
+) -> PackedSide | None:
+    """Append delta entries into a cached :class:`PackedSide` under the
+    REUSED (possibly :func:`extend_side_layout`-extended) layouts,
+    preserving every array shape — the packed half of the
+    zero-recompile warm retrain.
+
+    Each delta entry first tops up its solved row's one partial packed
+    segment (``pack_entries`` fills slots as a prefix, so occupancy is
+    recoverable from the mask), else claims the shard's next free
+    envelope row; ring mode additionally claims the next free slot of
+    its ``(shard, rotation-step)`` routing cell and extends the inverse
+    gather map. Returns ``None`` when the delta outgrows the free
+    slots of any dimension — the caller falls back to a fresh pack
+    (``layout_drift``). Entry order within a packed row is append
+    order, which a fresh repack would not reproduce exactly: the warm
+    solve is float-equal to ~1e-6 of a fresh-layout solve, not
+    bit-identical (the fallback path stays bit-identical).
+    """
+    S, R, K = ps.shards, ps.rows_per_shard, ps.pack_width
+    mode = ps.mode
+    # entry arrays may be read-only mmap views out of the prep cache
+    row_ids = np.array(ps.row_ids, dtype=np.int32, copy=True)
+    col_ids = np.array(ps.col_ids, copy=True)
+    ratings = np.array(ps.ratings, copy=True)
+    mask = np.array(ps.mask, copy=True)
+    seg = np.array(ps.seg, copy=True)
+    B = ratings.shape[1]
+    used = (mask > 0).sum(axis=2).astype(np.int64)  # [S, B] filled slots
+    n_real = (used > 0).sum(axis=1).astype(np.int64)  # real rows: [0, n_s)
+    seg_slot = seg if mode == "gather" else seg[:, :, 0]
+    # the at-most-one partial packed row per (shard, solved slot)
+    partial = {
+        (int(s), int(seg_slot[s, b])): int(b)
+        for s, b in zip(*np.nonzero((used > 0) & (used < K)))
+    }
+    if mode == "ring":
+        E = col_ids.shape[2]
+        gmap = seg[:, :, 1:]
+        cell_fill = np.zeros((S, S), np.int64)
+        for s in range(S):
+            v = gmap[s][mask[s] > 0]  # real slots: step * E + e_pos
+            if v.size:
+                cell_fill[s] = np.bincount(v // E, minlength=S)
+    delta_t = np.asarray(delta_t, np.int64)
+    delta_o = np.asarray(delta_o, np.int64)
+    delta_vals = np.asarray(delta_vals, np.float32)
+    o_pos = o_layout.positions
+    packed_rows = int(ps.packed_rows)
+    for t, o, v in zip(delta_t, delta_o, delta_vals):
+        s, l = int(t_layout.assign[t]), int(t_layout.loc[t])
+        b = partial.get((s, l))
+        if b is None:
+            b = int(n_real[s])
+            if b >= B:
+                return None  # envelope exhausted
+            n_real[s] += 1
+            packed_rows += 1
+            seg_slot[s, b] = l
+            partial[(s, l)] = b
+            k = 0
+        else:
+            k = int(used[s, b])
+        ratings[s, b, k] = v
+        mask[s, b, k] = 1.0
+        used[s, b] = k + 1
+        if used[s, b] >= K:
+            partial.pop((s, l), None)
+        if mode == "gather":
+            col_ids[s, b, k] = o_pos[o]
+        else:
+            step = (s - int(o_layout.assign[o])) % S
+            e = int(cell_fill[s, step])
+            if e >= E:
+                return None  # routing cell exhausted
+            col_ids[s, step, e] = o_layout.loc[o]
+            seg[s, b, 1 + k] = step * E + e
+            cell_fill[s, step] = e + 1
+        row_ids[s * R + l] = s * R + l  # solved slots self-address
+    return PackedSide(
+        row_ids=row_ids,
+        col_ids=col_ids,
+        ratings=ratings,
+        mask=mask,
+        seg=seg,
+        mode=mode,
+        shards=S,
+        rows_per_shard=R,
+        pack_width=K,
+        packed_rows=packed_rows,
     )
 
 
@@ -750,25 +941,34 @@ def prepare_sharded_pack(
     params: als_ops.ALSParams,
     shards: int,
     mode: str = "auto",
+    stable_shapes: bool = False,
 ):
     """Build the host-side sharded prep — resolved mode, both
     :class:`SideLayout`\\ s, and both :class:`PackedSide`\\ s — WITHOUT
     training. This is the scan+pack work :func:`sharded_als_train`
     normally does inline; split out so the packed-prep cache
     (core/prep_cache.py) can persist and restore it, handing the result
-    back via ``prepacked=``. Returns ``(mode, row_layout, col_layout,
-    row_ps, col_ps)``."""
+    back via ``prepacked=``. ``stable_shapes`` (set by the prep cache)
+    pads every packed dimension to its pow2 :func:`_envelope` so a
+    cached pack can absorb small deltas shape-stably. Returns ``(mode,
+    row_layout, col_layout, row_ps, col_ps)``."""
     if mode == "auto":
         mode = choose_sharded_mode(data, params, shards)
     elif mode not in ("gather", "ring"):
         raise ValueError(f"mode must be auto|gather|ring, got {mode!r}")
-    row_layout = build_side_layout(data.rows, data.num_rows, shards)
-    col_layout = build_side_layout(data.cols, data.num_cols, shards)
+    row_layout = build_side_layout(
+        data.rows, data.num_rows, shards, stable_shapes=stable_shapes
+    )
+    col_layout = build_side_layout(
+        data.cols, data.num_cols, shards, stable_shapes=stable_shapes
+    )
     row_ps = pack_sharded_side(
-        data.rows, data.cols, data.vals, row_layout, col_layout, shards, mode
+        data.rows, data.cols, data.vals, row_layout, col_layout, shards,
+        mode, stable_shapes=stable_shapes,
     )
     col_ps = pack_sharded_side(
-        data.cols, data.rows, data.vals, col_layout, row_layout, shards, mode
+        data.cols, data.rows, data.vals, col_layout, row_layout, shards,
+        mode, stable_shapes=stable_shapes,
     )
     return mode, row_layout, col_layout, row_ps, col_ps
 

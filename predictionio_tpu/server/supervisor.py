@@ -137,6 +137,7 @@ class Supervisor:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         probe: Callable[[ServiceSpec], dict | None] | None = None,
+        retrain: "RetrainScheduler | None" = None,
     ):
         self.poll_interval = (
             poll_interval
@@ -181,6 +182,7 @@ class Supervisor:
         self._sleep = sleep
         self._probe_fn = probe
         self._children = [_Child(spec, seed) for spec in specs]
+        self.retrain = retrain
         self._stop_event = threading.Event()
         self._dirty = True
         self._lock = threading.RLock()
@@ -345,6 +347,16 @@ class Supervisor:
                 now = self._clock()
             for child in self._children:
                 self._step_child(child, now)
+            # the retrain child is deliberately NOT a supervised service:
+            # its exits are expected and must not feed the flap detector
+            if self.retrain is not None:
+                try:
+                    self.retrain.tick(now)
+                except Exception:
+                    logger.exception("supervisor: retrain tick failed")
+                if self.retrain.dirty:
+                    self.retrain.dirty = False
+                    self._dirty = True
             if self._dirty:
                 self._write_state()
 
@@ -440,6 +452,8 @@ class Supervisor:
         grace."""
         self._stop_event.set()
         with self._lock:
+            if self.retrain is not None:
+                self.retrain.stop()
             for child in reversed(self._children):
                 if child.state in (STOPPED,):
                     continue
@@ -484,11 +498,14 @@ class Supervisor:
             }
 
     def state_doc(self) -> dict:
-        return {
+        doc = {
             "pid": os.getpid(),
             "updated": time.time(),
             "services": self.services(),
         }
+        if self.retrain is not None:
+            doc["retrain"] = self.retrain.doc()
+        return doc
 
     def _write_state(self) -> None:
         """Atomic supervisor.json under the run dir — what ``pio
@@ -503,6 +520,289 @@ class Supervisor:
             tmp.replace(path)
         except OSError:
             logger.exception("supervisor: state write failed")
+
+
+class RetrainScheduler:
+    """Cadenced warm retrain driven by the supervisor loop.
+
+    Each due tick spawns ``pio train`` (warm-start + prep-cache hot
+    path) as a NON-supervised child — its exits are expected, so it
+    must never feed the flap detector — then, on success, POSTs
+    ``/reload`` to every engine replica (epoch-fenced: the engine swaps
+    to the newest COMPLETED instance). Ticks are serialized: while a
+    retrain is running nothing else is spawned, and a crashed retrain
+    just counts a failure and waits for the next cadence tick (the
+    prep cache + checkpoint make the retry cheap).
+
+    With ``slo_driven`` the interval adapts to the ``serving.freshness``
+    SLO: while it burns, the interval halves (down to ``floor_s``); once
+    it is ok again the interval decays back toward the configured base.
+    A tick is skipped (counted) when the speed-layer watermark
+    (``events_folded + events_behind`` from the engine's ``/stats.json``)
+    hasn't moved — no new events means retraining buys nothing.
+
+    Clock, spawn, stats/SLO fetch, and reload are injectable for tests
+    and for in-process drills (bench.py production_stack).
+    """
+
+    def __init__(
+        self,
+        interval_s: float,
+        *,
+        train_argv: list[str],
+        engine_ports: tuple[int, ...] | list[int] = (),
+        host: str = "127.0.0.1",
+        slo_driven: bool = False,
+        floor_s: float | None = None,
+        slo_name: str = "serving.freshness",
+        spawn: Callable[[], Any] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        fetch_stats: Callable[[], dict | None] | None = None,
+        fetch_slo: Callable[[], dict | None] | None = None,
+        post_reload: Callable[[], int] | None = None,
+    ):
+        self.base_interval_s = float(interval_s)
+        self.interval_s = float(interval_s)
+        self.floor_s = (
+            float(floor_s) if floor_s is not None
+            else max(1.0, self.base_interval_s / 8.0)
+        )
+        self.train_argv = list(train_argv)
+        self.engine_ports = tuple(int(p) for p in engine_ports)
+        self.host = host
+        self.slo_driven = bool(slo_driven)
+        self.slo_name = slo_name
+        self._spawn = spawn
+        self._clock = clock
+        self._fetch_stats = fetch_stats
+        self._fetch_slo = fetch_slo
+        self._post_reload = post_reload
+        self._proc: Any | None = None
+        self._started_at = 0.0
+        self._pending_watermark: float | None = None
+        self._last_watermark: float | None = None
+        self._next_slo_check = 0.0
+        self.next_at = self._clock() + self.interval_s
+        self.runs = 0
+        self.skips = 0
+        self.failures = 0
+        self.last_run: dict | None = None
+        self.dirty = True
+        self._g_interval().set(self.interval_s)
+
+    # -- metrics -----------------------------------------------------------
+
+    @staticmethod
+    def _m(kind: str):
+        return obs_metrics.counter(
+            f"pio_retrain_{kind}_total",
+            {
+                "runs": "Scheduled retrains that completed successfully",
+                "skips": "Scheduled retrains skipped (watermark unmoved)",
+                "failures": "Scheduled retrains that exited non-zero",
+            }[kind],
+        )
+
+    @staticmethod
+    def _g_interval():
+        return obs_metrics.gauge(
+            "pio_retrain_interval_s",
+            "Current retrain cadence (adapts under --retrain-slo)",
+        )
+
+    # -- default I/O (real fleet) ------------------------------------------
+
+    def _http_json(self, port: int, path: str, post: bool = False):
+        import json as _json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{self.host}:{port}{path}",
+            method="POST" if post else "GET",
+            data=b"{}" if post else None,
+            headers={"Content-Type": "application/json"} if post else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                return _json.loads(resp.read().decode())
+        except Exception:
+            return None
+
+    def _watermark(self) -> float | None:
+        """Speed-layer progress marker; None -> unknown (never skip)."""
+        doc = (
+            self._fetch_stats() if self._fetch_stats is not None
+            else (
+                self._http_json(self.engine_ports[0], "/stats.json")
+                if self.engine_ports else None
+            )
+        )
+        if not isinstance(doc, dict):
+            return None
+        rt = doc.get("realtime")
+        if not isinstance(rt, dict):
+            return None
+        if "events_folded" not in rt and "events_behind" not in rt:
+            # batch-only serving (speed layer off) reports no counters:
+            # a constant 0.0 here would skip every tick after the first
+            # successful run — unknown progress must retrain on cadence
+            return None
+        try:
+            return float(rt.get("events_folded", 0)) + float(
+                rt.get("events_behind", 0)
+            )
+        except (TypeError, ValueError):
+            return None
+
+    def _slo_state(self) -> str | None:
+        doc = (
+            self._fetch_slo() if self._fetch_slo is not None
+            else (
+                self._http_json(self.engine_ports[0], "/slo.json")
+                if self.engine_ports else None
+            )
+        )
+        if not isinstance(doc, dict):
+            return None
+        for s in doc.get("slos", []):
+            if s.get("name") == self.slo_name:
+                return s.get("state")
+        return None
+
+    def _reload_all(self) -> int:
+        if self._post_reload is not None:
+            return int(self._post_reload())
+        n = 0
+        for port in self.engine_ports:
+            if self._http_json(port, "/reload", post=True) is not None:
+                n += 1
+        return n
+
+    # -- the cadence machine -----------------------------------------------
+
+    def _set_interval(self, value: float) -> None:
+        value = min(self.base_interval_s, max(self.floor_s, value))
+        if value != self.interval_s:
+            logger.info(
+                "retrain: interval %.1fs -> %.1fs (slo %s)",
+                self.interval_s, value, self.slo_name,
+            )
+            self.interval_s = value
+            self._g_interval().set(value)
+            self.dirty = True
+
+    def _adapt(self, now: float) -> None:
+        if now < self._next_slo_check:
+            return
+        self._next_slo_check = now + max(
+            1.0, min(5.0, self.interval_s / 4.0)
+        )
+        state = self._slo_state()
+        if state in ("burning", "violated"):
+            self._set_interval(self.interval_s / 2.0)
+            # pull the next run forward: a burn shouldn't wait out the
+            # remainder of a long idle interval
+            self.next_at = min(self.next_at, now + self.interval_s)
+        elif state == "ok":
+            self._set_interval(self.interval_s * 1.5)
+
+    def tick(self, now: float | None = None) -> None:
+        if now is None:
+            now = self._clock()
+        if self._proc is not None:
+            rc = self._proc.poll()
+            if rc is None:
+                return  # serialized: one retrain at a time
+            self._finish(rc, now)
+            return
+        if self.slo_driven:
+            self._adapt(now)
+        if now < self.next_at:
+            return
+        wm = self._watermark()
+        if wm is not None and self._last_watermark is not None and (
+            wm <= self._last_watermark
+        ):
+            self.skips += 1
+            self._m("skips").inc()
+            self.last_run = {
+                "t": time.time(), "ok": True, "skipped": True,
+                "watermark": wm,
+            }
+            self.next_at = now + self.interval_s
+            self.dirty = True
+            return
+        self._pending_watermark = wm
+        try:
+            if self._spawn is not None:
+                self._proc = self._spawn()
+            else:
+                self._proc = daemon.spawn_service("retrain", self.train_argv)
+        except Exception as exc:
+            self.failures += 1
+            self._m("failures").inc()
+            self.last_run = {
+                "t": time.time(), "ok": False,
+                "exit": f"spawn failed: {exc}",
+            }
+            self.next_at = now + self.interval_s
+            self.dirty = True
+            return
+        self._started_at = now
+        self.dirty = True
+
+    def _finish(self, rc: int, now: float) -> None:
+        self._proc = None
+        ok = rc == 0
+        reloaded = 0
+        if ok:
+            self.runs += 1
+            self._m("runs").inc()
+            self._last_watermark = self._pending_watermark
+            reloaded = self._reload_all()
+        else:
+            self.failures += 1
+            self._m("failures").inc()
+        self.last_run = {
+            "t": time.time(),
+            "ok": ok,
+            "exit": _describe_exit(rc),
+            "wall_s": round(now - self._started_at, 3),
+            "reloaded": reloaded,
+        }
+        self.next_at = now + self.interval_s
+        self.dirty = True
+
+    def doc(self) -> dict:
+        now = self._clock()
+        return {
+            "state": "running" if self._proc is not None else "idle",
+            "interval_s": round(self.interval_s, 3),
+            "base_interval_s": round(self.base_interval_s, 3),
+            "slo_driven": self.slo_driven,
+            "next_in_s": (
+                None if self._proc is not None
+                else round(max(0.0, self.next_at - now), 3)
+            ),
+            "runs": self.runs,
+            "skips": self.skips,
+            "failures": self.failures,
+            "last_run": self.last_run,
+        }
+
+    def stop(self) -> None:
+        proc = self._proc
+        self._proc = None
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=daemon.drain_grace())
+                except Exception:
+                    proc.kill()
+                    proc.wait()
+            except Exception:
+                pass
 
 
 def state_file():

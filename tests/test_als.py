@@ -1175,3 +1175,138 @@ class TestSweepWithChunkedGathers:
             np.testing.assert_allclose(
                 np.asarray(V), np.asarray(V_s), rtol=2e-4, atol=2e-5
             )
+
+
+class TestLayoutSpliceProperty:
+    """The layout-stable warm-retrain primitives (extend_side_layout /
+    splice_packed_side): placed rows never move, packed array shapes
+    never change, the spliced multiset is exactly raw + delta, and a
+    warm solve on the spliced pack matches a fresh-layout solve."""
+
+    @staticmethod
+    def _base(seed):
+        rng = np.random.default_rng(seed)
+        n_u, n_i, n = 60, 40, 700
+        rows = rng.integers(0, n_u, n).astype(np.int32)
+        cols = rng.integers(0, n_i, n).astype(np.int32)
+        # unit-scale ratings keep f32 reassociation noise under the
+        # 1e-6 parity budget (see TestFusedParity)
+        vals = rng.uniform(0.2, 1.0, n).astype(np.float32)
+        return rows, cols, vals, n_u, n_i
+
+    def test_extend_keeps_placements_and_shapes(self):
+        from predictionio_tpu.parallel import als_sharded as sh
+
+        rows, cols, vals, n_u, n_i = self._base(0)
+        rl = sh.build_side_layout(rows, n_u, 8, stable_shapes=True)
+        delta = np.array([n_u, n_u, n_u + 1], np.int64)  # two new rows
+        rl2 = sh.extend_side_layout(rl, n_u + 2, delta)
+        assert rl2 is not None
+        np.testing.assert_array_equal(rl2.assign[:n_u], rl.assign)
+        np.testing.assert_array_equal(rl2.loc[:n_u], rl.loc)
+        assert rl2.rows_per_shard == rl.rows_per_shard
+        # new rows stay below the guaranteed-free dummy slot, at
+        # positions nothing else occupies
+        assert (rl2.loc[n_u:] < rl.rows_per_shard - 1).all()
+        pos = rl2.positions
+        assert len(set(pos.tolist())) == len(pos)
+        # a no-op extend hands back the SAME layout (cache identity)
+        assert sh.extend_side_layout(rl, n_u, np.empty(0, np.int64)) is rl
+
+    def test_extend_overflow_and_shrink_return_none(self):
+        from predictionio_tpu.parallel import als_sharded as sh
+
+        rows, cols, vals, n_u, n_i = self._base(1)
+        rl = sh.build_side_layout(rows, n_u, 8)  # tight: R = max_count+1
+        S, R = rl.shards, rl.rows_per_shard
+        free = S * (R - 1) - n_u  # dummy slot per shard is off limits
+        new_ids = np.arange(n_u, n_u + free + 1, dtype=np.int64)
+        assert sh.extend_side_layout(rl, n_u + free + 1, new_ids) is None
+        assert (
+            sh.extend_side_layout(rl, n_u - 1, np.empty(0, np.int64)) is None
+        )
+        # exactly the free count still fits, shape-stably
+        fit = sh.extend_side_layout(rl, n_u + free, new_ids[:-1])
+        assert fit is not None and fit.rows_per_shard == R
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("mode", ["gather", "ring"])
+    def test_splice_preserves_triples_and_shapes(self, seed, mode):
+        from predictionio_tpu.parallel import als_sharded as sh
+
+        rows, cols, vals, n_u, n_i = self._base(seed)
+        raw = sorted(zip(rows.tolist(), cols.tolist(), vals.tolist()))
+        S = 8
+        rl = sh.build_side_layout(rows, n_u, S, stable_shapes=True)
+        cl = sh.build_side_layout(cols, n_i, S, stable_shapes=True)
+        ps = sh.pack_sharded_side(
+            rows, cols, vals, rl, cl, S, mode, stable_shapes=True
+        )
+        rng = np.random.default_rng(100 + seed)
+        d_rows = np.array([n_u, n_u, 3, 7], np.int64)  # one new user
+        d_cols = np.array([n_i, 1, 5, 9], np.int64)  # one new item
+        d_vals = rng.uniform(0.2, 1.0, 4).astype(np.float32)
+        rl2 = sh.extend_side_layout(rl, n_u + 1, d_rows)
+        cl2 = sh.extend_side_layout(cl, n_i + 1, d_cols)
+        assert rl2 is not None and cl2 is not None
+        sp = sh.splice_packed_side(ps, rl2, cl2, d_rows, d_cols, d_vals)
+        assert sp is not None
+        for f in ("row_ids", "col_ids", "ratings", "mask", "seg"):
+            assert getattr(sp, f).shape == getattr(ps, f).shape, f
+        want = sorted(
+            raw
+            + list(zip(d_rows.tolist(), d_cols.tolist(), d_vals.tolist()))
+        )
+        got = TestPackedLayoutProperty._packed_triples(sp, rl2, cl2, S)
+        assert got == want
+        # the cached source pack was copied, never mutated
+        assert TestPackedLayoutProperty._packed_triples(ps, rl, cl, S) == raw
+
+    def test_spliced_solve_matches_fresh_layout_solve(self):
+        from predictionio_tpu.parallel import als_sharded as sh
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        rows, cols, vals, n_u, n_i = self._base(5)
+        S = 8
+        params = als.ALSParams(rank=6, iterations=3, reg=0.05, seed=11)
+        rl = sh.build_side_layout(rows, n_u, S, stable_shapes=True)
+        cl = sh.build_side_layout(cols, n_i, S, stable_shapes=True)
+        rp = sh.pack_sharded_side(
+            rows, cols, vals, rl, cl, S, "gather", stable_shapes=True
+        )
+        cp = sh.pack_sharded_side(
+            cols, rows, vals, cl, rl, S, "gather", stable_shapes=True
+        )
+        rng = np.random.default_rng(42)
+        d_rows = np.array([n_u, n_u, 2, 17], np.int64)  # one new user
+        d_cols = rng.integers(0, n_i, 4).astype(np.int64)  # no new items
+        d_vals = rng.uniform(0.2, 1.0, 4).astype(np.float32)
+        rl2 = sh.extend_side_layout(rl, n_u + 1, d_rows)
+        cl2 = sh.extend_side_layout(cl, n_i, d_cols)
+        assert rl2 is not None
+        assert cl2 is cl  # no new cols: the cached col layout is reused as-is
+        rp2 = sh.splice_packed_side(rp, rl2, cl2, d_rows, d_cols, d_vals)
+        cp2 = sh.splice_packed_side(cp, cl2, rl2, d_cols, d_rows, d_vals)
+        assert rp2 is not None and cp2 is not None
+
+        rows_all = np.concatenate([rows, d_rows]).astype(np.int32)
+        cols_all = np.concatenate([cols, d_cols]).astype(np.int32)
+        vals_all = np.concatenate([vals, d_vals]).astype(np.float32)
+        data = als.build_ratings_data(
+            rows_all, cols_all, vals_all, n_u + 1, n_i, bucket_widths=(8, 32)
+        )
+        mesh = make_mesh([("data", S)])
+        spliced = ("gather", rl2, cl2, rp2, cp2)
+        fresh = sh.prepare_sharded_pack(data, params, S, "gather")
+        U_s, V_s = sh.sharded_als_train(
+            data, params, mesh, mode="gather", prepacked=spliced
+        )
+        U_f, V_f = sh.sharded_als_train(
+            data, params, mesh, mode="gather", prepacked=fresh
+        )
+        np.testing.assert_allclose(
+            np.asarray(U_s), np.asarray(U_f), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(V_s), np.asarray(V_f), atol=1e-6
+        )

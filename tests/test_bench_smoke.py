@@ -325,3 +325,45 @@ class TestBenchCompare:
         p2.write_text(json.dumps({"rc": 0, "tail": detail + "\n"}))
         assert bench_compare._load_summary(str(p2))["serving"]["qps"] \
             == 1000.0
+
+
+class TestBenchCompareShardedRetrain:
+    """Direction heuristics + regression wiring for the zero-recompile
+    sharded retrain rung and the scheduler drill leaves."""
+
+    def test_direction_heuristics(self):
+        # zero-recompile rung: compile/rebuild/drift counters and the
+        # warm/cold wall ratio are lower-better, layout reuse higher
+        assert bench_compare.leaf_direction("compiles_added") == "lower"
+        assert bench_compare.leaf_direction("layout_rebuilds") == "lower"
+        assert bench_compare.leaf_direction("layout_reuse") == "higher"
+        assert bench_compare.leaf_direction("warm_wall_ratio") == "lower"
+        assert bench_compare.leaf_direction("factor_parity") is None
+        # scheduler drill: failure/skip/eviction counters down
+        assert bench_compare.leaf_direction("retrain_failures") == "lower"
+        assert bench_compare.leaf_direction("evictions") == "lower"
+        assert bench_compare.leaf_direction("stale_observations") is None
+        # cache lifecycle: byte totals are volume, rebuild counts down
+        assert bench_compare.leaf_direction("rebuilds") == "lower"
+
+    def test_sharded_retrain_regression_flagged(self):
+        old = {"retrain": {"sharded": {
+            "compiles_added": 0, "layout_rebuilds": 0, "layout_reuse": 1,
+            "warm_wall_ratio": 0.12,
+        }}}
+        new = {"retrain": {"sharded": {
+            "compiles_added": 2, "layout_rebuilds": 1, "layout_reuse": 0,
+            "warm_wall_ratio": 0.9,
+        }}}
+        report = bench_compare.compare(old, new)
+        paths = [r["path"] for r in report["regressions"]]
+        assert "retrain.sharded.compiles_added" in paths
+        assert "retrain.sharded.layout_rebuilds" in paths
+        assert "retrain.sharded.layout_reuse" in paths
+        assert "retrain.sharded.warm_wall_ratio" in paths
+        # the zero -> nonzero compile regression has no relative change
+        row = next(
+            r for r in report["regressions"]
+            if r["path"] == "retrain.sharded.compiles_added"
+        )
+        assert row["change_pct"] is None

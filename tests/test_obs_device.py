@@ -213,6 +213,33 @@ class TestProgressFile:
         assert progress.read_progress(str(path)) is None
         assert progress.read_progress(str(tmp_path / "absent.json")) is None
 
+    def test_tol_run_reports_bounds_not_predictions(self, tmp_path):
+        """Under --tol the configured count is an upper bound: a live
+        doc flags eta_is_bound, and a plateau stop pins
+        total_iterations to the count actually run."""
+        path = str(tmp_path / "p.json")
+        pub = progress.ProgressPublisher(100, path=path, tol=1e-3,
+                                         mesh="single")
+        pub.publish(10)
+        doc = progress.read_progress(path)
+        assert doc["configured_iterations"] == 100
+        assert doc["tol"] == 1e-3
+        assert doc["eta_is_bound"] is True
+        assert doc["early_stopped"] is False
+        pub.done(12, early_stopped=True)
+        doc = progress.read_progress(path)
+        assert doc["state"] == "done"
+        assert doc["early_stopped"] is True
+        assert doc["total_iterations"] == 12
+        assert doc["configured_iterations"] == 100
+        assert doc["eta_is_bound"] is False
+        # without --tol the ETA is a prediction, never flagged a bound
+        pub2 = progress.ProgressPublisher(100, path=path, mesh="single")
+        pub2.publish(10)
+        doc = progress.read_progress(path)
+        assert doc["eta_is_bound"] is False and doc["tol"] is None
+
+
 
 class TestProfileSmoke:
     def test_cli_profile_produces_trace_dir(self, tmp_path, capsys):

@@ -15,6 +15,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 from datetime import datetime, timedelta, timezone
 from pathlib import Path
 
@@ -491,3 +492,229 @@ class TestKill9MidPublish:
             else:
                 os.environ["PIO_PREP_CACHE_DIR"] = prev
             storage.close()
+
+
+def _publish_sharded(handle, batch, params, shards):
+    """Publish with both the single-chip pack AND a stable-shapes
+    sharded pack, the way a `sharded_train` engine run does."""
+    from predictionio_tpu.parallel import als_sharded
+
+    rb, cb = _fresh_pack(batch)
+    data = als_ops.RatingsData(
+        rows=batch.rows, cols=batch.cols, vals=batch.vals,
+        num_rows=len(batch.entity_ids), num_cols=len(batch.target_ids),
+        row_buckets=rb, col_buckets=cb,
+    )
+    sharded = als_sharded.prepare_sharded_pack(
+        data, params, shards, "gather", stable_shapes=True
+    )
+    return handle.publish(
+        batch, data=data, bucket_widths=WIDTHS, sharded=sharded,
+        params=params, sharded_requested="gather",
+    )
+
+
+class TestShardedLayoutReuse:
+    """sharded_pack() off a splice probe: a small delta keeps the cached
+    SideLayout verbatim (zero-recompile warm retrain); a layout-shifting
+    delta falls back clean, counted reason=layout_drift."""
+
+    SHARDS = 4
+
+    def _seed(self, storage, app_id, params):
+        _put(storage, app_id, 0, 400)
+        h = prep_cache.probe("A", storage=storage, **FILTERS)
+        assert h.status == "miss"
+        batch = data_store.find_ratings("A", storage=storage, **FILTERS)
+        assert _publish_sharded(h, batch, params, self.SHARDS)
+        return batch
+
+    def test_small_delta_reuses_the_cached_layout(self, prep_storage):
+        from predictionio_tpu.parallel import als_sharded
+
+        from tests.test_als import TestPackedLayoutProperty
+
+        storage, app_id = prep_storage
+        params = als_ops.ALSParams(rank=4, iterations=2)
+        seed_batch = self._seed(storage, app_id, params)
+        reuse0 = obs_metrics.counter(
+            "pio_prep_cache_layout_reuse_total"
+        ).value()
+
+        _put(storage, app_id, 400, 8)  # reuses existing user/item ids
+        h = prep_cache.probe("A", storage=storage, **FILTERS)
+        assert h.status == "splice"
+        pack = h.sharded_pack(params, self.SHARDS, "gather")
+        assert pack is not None
+        assert (
+            obs_metrics.counter(
+                "pio_prep_cache_layout_reuse_total"
+            ).value()
+            == reuse0 + 1
+        )
+        mode, rl, cl, rp, cp = pack
+
+        # the reused layout IS the seed batch's layout — placement (and
+        # with it the compiled fused program) survived the delta
+        rb, cb = _fresh_pack(seed_batch)
+        data0 = als_ops.RatingsData(
+            rows=seed_batch.rows, cols=seed_batch.cols,
+            vals=seed_batch.vals, num_rows=len(seed_batch.entity_ids),
+            num_cols=len(seed_batch.target_ids),
+            row_buckets=rb, col_buckets=cb,
+        )
+        _, rl0, cl0, rp0, cp0 = als_sharded.prepare_sharded_pack(
+            data0, params, self.SHARDS, "gather", stable_shapes=True
+        )
+        np.testing.assert_array_equal(rl.assign, rl0.assign)
+        np.testing.assert_array_equal(cl.assign, cl0.assign)
+        for got, ref in ((rp, rp0), (cp, cp0)):
+            for f in ("row_ids", "col_ids", "ratings", "mask", "seg"):
+                assert getattr(got, f).shape == getattr(ref, f).shape, f
+
+        # and the spliced pack holds exactly the fresh scan's COO
+        fresh = data_store.find_ratings("A", storage=storage, **FILTERS)
+        _assert_batch_equal(h.batch, fresh)
+        want = sorted(
+            zip(fresh.rows.tolist(), fresh.cols.tolist(),
+                fresh.vals.tolist())
+        )
+        got = TestPackedLayoutProperty._packed_triples(
+            rp, rl, cl, self.SHARDS
+        )
+        assert got == want
+
+    def test_layout_drift_falls_back_clean(self, prep_storage):
+        storage, app_id = prep_storage
+        params = als_ops.ALSParams(rank=4, iterations=2)
+        self._seed(storage, app_id, params)
+        drift0 = obs_metrics.counter(
+            "pio_prep_cache_rebuilds_total", reason="layout_drift"
+        ).value()
+
+        # 60 brand-new users against a ~14-user side: way past the 5%
+        # layout-reuse envelope
+        _put(storage, app_id, 400, 60, user=lambda i: f"new{i}")
+        h = prep_cache.probe("A", storage=storage, **FILTERS)
+        assert h.status == "splice"
+        assert h.sharded_pack(params, self.SHARDS, "gather") is None
+        assert (
+            obs_metrics.counter(
+                "pio_prep_cache_rebuilds_total", reason="layout_drift"
+            ).value()
+            == drift0 + 1
+        )
+        # the fallback is only about the sharded pack: the spliced
+        # batch itself stays authoritative for the fresh-layout train
+        fresh = data_store.find_ratings("A", storage=storage, **FILTERS)
+        _assert_batch_equal(h.batch, fresh)
+
+    def test_key_mismatch_returns_none_without_drift(self, prep_storage):
+        storage, app_id = prep_storage
+        params = als_ops.ALSParams(rank=4, iterations=2)
+        self._seed(storage, app_id, params)
+        _put(storage, app_id, 400, 8)
+        drift0 = obs_metrics.counter(
+            "pio_prep_cache_rebuilds_total", reason="layout_drift"
+        ).value()
+        h = prep_cache.probe("A", storage=storage, **FILTERS)
+        assert h.status == "splice"
+        # different rank -> different pack key: not drift, just absent
+        other = als_ops.ALSParams(rank=6, iterations=2)
+        assert h.sharded_pack(other, self.SHARDS, "gather") is None
+        assert h.sharded_pack(params, self.SHARDS + 1, "gather") is None
+        assert (
+            obs_metrics.counter(
+                "pio_prep_cache_rebuilds_total", reason="layout_drift"
+            ).value()
+            == drift0
+        )
+        # iterations are solve-time, not pack-time: key still matches
+        more = als_ops.ALSParams(rank=4, iterations=9)
+        assert h.sharded_pack(more, self.SHARDS, "gather") is not None
+
+
+class TestCacheLifecycle:
+    """pio cache list/evict/prune semantics: LRU order by atime, byte
+    budget enforcement, husk sweeps, and eviction under a live reader."""
+
+    def _entry(self, storage, app_id, n=120):
+        _put(storage, app_id, 0, n)
+        h = prep_cache.probe("A", storage=storage, **FILTERS)
+        batch = data_store.find_ratings("A", storage=storage, **FILTERS)
+        assert _publish(h, batch)
+        (entry,) = prep_cache.cache_entries()
+        return entry, batch
+
+    def test_lru_budget_eviction(self, prep_storage):
+        import shutil
+
+        storage, app_id = prep_storage
+        entry, _ = self._entry(storage, app_id)
+        src = Path(entry["path"])
+        size = entry["bytes"]
+        # three byte-identical tenants with older last-use times
+        for i, name in enumerate(("aaa", "bbb", "ccc")):
+            dst = src.with_name(f"{name}{prep_cache.SUFFIX}")
+            shutil.copy2(src, dst)
+            t = entry["atime"] - 100.0 * (3 - i)
+            os.utime(dst, (t, t))
+        names = [e["name"] for e in prep_cache.cache_entries()]
+        assert names[:3] == [
+            f"aaa{prep_cache.SUFFIX}",
+            f"bbb{prep_cache.SUFFIX}",
+            f"ccc{prep_cache.SUFFIX}",
+        ]
+        assert names[3] == src.name  # newest-atime last
+
+        evicted = prep_cache.enforce_budget(limit=2 * size)
+        assert evicted == names[:2]  # oldest two went
+        left = prep_cache.cache_entries()
+        assert [e["name"] for e in left] == names[2:]
+        assert obs_metrics.gauge("pio_prep_cache_bytes").value() == float(
+            sum(e["bytes"] for e in left)
+        )
+        # unbounded (no limit, no env cap): a no-op
+        assert prep_cache.max_bytes() is None
+        assert prep_cache.enforce_budget() == []
+
+    def test_evict_by_name_and_bad_names(self, prep_storage):
+        storage, app_id = prep_storage
+        entry, _ = self._entry(storage, app_id)
+        assert not prep_cache.evict("nope.prep")  # absent
+        assert not prep_cache.evict(entry["name"] + ".bak")  # bad suffix
+        assert prep_cache.evict(entry["name"])
+        assert prep_cache.cache_entries() == []
+        assert obs_metrics.gauge("pio_prep_cache_bytes").value() == 0.0
+
+    def test_prune_sweeps_aged_husks_only(self, prep_storage):
+        storage, app_id = prep_storage
+        entry, _ = self._entry(storage, app_id)
+        d = prep_cache.cache_dir()
+        old_husk = d / "x.prep.tmp.123"
+        new_husk = d / "y.prep.tmp.456"
+        for husk in (old_husk, new_husk):
+            husk.write_bytes(b"partial")
+        t = time.time() - 1000.0
+        os.utime(old_husk, (t, t))
+        res = prep_cache.prune(max_age_s=600.0)
+        assert res["husks"] == [old_husk.name]
+        assert res["evicted"] == []
+        assert new_husk.exists()  # a live writer's tmp is left alone
+        assert Path(entry["path"]).exists()
+
+    def test_eviction_race_with_live_reader(self, prep_storage):
+        storage, app_id = prep_storage
+        entry, batch = self._entry(storage, app_id)
+        h = prep_cache.probe("A", storage=storage, **FILTERS)
+        assert h.status == "hit"  # holds the entry's mmap
+        assert prep_cache.evict(entry["name"])
+        # unlink doesn't tear the mapping: the reader's arrays survive
+        _assert_batch_equal(h.batch, batch)
+        rb, cb = h.packed_buckets(WIDTHS)
+        _assert_buckets_equal(rb, _fresh_pack(batch)[0])
+        # the NEXT probe sees a cold cache
+        assert (
+            prep_cache.probe("A", storage=storage, **FILTERS).status
+            == "miss"
+        )

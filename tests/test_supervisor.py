@@ -71,7 +71,7 @@ class _FakeProc:
 
 def _machine(
     *, seed=7, base=0.5, max_s=30.0, flap_max=100, flap_window_s=60.0,
-    stable_s=30.0,
+    stable_s=30.0, retrain=None,
 ):
     """A single-service supervisor with injected clock/sleep/spawn/probe
     so the crash/backoff/flap state machine runs without processes."""
@@ -103,6 +103,7 @@ def _machine(
         clock=lambda: clock["t"],
         sleep=lambda s: clock.__setitem__("t", clock["t"] + s),
         probe=probe,
+        retrain=retrain,
     )
     return sup, clock, procs
 
@@ -432,3 +433,203 @@ class TestRollingRestartByteParity:
                 old.stop()
             if new is not None:
                 new.stop()
+
+
+class TestRetrainScheduler:
+    """The SLO-driven retrain cadence machine (ISSUE 20), run entirely
+    on injected clock/spawn/fetch hooks: cadence + serialization, the
+    watermark-unmoved skip, burn-halving down to the floor with decay
+    back at ok, and failure accounting that never touches the
+    supervised-children flap detector."""
+
+    def _sched(self, interval=10.0, **kw):
+        clock = {"t": 0.0}
+        procs: list[_FakeProc] = []
+
+        def spawn():
+            p = _FakeProc(2000 + len(procs))
+            procs.append(p)
+            return p
+
+        defaults = dict(
+            train_argv=["train"],
+            spawn=spawn,
+            clock=lambda: clock["t"],
+            fetch_stats=lambda: None,
+            fetch_slo=lambda: None,
+            post_reload=lambda: 1,
+        )
+        defaults.update(kw)
+        return sup_mod.RetrainScheduler(interval, **defaults), clock, procs
+
+    def test_cadence_fires_serializes_and_reloads(self):
+        s, clock, procs = self._sched()
+        s.tick()
+        assert not procs, "fired before the first interval elapsed"
+        clock["t"] = 10.1
+        s.tick()
+        assert len(procs) == 1
+        clock["t"] = 25.0
+        s.tick()  # child still running: serialized, nothing new spawns
+        assert len(procs) == 1
+        procs[0].die(0)
+        s.tick()
+        assert s.runs == 1 and s.failures == 0
+        assert s.last_run["ok"] is True
+        assert s.last_run["reloaded"] == 1
+        clock["t"] = 36.0  # next cadence counts from the FINISH
+        s.tick()
+        assert len(procs) == 2
+
+    def test_unmoved_watermark_skips_the_tick(self):
+        wm = {"v": 100.0}
+        s, clock, procs = self._sched(
+            fetch_stats=lambda: {
+                "realtime": {"events_folded": wm["v"], "events_behind": 0.0}
+            }
+        )
+        clock["t"] = 10.1
+        s.tick()
+        procs[0].die(0)
+        s.tick()
+        assert s.runs == 1
+        clock["t"] = 21.0
+        s.tick()  # nothing new folded since the last successful run
+        assert len(procs) == 1 and s.skips == 1
+        assert s.last_run["skipped"] is True
+        wm["v"] = 150.0
+        clock["t"] = 32.0
+        s.tick()
+        assert len(procs) == 2 and s.skips == 1
+
+    def test_slo_burn_halves_to_floor_then_decays_back(self):
+        state = {"s": "burning"}
+        s, clock, procs = self._sched(
+            slo_driven=True, floor_s=1.0,
+            fetch_slo=lambda: {
+                "slos": [{"name": "serving.freshness", "state": state["s"]}]
+            },
+        )
+        t = 0.0
+        while s.interval_s > 1.0 and t < 120:
+            t += 1.1
+            clock["t"] = t
+            if procs and procs[-1].poll() is None:
+                procs[-1].die(0)
+            s.tick()
+        assert s.interval_s == 1.0, "burning SLO never reached the floor"
+        assert s.runs >= 1, "burn never pulled a retrain forward"
+        state["s"] = "ok"
+        while s.interval_s < s.base_interval_s and t < 400:
+            t += 1.1
+            clock["t"] = t
+            if procs and procs[-1].poll() is None:
+                procs[-1].die(0)
+            s.tick()
+        assert s.interval_s == s.base_interval_s, "ok never decayed back"
+
+    def test_spawn_failure_is_counted_not_raised(self):
+        def bad_spawn():
+            raise OSError("no such binary")
+
+        s, clock, _procs = self._sched(spawn=bad_spawn)
+        clock["t"] = 10.1
+        s.tick()
+        assert s.failures == 1
+        assert s.last_run["ok"] is False
+        assert "spawn failed" in s.last_run["exit"]
+        # the cadence machine keeps going
+        clock["t"] = 21.0
+        s.tick()
+        assert s.failures == 2
+
+    def test_kill9_mid_solve_then_clean_retrain(self):
+        """Chaos drill: kill -9 the scheduler's train child mid-solve;
+        the exit is recorded as a failure (not a crash-loop) and the
+        NEXT cadence tick retrains clean."""
+        spawned: list[subprocess.Popen] = []
+
+        def spawn():
+            code = (
+                "import time; time.sleep(60)" if not spawned
+                else "raise SystemExit(0)"
+            )
+            p = subprocess.Popen([sys.executable, "-c", code])
+            spawned.append(p)
+            return p
+
+        clock = {"t": 0.0}
+        s = sup_mod.RetrainScheduler(
+            5.0, train_argv=["train"], spawn=spawn,
+            clock=lambda: clock["t"], fetch_stats=lambda: None,
+            fetch_slo=lambda: None, post_reload=lambda: 1,
+        )
+        clock["t"] = 5.1
+        s.tick()
+        assert len(spawned) == 1
+        os.kill(spawned[0].pid, signal.SIGKILL)
+        spawned[0].wait(timeout=30)
+        clock["t"] = 6.0
+        s.tick()  # reap: a failure with the signal named, never a flap
+        assert s.failures == 1 and s.runs == 0
+        assert "SIGKILL" in s.last_run["exit"]
+        clock["t"] = 11.2
+        s.tick()  # next cadence: clean retrain
+        assert len(spawned) == 2
+        deadline = time.time() + 30
+        while spawned[1].poll() is None and time.time() < deadline:
+            time.sleep(0.02)
+        s.tick()
+        assert s.runs == 1 and s.last_run["ok"] is True
+
+    def test_retrain_failures_never_feed_the_flap_detector(self):
+        """A persistently failing retrain child must not break the
+        supervised engine: the retrain child is not a supervised
+        service, so the flap detector never sees its exits."""
+        rprocs: list[_FakeProc] = []
+
+        def rspawn():
+            p = _FakeProc(3000 + len(rprocs))
+            rprocs.append(p)
+            return p
+
+        clock_holder = {}
+        s = sup_mod.RetrainScheduler(
+            0.5, train_argv=["train"], spawn=rspawn,
+            clock=lambda: clock_holder.get("c", {"t": 0.0})["t"],
+            fetch_stats=lambda: None, fetch_slo=lambda: None,
+            post_reload=lambda: 1,
+        )
+        sup, clock, procs = _machine(flap_max=3, flap_window_s=60.0,
+                                     retrain=s)
+        clock_holder["c"] = clock
+        sup.start_all(wait_healthy_s=5.0)
+        for _ in range(20):
+            clock["t"] += 0.6
+            if rprocs and rprocs[-1].poll() is None:
+                rprocs[-1].die(1)  # every retrain crashes
+            sup.step(clock["t"])
+        assert s.failures >= 3
+        doc = sup.state_doc()
+        assert doc["retrain"]["failures"] == s.failures
+        assert doc["services"]["engine"]["state"] == "up"
+        assert doc["services"]["engine"]["restarts"] == 0
+        # the engine child itself never died: one spawn total
+        assert len(procs) == 1
+
+    def test_batch_only_serving_never_skips(self):
+        """An engine without the speed layer reports
+        realtime: {"enabled": false} — no counters. That is UNKNOWN
+        progress, not an unmoved watermark: the cadence must keep
+        retraining instead of skipping forever after the first run."""
+        s, clock, procs = self._sched(
+            fetch_stats=lambda: {"realtime": {"enabled": False}}
+        )
+        clock["t"] = 10.1
+        s.tick()
+        procs[0].die(0)
+        s.tick()
+        assert s.runs == 1
+        clock["t"] = 21.0
+        s.tick()  # would skip forever if the watermark read as 0.0
+        assert len(procs) == 2 and s.skips == 0
